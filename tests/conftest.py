@@ -1,0 +1,22 @@
+"""Test configuration: force an 8-device virtual CPU mesh before JAX imports.
+
+Multi-chip sharding paths are validated on a virtual CPU mesh
+(xla_force_host_platform_device_count=8); real-TPU benchmarking happens in
+bench.py, not in the test suite.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def tmp_storage_root(tmp_path):
+    root = tmp_path / "storage-root"
+    root.mkdir()
+    return root

@@ -1,0 +1,57 @@
+"""Contract tests for the filesystem and in-memory backends."""
+
+from __future__ import annotations
+
+import io
+
+import pytest
+
+from tests.storage_contract import StorageContract
+from tieredstorage_tpu.storage.core import BytesRange, ObjectKey, StorageBackendException
+from tieredstorage_tpu.storage.filesystem import FileSystemStorage
+from tieredstorage_tpu.storage.memory import InMemoryStorage
+
+
+class TestInMemoryStorage(StorageContract):
+    @pytest.fixture
+    def backend(self):
+        b = InMemoryStorage()
+        b.configure({})
+        return b
+
+
+class TestFileSystemStorage(StorageContract):
+    @pytest.fixture
+    def backend(self, tmp_storage_root):
+        b = FileSystemStorage()
+        b.configure({"root": str(tmp_storage_root), "overwrite.enabled": True})
+        return b
+
+    def test_requires_existing_writable_root(self, tmp_path):
+        b = FileSystemStorage()
+        with pytest.raises(ValueError):
+            b.configure({"root": str(tmp_path / "missing")})
+
+    def test_no_overwrite_by_default(self, tmp_storage_root):
+        b = FileSystemStorage()
+        b.configure({"root": str(tmp_storage_root)})
+        key = ObjectKey("a/b")
+        b.upload(io.BytesIO(b"one"), key)
+        with pytest.raises(StorageBackendException):
+            b.upload(io.BytesIO(b"two"), key)
+
+    def test_delete_prunes_empty_parent_dirs(self, tmp_storage_root):
+        b = FileSystemStorage()
+        b.configure({"root": str(tmp_storage_root), "overwrite.enabled": True})
+        key = ObjectKey("t-abc/0/00000000000000000000-x.log")
+        b.upload(io.BytesIO(b"data"), key)
+        assert (tmp_storage_root / "t-abc/0").is_dir()
+        b.delete(key)
+        assert not (tmp_storage_root / "t-abc").exists()
+        assert tmp_storage_root.is_dir()
+
+    def test_key_escaping_root_rejected(self, tmp_storage_root):
+        b = FileSystemStorage()
+        b.configure({"root": str(tmp_storage_root)})
+        with pytest.raises(StorageBackendException):
+            b.upload(io.BytesIO(b"x"), ObjectKey("../escape"))

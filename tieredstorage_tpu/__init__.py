@@ -1,0 +1,28 @@
+"""tieredstorage_tpu — a TPU-native tiered-storage framework.
+
+A brand-new implementation of the capabilities of
+aiven/tiered-storage-for-apache-kafka (KIP-405 RemoteStorageManager): chunked
+transform of Kafka log segments (compression -> AES-256-GCM envelope
+encryption -> chunk-index build), upload to pluggable object storage, and
+ranged detransform reads with caching and prefetch.
+
+Unlike the reference's one-chunk-at-a-time JNI stream pipeline
+(reference: core/src/main/java/io/aiven/kafka/tieredstorage/transform/), the
+transform here is a batched JAX/Pallas execution backend: whole-segment chunk
+arrays run vmapped AES-CTR+GHASH / CRC32C / compression kernels on TPU, with
+pjit/shard_map across chips for concurrent segments, behind a pluggable
+transform-backend seam (the CPU pipeline stays available and wire-compatible).
+
+Layer map (mirrors SURVEY.md §1):
+  rsm.py            — orchestration (reference L1)
+  transform/        — transform-backend seam + CPU/TPU backends (L2)
+  fetch/            — chunk manager + caches + prefetch (L3)
+  manifest/         — manifest + chunk-index data model, wire-compatible (L4)
+  security/         — AES-GCM data keys, RSA envelope encryption (L5)
+  storage/          — storage backend SPI + filesystem/S3/GCS/Azure (L6/L6a)
+  ops/              — TPU kernels (AES, GHASH, CRC32C, compression)
+  parallel/         — device mesh, shard_map batched transform
+  metrics/, config/ — observability + typed configuration
+"""
+
+__version__ = "0.1.0"

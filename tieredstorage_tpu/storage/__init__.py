@@ -1,0 +1,28 @@
+"""Storage backend SPI and concrete backends (reference L6/L6a).
+
+Reference: storage/core/src/main/java/io/aiven/kafka/tieredstorage/storage/.
+"""
+
+from tieredstorage_tpu.storage.core import (
+    BytesRange,
+    InvalidRangeException,
+    KeyNotFoundException,
+    ObjectDeleter,
+    ObjectFetcher,
+    ObjectKey,
+    ObjectUploader,
+    StorageBackend,
+    StorageBackendException,
+)
+
+__all__ = [
+    "BytesRange",
+    "InvalidRangeException",
+    "KeyNotFoundException",
+    "ObjectDeleter",
+    "ObjectFetcher",
+    "ObjectKey",
+    "ObjectUploader",
+    "StorageBackend",
+    "StorageBackendException",
+]

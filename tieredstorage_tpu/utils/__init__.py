@@ -1,0 +1,1 @@
+"""Shared host-side utilities (streams, varints, token bucket)."""
