@@ -1,0 +1,310 @@
+"""Tests for chunk-size codec, chunk indexes, segment indexes, and manifest serde."""
+
+from __future__ import annotations
+
+import base64
+import json
+import random
+import struct
+
+import pytest
+
+from tieredstorage_tpu.manifest import (
+    FixedSizeChunkIndex,
+    FixedSizeChunkIndexBuilder,
+    IndexType,
+    SegmentEncryptionMetadataV1,
+    SegmentIndexesV1Builder,
+    SegmentManifestV1,
+    VariableSizeChunkIndex,
+    VariableSizeChunkIndexBuilder,
+    chunk_index_from_json,
+    chunk_index_to_json,
+    decode_chunk_sizes,
+    encode_chunk_sizes,
+    manifest_from_json,
+    manifest_to_json,
+)
+from tieredstorage_tpu.storage.core import BytesRange
+
+
+class TestChunkSizesCodec:
+    def test_empty(self):
+        assert encode_chunk_sizes([]) == struct.pack(">i", 0)
+        assert decode_chunk_sizes(encode_chunk_sizes([])) == []
+
+    def test_single_value(self):
+        data = encode_chunk_sizes([12345])
+        assert data == struct.pack(">ii", 1, 12345)
+        assert decode_chunk_sizes(data) == [12345]
+
+    def test_golden_layout(self):
+        # values 1000000, 1000010, 1000020: base=1000000 over all-but-last,
+        # de-based body [0, 10] in 1 byte each, last raw.
+        data = encode_chunk_sizes([1000000, 1000010, 1000020])
+        expected = struct.pack(">iiB", 3, 1000000, 1) + bytes([0, 10]) + struct.pack(">i", 1000020)
+        assert data == expected
+
+    def test_small_last_value_not_in_base(self):
+        # Final chunk may be tiny; it must not drag the base down.
+        values = [4_194_304, 4_194_310, 4_194_309, 17]
+        data = encode_chunk_sizes(values)
+        count, base, bpv = struct.unpack_from(">iiB", data, 0)
+        assert (count, base, bpv) == (4, 4_194_304, 1)
+        assert decode_chunk_sizes(data) == values
+
+    @pytest.mark.parametrize("bpv_target", [1, 2, 3, 4])
+    def test_bytes_per_value_boundaries(self, bpv_target):
+        spread = min((1 << (8 * bpv_target)) - 1, 0x7FFFFFFF - 100)
+        values = [100, 100 + spread, 50]
+        data = encode_chunk_sizes(values)
+        _, _, bpv = struct.unpack_from(">iiB", data, 0)
+        assert bpv == bpv_target
+        assert decode_chunk_sizes(data) == values
+
+    def test_negative_rejected(self):
+        with pytest.raises(ValueError):
+            encode_chunk_sizes([-1])
+        with pytest.raises(ValueError):
+            encode_chunk_sizes([10, -1, 5])
+
+    def test_property_round_trip(self):
+        rng = random.Random(42)
+        for _ in range(50):
+            n = rng.randint(0, 2000)
+            base = rng.randint(0, 2**30)
+            spread = rng.choice([0, 5, 300, 70_000, 20_000_000])
+            values = [base + rng.randint(0, spread) for _ in range(n)]
+            if n:
+                values[-1] = rng.randint(0, base)
+            assert decode_chunk_sizes(encode_chunk_sizes(values)) == values
+
+    def test_expected_density(self):
+        # Reference doc example: variability 200 => ~1 byte/value
+        # (ChunkSizesBinaryCodec.java:43-61).
+        rng = random.Random(1)
+        values = [1024 * 1024 + rng.randint(0, 200) for _ in range(2047)]
+        data = encode_chunk_sizes(values)
+        assert len(data) / len(values) < 1.1
+
+
+class TestFixedSizeChunkIndex:
+    def test_basic_geometry(self):
+        # 250 bytes in chunks of 100 -> 3 chunks, final original size 50.
+        idx = FixedSizeChunkIndex(100, 250, 110, 80)
+        chunks = idx.chunks()
+        assert len(chunks) == 3
+        assert [c.original_position for c in chunks] == [0, 100, 200]
+        assert [c.original_size for c in chunks] == [100, 100, 50]
+        assert [c.transformed_position for c in chunks] == [0, 110, 220]
+        assert [c.transformed_size for c in chunks] == [110, 110, 80]
+        assert idx.total_transformed_size == 300
+
+    def test_find_chunk(self):
+        idx = FixedSizeChunkIndex(100, 250, 110, 80)
+        assert idx.find_chunk_for_original_offset(0).id == 0
+        assert idx.find_chunk_for_original_offset(99).id == 0
+        assert idx.find_chunk_for_original_offset(100).id == 1
+        assert idx.find_chunk_for_original_offset(249).id == 2
+        assert idx.find_chunk_for_original_offset(250) is None
+        assert idx.find_chunk_for_original_offset(10_000) is None
+        with pytest.raises(ValueError):
+            idx.find_chunk_for_original_offset(-1)
+
+    def test_chunks_for_range(self):
+        idx = FixedSizeChunkIndex(100, 250, 110, 80)
+        assert [c.id for c in idx.chunks_for_range(BytesRange.of(0, 249))] == [0, 1, 2]
+        assert [c.id for c in idx.chunks_for_range(BytesRange.of(150, 180))] == [1]
+        assert [c.id for c in idx.chunks_for_range(BytesRange.of(99, 100))] == [0, 1]
+        assert [c.id for c in idx.chunks_for_range(BytesRange.of(200, 10_000))] == [2]
+        assert idx.chunks_for_range(BytesRange.of(250, 300)) == []
+
+    def test_empty_file(self):
+        idx = FixedSizeChunkIndex(100, 0, 0, 0)
+        assert idx.chunk_count == 0
+        chunks = idx.chunks()
+        assert len(chunks) == 1 and chunks[0].original_size == 0
+        assert idx.find_chunk_for_original_offset(0) is None
+
+    def test_aligned_file_has_no_short_chunk(self):
+        idx = FixedSizeChunkIndex(100, 300, 110, 110)
+        assert [c.original_size for c in idx.chunks()] == [100, 100, 100]
+
+    def test_json_round_trip(self):
+        idx = FixedSizeChunkIndex(100, 250, 110, 80)
+        obj = chunk_index_to_json(idx)
+        assert obj["type"] == "fixed"
+        assert chunk_index_from_json(json.loads(json.dumps(obj))) == idx
+
+
+class TestVariableSizeChunkIndex:
+    def test_geometry(self):
+        idx = VariableSizeChunkIndex(100, 250, [30, 20, 10])
+        chunks = idx.chunks()
+        assert [c.transformed_position for c in chunks] == [0, 30, 50]
+        assert [c.original_size for c in chunks] == [100, 100, 50]
+        assert idx.total_transformed_size == 60
+
+    def test_json_round_trip_uses_binary_codec(self):
+        idx = VariableSizeChunkIndex(100, 250, [30, 20, 10])
+        obj = chunk_index_to_json(idx)
+        assert obj["type"] == "variable"
+        decoded = decode_chunk_sizes(base64.b64decode(obj["transformedChunks"]))
+        assert decoded == [30, 20, 10]
+        assert chunk_index_from_json(obj) == idx
+
+    def test_unknown_type_rejected(self):
+        with pytest.raises(ValueError):
+            chunk_index_from_json({"type": "wat"})
+
+
+class TestBuilders:
+    def test_fixed_builder_protocol(self):
+        b = FixedSizeChunkIndexBuilder(100, 250, 110)
+        b.add_chunk(110)
+        b.add_chunk(110)
+        idx = b.finish(80)
+        assert idx == FixedSizeChunkIndex(100, 250, 110, 80)
+
+    def test_fixed_builder_rejects_mismatched_size(self):
+        b = FixedSizeChunkIndexBuilder(100, 250, 110)
+        with pytest.raises(ValueError):
+            b.add_chunk(111)
+
+    def test_too_many_chunks_rejected(self):
+        b = VariableSizeChunkIndexBuilder(100, 250)
+        b.add_chunk(5)
+        b.add_chunk(6)
+        with pytest.raises(RuntimeError):
+            b.add_chunk(7)
+
+    def test_premature_finish_rejected(self):
+        b = VariableSizeChunkIndexBuilder(100, 250)
+        with pytest.raises(RuntimeError):
+            b.finish(1)
+
+    def test_variable_builder(self):
+        b = VariableSizeChunkIndexBuilder(100, 201)
+        b.add_chunk(30)
+        b.add_chunk(20)
+        idx = b.finish(3)
+        assert idx == VariableSizeChunkIndex(100, 201, [30, 20, 3])
+
+    def test_double_finish_rejected(self):
+        b = FixedSizeChunkIndexBuilder(100, 100, 110)
+        b.finish(80)
+        with pytest.raises(RuntimeError):
+            b.finish(80)
+
+
+def _segment_indexes():
+    return (
+        SegmentIndexesV1Builder()
+        .add(IndexType.OFFSET, 16)
+        .add(IndexType.TIMESTAMP, 24)
+        .add(IndexType.PRODUCER_SNAPSHOT, 8)
+        .add(IndexType.LEADER_EPOCH, 0)
+        .build()
+    )
+
+
+class TestSegmentIndexes:
+    def test_positions_accumulate(self):
+        si = (
+            SegmentIndexesV1Builder()
+            .add(IndexType.OFFSET, 16)
+            .add(IndexType.TIMESTAMP, 24)
+            .add(IndexType.PRODUCER_SNAPSHOT, 8)
+            .add(IndexType.LEADER_EPOCH, 4)
+            .add(IndexType.TRANSACTION, 10)
+            .build()
+        )
+        assert (si.offset.position, si.offset.size) == (0, 16)
+        assert (si.timestamp.position, si.timestamp.size) == (16, 24)
+        assert (si.producer_snapshot.position, si.producer_snapshot.size) == (40, 8)
+        assert (si.leader_epoch.position, si.leader_epoch.size) == (48, 4)
+        assert (si.transaction.position, si.transaction.size) == (52, 10)
+        assert si.segment_index(IndexType.TIMESTAMP) is si.timestamp
+
+    def test_mandatory_types_enforced(self):
+        with pytest.raises(ValueError, match="LEADER_EPOCH"):
+            SegmentIndexesV1Builder().add(IndexType.OFFSET, 1).add(IndexType.TIMESTAMP, 1).add(
+                IndexType.PRODUCER_SNAPSHOT, 1
+            ).build()
+
+    def test_duplicate_rejected(self):
+        b = SegmentIndexesV1Builder().add(IndexType.OFFSET, 1)
+        with pytest.raises(ValueError):
+            b.add(IndexType.OFFSET, 2)
+
+    def test_transaction_optional_and_null_in_json(self):
+        si = _segment_indexes()
+        assert si.transaction is None
+        assert si.to_json()["transaction"] is None
+
+
+class TestManifestSerde:
+    def test_plain_manifest_json_shape(self):
+        m = SegmentManifestV1(
+            chunk_index=FixedSizeChunkIndex(100, 250, 110, 80),
+            segment_indexes=_segment_indexes(),
+            compression=False,
+        )
+        obj = json.loads(manifest_to_json(m))
+        assert obj["version"] == "1"
+        assert obj["chunkIndex"]["type"] == "fixed"
+        assert obj["compression"] is False
+        assert "encryption" not in obj
+        assert "compressionCodec" not in obj
+        assert manifest_from_json(json.dumps(obj)) == m
+
+    def test_encrypted_manifest_uses_data_key_hooks(self):
+        m = SegmentManifestV1(
+            chunk_index=VariableSizeChunkIndex(100, 250, [30, 20, 10]),
+            segment_indexes=_segment_indexes(),
+            compression=True,
+            encryption=SegmentEncryptionMetadataV1(data_key=b"\x01" * 32, aad=b"\x02" * 32),
+        )
+        encoder = lambda dek: "static-key-id:" + base64.b64encode(dek[::-1]).decode()
+        decoder = lambda s: base64.b64decode(s.split(":", 1)[1])[::-1]
+        text = manifest_to_json(m, data_key_encoder=encoder)
+        obj = json.loads(text)
+        assert obj["encryption"]["dataKey"].startswith("static-key-id:")
+        assert base64.b64decode(obj["encryption"]["aad"]) == b"\x02" * 32
+        back = manifest_from_json(text, data_key_decoder=decoder)
+        assert back.encryption.data_key == b"\x01" * 32
+        assert back == m
+
+    def test_encryption_without_encoder_rejected(self):
+        m = SegmentManifestV1(
+            chunk_index=FixedSizeChunkIndex(100, 100, 110, 110),
+            segment_indexes=_segment_indexes(),
+            compression=False,
+            encryption=SegmentEncryptionMetadataV1(b"\x00" * 32, b"\x00" * 32),
+        )
+        with pytest.raises(ValueError):
+            manifest_to_json(m)
+
+    def test_codec_id_round_trip(self):
+        m = SegmentManifestV1(
+            chunk_index=VariableSizeChunkIndex(100, 250, [30, 20, 10]),
+            segment_indexes=_segment_indexes(),
+            compression=True,
+            compression_codec="tsz1",
+        )
+        obj = json.loads(manifest_to_json(m))
+        assert obj["compressionCodec"] == "tsz1"
+        assert manifest_from_json(json.dumps(obj)).compression_codec == "tsz1"
+
+    def test_zstd_codec_id_omitted_for_reference_compat(self):
+        m = SegmentManifestV1(
+            chunk_index=VariableSizeChunkIndex(100, 250, [30, 20, 10]),
+            segment_indexes=_segment_indexes(),
+            compression=True,
+            compression_codec="zstd",
+        )
+        assert "compressionCodec" not in json.loads(manifest_to_json(m))
+
+    def test_unsupported_version_rejected(self):
+        with pytest.raises(ValueError):
+            manifest_from_json(json.dumps({"version": "2"}))

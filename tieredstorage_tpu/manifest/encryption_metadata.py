@@ -1,0 +1,21 @@
+"""Per-segment encryption metadata stored in the manifest.
+
+Reference: core/.../manifest/SegmentEncryptionMetadataV1.java (IV_SIZE = 12 at
+:30; fields `dataKey` — the AES-256 DEK, RSA-enveloped in JSON — and `aad`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+IV_SIZE = 12
+
+
+@dataclasses.dataclass(frozen=True)
+class SegmentEncryptionMetadataV1:
+    data_key: bytes  # raw AES-256 key bytes (32)
+    aad: bytes
+
+    @property
+    def iv_size(self) -> int:
+        return IV_SIZE
