@@ -1,0 +1,131 @@
+"""Security layer tests: AES-GCM chunks, RSA-OAEP envelope, PEM keyring."""
+
+from __future__ import annotations
+
+import base64
+
+import pytest
+
+from tieredstorage_tpu.security import (
+    AesEncryptionProvider,
+    EncryptedDataKey,
+    RsaEncryptionProvider,
+)
+from tieredstorage_tpu.security.aes import IV_SIZE, TAG_SIZE
+from tieredstorage_tpu.security.rsa import (
+    _oaep_decode,
+    _oaep_encode,
+    generate_key_pair_pem_files,
+)
+
+
+@pytest.fixture(scope="module")
+def rsa_provider(tmp_path_factory):
+    d = tmp_path_factory.mktemp("keys")
+    pub1, priv1 = generate_key_pair_pem_files(d, prefix="k1")
+    pub2, priv2 = generate_key_pair_pem_files(d, prefix="k2")
+    return RsaEncryptionProvider.from_pem_files(
+        "key1", {"key1": (pub1, priv1), "key2": (pub2, priv2)}
+    )
+
+
+class TestAes:
+    def test_data_key_and_aad_independent(self):
+        pair = AesEncryptionProvider.create_data_key_and_aad()
+        assert len(pair.data_key) == 32
+        assert len(pair.aad) == 32
+        assert pair.data_key != pair.aad
+
+    def test_chunk_round_trip(self):
+        pair = AesEncryptionProvider.create_data_key_and_aad()
+        ct = AesEncryptionProvider.encrypt_chunk(b"payload", pair.data_key, pair.aad)
+        assert len(ct) == AesEncryptionProvider.encrypted_chunk_size(len(b"payload"))
+        assert AesEncryptionProvider.decrypt_chunk(ct, pair.data_key, pair.aad) == b"payload"
+
+    def test_fresh_iv_per_chunk(self):
+        pair = AesEncryptionProvider.create_data_key_and_aad()
+        c1 = AesEncryptionProvider.encrypt_chunk(b"same", pair.data_key, pair.aad)
+        c2 = AesEncryptionProvider.encrypt_chunk(b"same", pair.data_key, pair.aad)
+        assert c1[:IV_SIZE] != c2[:IV_SIZE]
+        assert c1 != c2
+
+    def test_wrong_aad_rejected(self):
+        pair = AesEncryptionProvider.create_data_key_and_aad()
+        ct = AesEncryptionProvider.encrypt_chunk(b"payload", pair.data_key, pair.aad)
+        with pytest.raises(Exception):
+            AesEncryptionProvider.decrypt_chunk(ct, pair.data_key, b"\x00" * 32)
+
+    def test_tampered_ciphertext_rejected(self):
+        pair = AesEncryptionProvider.create_data_key_and_aad()
+        ct = bytearray(AesEncryptionProvider.encrypt_chunk(b"payload", pair.data_key, pair.aad))
+        ct[IV_SIZE] ^= 0xFF
+        with pytest.raises(Exception):
+            AesEncryptionProvider.decrypt_chunk(bytes(ct), pair.data_key, pair.aad)
+
+    def test_size_formula(self):
+        assert AesEncryptionProvider.encrypted_chunk_size(100) == IV_SIZE + 100 + TAG_SIZE
+
+
+class TestOaep:
+    def test_round_trip(self):
+        em = _oaep_encode(b"\x01" * 32, 256)
+        assert len(em) == 256 and em[0] == 0
+        assert _oaep_decode(em, 256) == b"\x01" * 32
+
+    def test_randomized(self):
+        assert _oaep_encode(b"m", 256) != _oaep_encode(b"m", 256)
+
+    def test_too_long_rejected(self):
+        with pytest.raises(ValueError):
+            _oaep_encode(b"x" * 200, 256)  # max = 256 - 130 = 126
+
+    def test_corrupted_rejected(self):
+        em = bytearray(_oaep_encode(b"secret", 256))
+        em[100] ^= 0x01
+        with pytest.raises(ValueError):
+            _oaep_decode(bytes(em), 256)
+
+
+class TestRsaProvider:
+    def test_envelope_round_trip(self, rsa_provider):
+        dek = b"\x42" * 32
+        enc = rsa_provider.encrypt_data_key(dek)
+        assert enc.key_encryption_key_id == "key1"
+        assert len(enc.encrypted_data_key) == 256
+        assert rsa_provider.decrypt_data_key(enc) == dek
+
+    def test_decrypt_with_non_active_ring_key(self, rsa_provider):
+        # Rotate: messages encrypted under key2 still decrypt via the ring.
+        other = RsaEncryptionProvider("key2", rsa_provider._keyring)
+        enc = other.encrypt_data_key(b"\x07" * 32)
+        assert enc.key_encryption_key_id == "key2"
+        assert rsa_provider.decrypt_data_key(enc) == b"\x07" * 32
+
+    def test_unknown_key_id_rejected(self, rsa_provider):
+        with pytest.raises(ValueError, match="Unknown key"):
+            rsa_provider.decrypt_data_key(EncryptedDataKey("nope", b"\x00" * 256))
+
+    def test_active_key_must_be_in_ring(self, rsa_provider):
+        with pytest.raises(ValueError):
+            RsaEncryptionProvider("ghost", rsa_provider._keyring)
+
+    def test_serde_hooks_produce_key_id_prefix(self, rsa_provider):
+        s = rsa_provider.data_key_encoder(b"\x01" * 32)
+        assert s.startswith("key1:")
+        base64.b64decode(s.split(":", 1)[1])  # valid base64
+        assert rsa_provider.data_key_decoder(s) == b"\x01" * 32
+
+
+class TestEncryptedDataKey:
+    def test_serialize_parse(self):
+        e = EncryptedDataKey("rsa-key-1", b"\x00\x01\x02")
+        assert EncryptedDataKey.parse(e.serialize()) == e
+
+    def test_malformed_rejected(self):
+        for bad in ("", "nocolon", ":empty-id", "id:"):
+            with pytest.raises(ValueError):
+                EncryptedDataKey.parse(bad)
+
+    def test_key_id_with_colon_rejected(self):
+        with pytest.raises(ValueError):
+            EncryptedDataKey("a:b", b"\x01")

@@ -1,0 +1,16 @@
+"""Envelope encryption (reference L5): AES-256-GCM data keys wrapped by RSA KEKs.
+
+Reference: core/src/main/java/io/aiven/kafka/tieredstorage/security/.
+"""
+
+from tieredstorage_tpu.security.aes import AesEncryptionProvider, DataKeyAndAAD
+from tieredstorage_tpu.security.keys import EncryptedDataKey
+from tieredstorage_tpu.security.rsa import RsaEncryptionProvider, RsaKeyReader
+
+__all__ = [
+    "AesEncryptionProvider",
+    "DataKeyAndAAD",
+    "EncryptedDataKey",
+    "RsaEncryptionProvider",
+    "RsaKeyReader",
+]

@@ -1,0 +1,65 @@
+"""AES-256-GCM data-key provider (host/CPU path).
+
+Reference: core/.../security/AesEncryptionProvider.java — AES-256, GCM with
+128-bit tag and 12-byte IV (constants :36-39), a fresh DEK + AAD pair per
+segment from two independent key generations (:52-58; the reference comments
+that deriving AAD from the DEK would be a security flaw), fresh random IV per
+chunk with ciphertext layout `IV || ciphertext || tag` (the `cryptography`
+AEAD API emits ciphertext||tag, matching JDK GCM output).
+
+The TPU path (ops/aes.py + ops/ghash.py) produces identical bytes for the
+same (key, iv, aad, plaintext); this module is the correctness oracle and the
+non-TPU fallback.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+KEY_SIZE = 32  # AES-256
+IV_SIZE = 12
+TAG_SIZE = 16
+AAD_SIZE = 32
+
+
+@dataclasses.dataclass(frozen=True)
+class DataKeyAndAAD:
+    data_key: bytes
+    aad: bytes
+
+
+class AesEncryptionProvider:
+    @staticmethod
+    def create_data_key_and_aad() -> DataKeyAndAAD:
+        # Two independent random draws, like the reference's two generateKey()
+        # calls (AesEncryptionProvider.java:52-58).
+        return DataKeyAndAAD(data_key=os.urandom(KEY_SIZE), aad=os.urandom(AAD_SIZE))
+
+    @staticmethod
+    def encrypt_chunk(plaintext: bytes, data_key: bytes, aad: bytes, iv: bytes | None = None) -> bytes:
+        """Returns IV || ciphertext || tag; a fresh random IV unless given."""
+        if iv is None:
+            iv = os.urandom(IV_SIZE)
+        if len(iv) != IV_SIZE:
+            raise ValueError(f"IV must be {IV_SIZE} bytes")
+        return iv + AESGCM(data_key).encrypt(iv, plaintext, aad)
+
+    @staticmethod
+    def decrypt_chunk(transformed: bytes, data_key: bytes, aad: bytes) -> bytes:
+        """Inverse of encrypt_chunk: reads the IV from the chunk head
+        (reference: DecryptionChunkEnumeration.java:54-62)."""
+        if len(transformed) < IV_SIZE + TAG_SIZE:
+            raise ValueError("Encrypted chunk shorter than IV+tag")
+        iv, ct = transformed[:IV_SIZE], transformed[IV_SIZE:]
+        return AESGCM(data_key).decrypt(iv, ct, aad)
+
+    @staticmethod
+    def encrypted_chunk_size(plaintext_size: int) -> int:
+        """Fixed size growth: IV + plaintext + tag (GCM is length-preserving).
+
+        Reference: EncryptionChunkEnumeration.encryptedChunkSize:82-84.
+        """
+        return IV_SIZE + plaintext_size + TAG_SIZE
