@@ -1,0 +1,34 @@
+"""Transform/detransform pipeline (reference L2) behind a pluggable backend seam.
+
+The reference processes one chunk at a time through an Enumeration decorator
+chain (core/.../transform/ — Base -> [Compression] -> [Encryption] on upload,
+Base -> [Decryption] -> [Decompression] on fetch, composed at
+RemoteStorageManager.transformation:434-453 and DefaultChunkManager:50-66).
+
+This framework inverts that: a whole window of chunks becomes one batch, and a
+TransformBackend maps `batch of original chunks -> (transformed chunks,
+sizes)` in a single call — the shape TPU execution wants (vmapped kernels over
+a uint8[batch, chunk_size] array). The CPU backend (zstd + AES-GCM via host
+libs) is wire-compatible with the reference and doubles as the correctness
+oracle; the backend is selected via the `transform.backend.class` config seam.
+"""
+
+from tieredstorage_tpu.transform.api import (
+    DetransformOptions,
+    TransformBackend,
+    TransformOptions,
+)
+from tieredstorage_tpu.transform.cpu import CpuTransformBackend
+from tieredstorage_tpu.transform.pipeline import (
+    SegmentTransformation,
+    detransform_chunks,
+)
+
+__all__ = [
+    "CpuTransformBackend",
+    "DetransformOptions",
+    "SegmentTransformation",
+    "TransformBackend",
+    "TransformOptions",
+    "detransform_chunks",
+]

@@ -1,0 +1,97 @@
+"""The transform-backend seam: batch-of-chunks in, batch-of-chunks out.
+
+This is the `transform.backend.class` pluggability point (the new seam this
+framework adds next to the reference's `storage.backend.class` and
+`fetch.chunk.cache.class`; see BASELINE notes). Backends are stateless with
+respect to segments: every call carries the full cryptographic/codec context,
+so calls can be batched, reordered, and sharded across chips freely.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import Optional, Sequence
+
+from tieredstorage_tpu.security.aes import DataKeyAndAAD
+
+#: Compression codec ids recordable in the manifest. "zstd" is the
+#: reference-compatible default (zstd frame with content size, one frame per
+#: chunk — CompressionChunkEnumeration.java:50-63).
+ZSTD = "zstd"
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformOptions:
+    """Per-segment transform context (upload direction)."""
+
+    compression: bool = False
+    compression_codec: str = ZSTD
+    compression_level: int = 3
+    encryption: Optional[DataKeyAndAAD] = None
+    # Deterministic IVs for tests; None = fresh random IV per chunk (the
+    # reference's behavior: fresh cipher per chunk,
+    # EncryptionChunkEnumeration.java:66-81).
+    ivs: Optional[Sequence[bytes]] = None
+
+    @property
+    def is_identity(self) -> bool:
+        return not self.compression and self.encryption is None
+
+    def fixed_transformed_size(self, original_size: int) -> Optional[int]:
+        """Transformed size when it's statically known (null = variable).
+
+        Mirrors TransformChunkEnumeration.transformedChunkSize() semantics
+        (core/.../transform/TransformChunkEnumeration.java:20-42).
+        """
+        if self.compression:
+            return None
+        if self.encryption is not None:
+            from tieredstorage_tpu.security.aes import IV_SIZE, TAG_SIZE
+
+            return IV_SIZE + original_size + TAG_SIZE
+        return original_size
+
+
+@dataclasses.dataclass(frozen=True)
+class DetransformOptions:
+    """Per-segment detransform context (fetch direction)."""
+
+    compression: bool = False
+    compression_codec: str = ZSTD
+    encryption: Optional[DataKeyAndAAD] = None
+
+    @staticmethod
+    def from_manifest(manifest, aes_key: Optional[DataKeyAndAAD] = None) -> "DetransformOptions":
+        enc = None
+        if manifest.encryption is not None:
+            enc = DataKeyAndAAD(manifest.encryption.data_key, manifest.encryption.aad)
+        if aes_key is not None:
+            enc = aes_key
+        return DetransformOptions(
+            compression=manifest.compression,
+            compression_codec=manifest.compression_codec or ZSTD,
+            encryption=enc,
+        )
+
+
+class TransformBackend(abc.ABC):
+    """Maps batches of chunks through [compress] -> [encrypt] and back."""
+
+    #: Preferred number of chunks per transform call; the pipeline feeds
+    #: windows of roughly this size. TPU backends set this to fill the chip.
+    preferred_batch_chunks: int = 64
+
+    def configure(self, configs: dict) -> None:  # noqa: B027
+        """Configure from the `transform.`-prefixed config subset."""
+
+    @abc.abstractmethod
+    def transform(self, chunks: Sequence[bytes], opts: TransformOptions) -> list[bytes]:
+        """Upload direction: original chunks -> transformed chunks (1:1)."""
+
+    @abc.abstractmethod
+    def detransform(self, chunks: Sequence[bytes], opts: DetransformOptions) -> list[bytes]:
+        """Fetch direction: transformed chunks -> original chunks (1:1)."""
+
+    def close(self) -> None:  # noqa: B027
+        pass

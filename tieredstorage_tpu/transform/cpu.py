@@ -1,0 +1,69 @@
+"""CPU transform backend: host zstd + AES-GCM; reference-wire-compatible oracle.
+
+Per-chunk zstd frames carry the content size (the reference pledges source
+size and sets content-size so the decompressor can size its output —
+CompressionChunkEnumeration.java:50-63, DecompressionChunkEnumeration.java:39-46);
+encryption produces IV || ciphertext || tag per chunk with a fresh IV
+(EncryptionChunkEnumeration.java:66-81). Compose order: compress then encrypt
+on upload; decrypt then decompress on fetch.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import zstandard
+
+from tieredstorage_tpu.security.aes import AesEncryptionProvider
+from tieredstorage_tpu.transform.api import (
+    ZSTD,
+    DetransformOptions,
+    TransformBackend,
+    TransformOptions,
+)
+
+
+class CpuTransformBackend(TransformBackend):
+    def transform(self, chunks: Sequence[bytes], opts: TransformOptions) -> list[bytes]:
+        out = list(chunks)
+        if opts.compression:
+            if opts.compression_codec != ZSTD:
+                raise ValueError(
+                    f"CPU backend supports only the {ZSTD!r} codec, "
+                    f"got {opts.compression_codec!r}"
+                )
+            # A compressor per chunk size keeps the pledged-src-size frames
+            # identical to the reference's per-chunk Zstd usage.
+            out = [
+                zstandard.ZstdCompressor(
+                    level=opts.compression_level, write_content_size=True
+                ).compress(c)
+                for c in out
+            ]
+        if opts.encryption is not None:
+            enc = opts.encryption
+            ivs = opts.ivs
+            out = [
+                AesEncryptionProvider.encrypt_chunk(
+                    c, enc.data_key, enc.aad, iv=None if ivs is None else ivs[i]
+                )
+                for i, c in enumerate(out)
+            ]
+        return out
+
+    def detransform(self, chunks: Sequence[bytes], opts: DetransformOptions) -> list[bytes]:
+        out = list(chunks)
+        if opts.encryption is not None:
+            enc = opts.encryption
+            out = [
+                AesEncryptionProvider.decrypt_chunk(c, enc.data_key, enc.aad) for c in out
+            ]
+        if opts.compression:
+            if opts.compression_codec != ZSTD:
+                raise ValueError(
+                    f"CPU backend supports only the {ZSTD!r} codec, "
+                    f"got {opts.compression_codec!r}"
+                )
+            dctx = zstandard.ZstdDecompressor()
+            out = [dctx.decompress(c) for c in out]
+        return out
