@@ -1,0 +1,257 @@
+"""Unit tests: object key factory, custom metadata serde, varints, rate limiter,
+record-batch heuristic, config parsing."""
+
+from __future__ import annotations
+
+import io
+import struct
+import time
+
+import pytest
+
+from tieredstorage_tpu.config.configdef import ConfigException
+from tieredstorage_tpu.config.rsm_config import RemoteStorageManagerConfig
+from tieredstorage_tpu.custom_metadata import (
+    SegmentCustomMetadataBuilder,
+    SegmentCustomMetadataField,
+    deserialize_custom_metadata,
+    serialize_custom_metadata,
+)
+from tieredstorage_tpu.kafka_records import (
+    InvalidRecordBatchException,
+    first_batch_compression_codec,
+    segment_looks_compressed,
+)
+from tieredstorage_tpu.metadata import (
+    KafkaUuid,
+    RemoteLogSegmentId,
+    RemoteLogSegmentMetadata,
+    TopicIdPartition,
+    TopicPartition,
+)
+from tieredstorage_tpu.object_key import ObjectKeyFactory, Suffix, main_path
+from tieredstorage_tpu.utils.ratelimit import MIN_RATE, RateLimitedStream, TokenBucket
+from tieredstorage_tpu.utils.varint import (
+    read_unsigned_varint,
+    read_varlong,
+    write_unsigned_varint,
+    write_varlong,
+)
+
+
+def _metadata(topic="topic", partition=7, offset=1234):
+    tip = TopicIdPartition(KafkaUuid(b"\x01" * 16), TopicPartition(topic, partition))
+    return RemoteLogSegmentMetadata(
+        remote_log_segment_id=RemoteLogSegmentId(tip, KafkaUuid(b"\x02" * 16)),
+        start_offset=offset,
+        end_offset=offset + 100,
+    )
+
+
+class TestObjectKeyFactory:
+    def test_layout(self):
+        factory = ObjectKeyFactory("someprefix/")
+        key = factory.key(_metadata(), Suffix.LOG)
+        assert key.value == (
+            "someprefix/topic-AQEBAQEBAQEBAQEBAQEBAQ/7/"
+            "00000000000000001234-AgICAgICAgICAgICAgICAg.log"
+        )
+
+    def test_all_suffixes(self):
+        factory = ObjectKeyFactory(None)
+        md = _metadata()
+        assert factory.key(md, Suffix.LOG).value.endswith(".log")
+        assert factory.key(md, Suffix.INDEXES).value.endswith(".indexes")
+        assert factory.key(md, Suffix.MANIFEST).value.endswith(".rsm-manifest")
+
+    def test_offset_zero_padding(self):
+        assert "/00000000000000000000-" in main_path(_metadata(offset=0))
+        assert "/09223372036854775807-" in main_path(_metadata(offset=2**63 - 1))
+
+    def test_masked_prefix_hides_in_str_but_not_value(self):
+        factory = ObjectKeyFactory("secret/", mask_prefix=True)
+        key = factory.key(_metadata(), Suffix.LOG)
+        assert key.value.startswith("secret/")
+        assert str(key).startswith("<prefix>/")
+        assert "secret" not in str(key)
+
+    def test_fields_override(self):
+        factory = ObjectKeyFactory("configured/")
+        md = _metadata()
+        fields = {
+            SegmentCustomMetadataField.OBJECT_PREFIX.index: "stored/",
+            SegmentCustomMetadataField.OBJECT_KEY.index: "custom/main/path",
+        }
+        key = factory.key_from_fields(fields, md, Suffix.LOG)
+        assert key.value == "stored/custom/main/path.log"
+        # Partial override: only prefix.
+        key2 = factory.key_from_fields(
+            {SegmentCustomMetadataField.OBJECT_PREFIX.index: "stored/"}, md, Suffix.LOG
+        )
+        assert key2.value == "stored/" + main_path(md) + ".log"
+
+
+class TestVarint:
+    @pytest.mark.parametrize("v", [0, 1, 127, 128, 300, 2**31 - 1, 2**40])
+    def test_unsigned_round_trip(self, v):
+        out = bytearray()
+        write_unsigned_varint(v, out)
+        got, pos = read_unsigned_varint(bytes(out), 0)
+        assert (got, pos) == (v, len(out))
+
+    @pytest.mark.parametrize("v", [0, -1, 1, 63, -64, 2**40, -(2**40), 2**62])
+    def test_varlong_round_trip(self, v):
+        out = bytearray()
+        write_varlong(v, out)
+        got, pos = read_varlong(bytes(out), 0)
+        assert (got, pos) == (v, len(out))
+
+    def test_zigzag_small_encoding(self):
+        out = bytearray()
+        write_varlong(-1, out)
+        assert bytes(out) == b"\x01"  # zigzag(-1) = 1
+
+
+class TestCustomMetadataSerde:
+    def test_round_trip_all_fields(self):
+        fields = {0: 123456789, 1: "prefix/", 2: "topic-abc/7/000123-uuid"}
+        data = serialize_custom_metadata(fields)
+        assert deserialize_custom_metadata(data) == fields
+
+    def test_empty(self):
+        assert serialize_custom_metadata({}) == b""
+        assert deserialize_custom_metadata(b"") == {}
+        assert deserialize_custom_metadata(None) == {}
+
+    def test_builder_totals_and_subset(self):
+        md = _metadata()
+        b = SegmentCustomMetadataBuilder(
+            [SegmentCustomMetadataField.REMOTE_SIZE], "pre/", md
+        )
+        b.add_upload_result(Suffix.LOG, 1000)
+        b.add_upload_result(Suffix.INDEXES, 200)
+        b.add_upload_result(Suffix.MANIFEST, 30)
+        assert b.total_size() == 1230
+        fields = b.build()
+        assert fields == {0: 1230}
+        with pytest.raises(ValueError):
+            b.add_upload_result(Suffix.LOG, 1)
+
+
+class TestRecordBatchHeuristic:
+    def _v2_segment(self, tmp_path, attributes: int) -> str:
+        p = tmp_path / "seg.log"
+        p.write_bytes(struct.pack(">qiibih", 0, 100, 0, 2, 0, attributes) + b"\x00" * 64)
+        return p
+
+    def test_uncompressed_v2(self, tmp_path):
+        assert first_batch_compression_codec(self._v2_segment(tmp_path, 0)) == 0
+        assert not segment_looks_compressed(self._v2_segment(tmp_path, 0))
+
+    @pytest.mark.parametrize("codec", [1, 2, 3, 4])
+    def test_compressed_v2(self, tmp_path, codec):
+        assert first_batch_compression_codec(self._v2_segment(tmp_path, codec)) == codec
+
+    def test_timestamp_bits_ignored(self, tmp_path):
+        # Attribute bit 3 is the timestamp type, not compression.
+        assert first_batch_compression_codec(self._v2_segment(tmp_path, 0x08)) == 0
+
+    def test_legacy_magic1(self, tmp_path):
+        p = tmp_path / "legacy.log"
+        p.write_bytes(struct.pack(">qiibb", 0, 100, 0, 1, 0x02) + b"\x00" * 32)
+        assert first_batch_compression_codec(p) == 2
+
+    def test_truncated_rejected(self, tmp_path):
+        p = tmp_path / "tiny.log"
+        p.write_bytes(b"\x00" * 4)
+        with pytest.raises(InvalidRecordBatchException):
+            first_batch_compression_codec(p)
+
+    def test_bad_magic_rejected(self, tmp_path):
+        p = tmp_path / "bad.log"
+        p.write_bytes(b"\x00" * 16 + b"\x09" + b"\x00" * 16)
+        with pytest.raises(InvalidRecordBatchException):
+            first_batch_compression_codec(p)
+
+
+class TestTokenBucket:
+    def test_paces_reads(self):
+        bucket = TokenBucket(MIN_RATE)  # 16 KiB/s
+        stream = RateLimitedStream(io.BytesIO(b"x" * (MIN_RATE + MIN_RATE // 2)), bucket)
+        start = time.monotonic()
+        assert len(stream.read(MIN_RATE)) == MIN_RATE  # burst: full bucket
+        elapsed_burst = time.monotonic() - start
+        assert elapsed_burst < 0.3
+        start = time.monotonic()
+        stream.read(MIN_RATE // 2)  # must wait ~0.5s for refill
+        assert time.monotonic() - start > 0.25
+
+    def test_refund_on_short_read(self):
+        bucket = TokenBucket(MIN_RATE)
+        stream = RateLimitedStream(io.BytesIO(b"abc"), bucket)
+        assert stream.read(MIN_RATE) == b"abc"
+        # Tokens were refunded: an immediate second read shouldn't block long.
+        start = time.monotonic()
+        assert stream.read(MIN_RATE) == b""
+        assert time.monotonic() - start < 0.5
+
+    def test_rate_floor(self):
+        with pytest.raises(ValueError):
+            TokenBucket(MIN_RATE - 1)
+
+
+class TestRsmConfig:
+    BASE = {
+        "storage.backend.class": "tieredstorage_tpu.storage.memory.InMemoryStorage",
+        "chunk.size": 4 * 1024 * 1024,
+    }
+
+    def test_minimal(self):
+        c = RemoteStorageManagerConfig(self.BASE)
+        assert c.chunk_size == 4 * 1024 * 1024
+        assert c.storage_backend_class.__name__ == "InMemoryStorage"
+        assert not c.compression_enabled and not c.encryption_enabled
+
+    def test_missing_required(self):
+        with pytest.raises(ConfigException, match="chunk.size"):
+            RemoteStorageManagerConfig({"storage.backend.class": self.BASE["storage.backend.class"]})
+
+    def test_chunk_size_bounds(self):
+        with pytest.raises(ConfigException):
+            RemoteStorageManagerConfig({**self.BASE, "chunk.size": 0})
+        with pytest.raises(ConfigException):
+            RemoteStorageManagerConfig({**self.BASE, "chunk.size": 2**31})
+
+    def test_heuristic_requires_compression(self):
+        with pytest.raises(ConfigException, match="compression.enabled"):
+            RemoteStorageManagerConfig({**self.BASE, "compression.heuristic.enabled": True})
+
+    def test_encryption_requires_keyring(self):
+        with pytest.raises(ConfigException, match="key.pair.id"):
+            RemoteStorageManagerConfig({**self.BASE, "encryption.enabled": True})
+
+    def test_key_pair_paths_two_phase(self):
+        with pytest.raises(ConfigException, match="key1"):
+            RemoteStorageManagerConfig({
+                **self.BASE,
+                "encryption.enabled": True,
+                "encryption.key.pair.id": "key1",
+                "encryption.key.pairs": "key1",
+            })
+
+    def test_rate_limit_floor(self):
+        with pytest.raises(ConfigException):
+            RemoteStorageManagerConfig({**self.BASE, "upload.rate.limit.bytes.per.second": 1024})
+        c = RemoteStorageManagerConfig(
+            {**self.BASE, "upload.rate.limit.bytes.per.second": 2 * 1024 * 1024}
+        )
+        assert c.upload_rate_limit == 2 * 1024 * 1024
+
+    def test_storage_prefix_routing(self):
+        c = RemoteStorageManagerConfig({**self.BASE, "storage.root": "/tmp/x", "storage.a.b": 1})
+        # Like the reference (originalsWithPrefix), backend.class passes through.
+        assert c.storage_configs() == {
+            "root": "/tmp/x",
+            "a.b": 1,
+            "backend.class": self.BASE["storage.backend.class"],
+        }

@@ -1,0 +1,11 @@
+"""Typed configuration (reference cross-cutting config layer).
+
+Reference: core/src/main/java/io/aiven/kafka/tieredstorage/config/ — Kafka
+ConfigDef-style typed keys with defaults, validators, docstrings (used for
+docs generation), and prefix-scoped nesting.
+"""
+
+from tieredstorage_tpu.config.configdef import ConfigDef, ConfigException, ConfigKey
+from tieredstorage_tpu.config.rsm_config import RemoteStorageManagerConfig
+
+__all__ = ["ConfigDef", "ConfigException", "ConfigKey", "RemoteStorageManagerConfig"]

@@ -1,0 +1,226 @@
+"""RemoteStorageManager configuration schema.
+
+Reference: core/.../config/RemoteStorageManagerConfig.java — keys (under the
+broker's `rsm.config.` prefix, already stripped by the broker): required
+`storage.backend.class` and `chunk.size` (1..Int.MAX/2, the encryption
+overflow guard :126-127), compression flags with the heuristic-implies-enabled
+cross check (:308-313), encryption keyring with two-phase dynamic define
+(:232-277), metrics settings, custom-metadata field subset, upload rate limit
+(>= 1 MiB/s floor :186-194), and prefix routing (`storage.*`,
+`fetch.*.cache.*` :44-46, 315-320). This build adds `transform.backend.class`
+at the same seam.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Optional
+
+from tieredstorage_tpu.config.configdef import (
+    ConfigDef,
+    ConfigException,
+    ConfigKey,
+    in_range,
+    non_empty_string,
+    subset_with_prefix,
+)
+
+INT_MAX = 2**31 - 1
+
+STORAGE_PREFIX = "storage."
+TRANSFORM_PREFIX = "transform."
+FETCH_CHUNK_CACHE_PREFIX = "fetch.chunk.cache."
+FETCH_INDEXES_CACHE_PREFIX = "fetch.indexes.cache."
+FETCH_MANIFEST_CACHE_PREFIX = "fetch.manifest.cache."
+
+
+def _base_def() -> ConfigDef:
+    d = ConfigDef()
+    d.define(ConfigKey(
+        "storage.backend.class", "class", importance="high",
+        doc="The storage backend implementation class.",
+    ))
+    d.define(ConfigKey(
+        "transform.backend.class", "class",
+        default="tieredstorage_tpu.transform.cpu.CpuTransformBackend",
+        importance="high",
+        doc="The transform backend implementation class (CPU zstd+AES pipeline "
+            "or the batched TPU backend).",
+    ))
+    d.define(ConfigKey(
+        "key.prefix", "string", default="", validator=None, importance="high",
+        doc="The object storage path prefix.",
+    ))
+    d.define(ConfigKey(
+        "key.prefix.mask", "bool", default=False, importance="low",
+        doc="Whether to mask the prefix in logs.",
+    ))
+    d.define(ConfigKey(
+        "chunk.size", "int", validator=in_range(1, INT_MAX // 2), importance="high",
+        doc="Segment files are chunked into chunks of this size, transformed "
+            "chunk-wise, and range-fetched chunk-wise.",
+    ))
+    d.define(ConfigKey(
+        "compression.enabled", "bool", default=False, importance="high",
+        doc="Whether to compress chunks before storing.",
+    ))
+    d.define(ConfigKey(
+        "compression.heuristic.enabled", "bool", default=False, importance="high",
+        doc="Only compress segments whose first record batch is not already "
+            "compressed (requires compression.enabled).",
+    ))
+    d.define(ConfigKey(
+        "compression.codec", "string", default="zstd", importance="medium",
+        doc="Compression codec id recorded in the manifest: 'zstd' "
+            "(reference-compatible) or a TPU-native codec id.",
+    ))
+    d.define(ConfigKey(
+        "encryption.enabled", "bool", default=False, importance="high",
+        doc="Whether to encrypt chunks with per-segment AES-256-GCM data keys.",
+    ))
+    d.define(ConfigKey(
+        "encryption.key.pair.id", "string", default=None, validator=non_empty_string,
+        importance="high",
+        doc="The active RSA key-encryption-key pair id.",
+    ))
+    d.define(ConfigKey(
+        "encryption.key.pairs", "list", default=[], importance="high",
+        doc="The list of RSA key pair ids in the keyring.",
+    ))
+    d.define(ConfigKey(
+        "upload.rate.limit.bytes.per.second", "int", default=None,
+        validator=lambda n, v: in_range(1024 * 1024, INT_MAX)(n, v) if v is not None else None,
+        importance="medium",
+        doc="Upper bound on segment upload bytes/s per manager instance.",
+    ))
+    d.define(ConfigKey(
+        "custom.metadata.fields.include", "list", default=[], importance="low",
+        doc="Custom metadata fields to persist with the broker "
+            "(REMOTE_SIZE, OBJECT_PREFIX, OBJECT_KEY).",
+    ))
+    d.define(ConfigKey(
+        "metrics.num.samples", "int", default=2, validator=in_range(1, None), importance="low",
+        doc="Number of samples for metrics computation.",
+    ))
+    d.define(ConfigKey(
+        "metrics.sample.window.ms", "long", default=30_000, validator=in_range(1, None),
+        importance="low", doc="Metrics sample window.",
+    ))
+    d.define(ConfigKey(
+        "metrics.recording.level", "string", default="INFO", importance="low",
+        doc="Metrics recording level (INFO, DEBUG).",
+    ))
+    return d
+
+
+class RemoteStorageManagerConfig:
+    def __init__(self, props: Mapping[str, Any]):
+        self._props = dict(props)
+        self._values = _base_def().parse(props)
+        self._validate_cross_keys()
+        self._key_pair_paths = self._parse_key_pairs()
+
+    def _validate_cross_keys(self) -> None:
+        if self.compression_heuristic_enabled and not self.compression_enabled:
+            # Reference: RemoteStorageManagerConfig.java:308-313.
+            raise ConfigException(
+                "compression.enabled must be enabled if compression.heuristic.enabled is"
+            )
+        if self.encryption_enabled:
+            if not self._values["encryption.key.pair.id"]:
+                raise ConfigException(
+                    "encryption.key.pair.id must be provided if encryption is enabled"
+                )
+            if not self._values["encryption.key.pairs"]:
+                raise ConfigException(
+                    "encryption.key.pairs must be provided if encryption is enabled"
+                )
+
+    def _parse_key_pairs(self) -> dict[str, tuple[str, str]]:
+        """Two-phase dynamic define (reference :232-277): each id in
+        `encryption.key.pairs` requires `encryption.key.pairs.<id>.public.key.file`
+        and `...private.key.file`."""
+        if not self.encryption_enabled:
+            return {}
+        paths: dict[str, tuple[str, str]] = {}
+        for key_id in self._values["encryption.key.pairs"]:
+            pub = self._props.get(f"encryption.key.pairs.{key_id}.public.key.file")
+            priv = self._props.get(f"encryption.key.pairs.{key_id}.private.key.file")
+            if not pub or not priv:
+                raise ConfigException(
+                    f"Both public and private key files must be provided for key pair {key_id!r}"
+                )
+            paths[key_id] = (str(pub), str(priv))
+        active = self._values["encryption.key.pair.id"]
+        if active not in paths:
+            raise ConfigException(
+                f"Encryption key {active!r} must be provided in encryption.key.pairs"
+            )
+        return paths
+
+    # --- accessors ---
+    @property
+    def storage_backend_class(self) -> type:
+        return self._values["storage.backend.class"]
+
+    def storage_configs(self) -> dict[str, Any]:
+        return subset_with_prefix(self._props, STORAGE_PREFIX)
+
+    @property
+    def transform_backend_class(self) -> type:
+        return self._values["transform.backend.class"]
+
+    def transform_configs(self) -> dict[str, Any]:
+        return subset_with_prefix(self._props, TRANSFORM_PREFIX)
+
+    @property
+    def key_prefix(self) -> str:
+        return self._values["key.prefix"]
+
+    @property
+    def key_prefix_mask(self) -> bool:
+        return self._values["key.prefix.mask"]
+
+    @property
+    def chunk_size(self) -> int:
+        return self._values["chunk.size"]
+
+    @property
+    def compression_enabled(self) -> bool:
+        return self._values["compression.enabled"]
+
+    @property
+    def compression_heuristic_enabled(self) -> bool:
+        return self._values["compression.heuristic.enabled"]
+
+    @property
+    def compression_codec(self) -> str:
+        return self._values["compression.codec"]
+
+    @property
+    def encryption_enabled(self) -> bool:
+        return self._values["encryption.enabled"]
+
+    @property
+    def encryption_key_pair_id(self) -> Optional[str]:
+        return self._values["encryption.key.pair.id"]
+
+    @property
+    def encryption_key_pair_paths(self) -> dict[str, tuple[str, str]]:
+        return dict(self._key_pair_paths)
+
+    @property
+    def upload_rate_limit(self) -> Optional[int]:
+        return self._values["upload.rate.limit.bytes.per.second"]
+
+    @property
+    def custom_metadata_fields_include(self) -> list[str]:
+        return self._values["custom.metadata.fields.include"]
+
+    def fetch_chunk_cache_configs(self) -> dict[str, Any]:
+        return subset_with_prefix(self._props, FETCH_CHUNK_CACHE_PREFIX)
+
+    def fetch_indexes_cache_configs(self) -> dict[str, Any]:
+        return subset_with_prefix(self._props, FETCH_INDEXES_CACHE_PREFIX)
+
+    def fetch_manifest_cache_configs(self) -> dict[str, Any]:
+        return subset_with_prefix(self._props, FETCH_MANIFEST_CACHE_PREFIX)

@@ -1,0 +1,9 @@
+"""Fetch path (reference L3): chunk manager, range enumeration, caches.
+
+Reference: core/src/main/java/io/aiven/kafka/tieredstorage/fetch/.
+"""
+
+from tieredstorage_tpu.fetch.chunk_manager import ChunkManager, DefaultChunkManager
+from tieredstorage_tpu.fetch.enumeration import FetchChunkEnumeration
+
+__all__ = ["ChunkManager", "DefaultChunkManager", "FetchChunkEnumeration"]

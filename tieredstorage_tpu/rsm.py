@@ -1,0 +1,394 @@
+"""RemoteStorageManager: the KIP-405-shaped orchestration layer (reference L1).
+
+Reference: core/src/main/java/io/aiven/kafka/tieredstorage/RemoteStorageManager.java —
+configure wires every component (:143-182), copyLogSegmentData uploads the
+transformed segment + concatenated indexes + manifest triple (:212-278),
+fetchLogSegment serves ranged reads through the chunk path (:539-576),
+fetchIndex serves index slices (:594-622), deleteLogSegmentData removes the
+triple (:673-697), with orphan cleanup on failed uploads (:258-267).
+
+The transform itself runs through the batched TransformBackend seam instead of
+the reference's per-chunk Enumeration chain.
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+import time
+from pathlib import Path
+from typing import BinaryIO, Mapping, Optional
+
+from tieredstorage_tpu.config.rsm_config import RemoteStorageManagerConfig
+from tieredstorage_tpu.custom_metadata import (
+    SegmentCustomMetadataBuilder,
+    SegmentCustomMetadataField,
+    deserialize_custom_metadata,
+    serialize_custom_metadata,
+)
+from tieredstorage_tpu.errors import RemoteResourceNotFoundException, RemoteStorageException
+from tieredstorage_tpu.fetch.chunk_manager import ChunkManager, DefaultChunkManager
+from tieredstorage_tpu.fetch.enumeration import FetchChunkEnumeration
+from tieredstorage_tpu.kafka_records import InvalidRecordBatchException, segment_looks_compressed
+from tieredstorage_tpu.manifest.encryption_metadata import SegmentEncryptionMetadataV1
+from tieredstorage_tpu.manifest.segment_indexes import IndexType, SegmentIndexesV1Builder
+from tieredstorage_tpu.manifest.segment_manifest import (
+    SegmentManifestV1,
+    manifest_from_json,
+    manifest_to_json,
+)
+from tieredstorage_tpu.metadata import LogSegmentData, RemoteLogSegmentMetadata
+from tieredstorage_tpu.object_key import ObjectKeyFactory, Suffix
+from tieredstorage_tpu.security.aes import AesEncryptionProvider, DataKeyAndAAD
+from tieredstorage_tpu.security.rsa import RsaEncryptionProvider
+from tieredstorage_tpu.storage.core import (
+    BytesRange,
+    KeyNotFoundException,
+    ObjectKey,
+    StorageBackend,
+    StorageBackendException,
+)
+from tieredstorage_tpu.transform.api import DetransformOptions, TransformOptions
+from tieredstorage_tpu.transform.pipeline import SegmentTransformation
+from tieredstorage_tpu.utils.ratelimit import RateLimitedStream, TokenBucket
+from tieredstorage_tpu.utils.streams import ClosableStreamHolder
+
+log = logging.getLogger(__name__)
+
+
+class RemoteStorageManager:
+    """Configure once, then copy/fetch/delete segments concurrently."""
+
+    def __init__(self) -> None:
+        self._config: Optional[RemoteStorageManagerConfig] = None
+        self._storage: Optional[StorageBackend] = None
+        self._transform_backend = None
+        self._object_key_factory: Optional[ObjectKeyFactory] = None
+        self._rsa: Optional[RsaEncryptionProvider] = None
+        self._rate_bucket: Optional[TokenBucket] = None
+        self._chunk_manager: Optional[ChunkManager] = None
+        self._metrics = None
+
+    # ------------------------------------------------------------------ setup
+    def configure(self, configs: Mapping[str, object]) -> None:
+        config = RemoteStorageManagerConfig(configs)
+        self._config = config
+
+        storage = config.storage_backend_class()
+        storage.configure(config.storage_configs())
+        self._storage = storage
+
+        backend = config.transform_backend_class()
+        backend.configure(config.transform_configs())
+        self._transform_backend = backend
+
+        self._object_key_factory = ObjectKeyFactory(config.key_prefix, config.key_prefix_mask)
+
+        if config.encryption_enabled:
+            self._rsa = RsaEncryptionProvider.from_pem_files(
+                config.encryption_key_pair_id, config.encryption_key_pair_paths
+            )
+
+        if config.upload_rate_limit is not None:
+            self._rate_bucket = TokenBucket(config.upload_rate_limit)
+
+        self._chunk_manager = self._build_chunk_manager(backend)
+
+    def _build_chunk_manager(self, backend) -> ChunkManager:
+        return DefaultChunkManager(self._storage, backend)
+
+    def _require_configured(self) -> RemoteStorageManagerConfig:
+        if self._config is None:
+            raise RemoteStorageException("RemoteStorageManager is not configured")
+        return self._config
+
+    # ----------------------------------------------------------------- upload
+    def copy_log_segment_data(
+        self, metadata: RemoteLogSegmentMetadata, segment_data: LogSegmentData
+    ) -> Optional[bytes]:
+        """Uploads `.log`, `.indexes`, `.rsm-manifest`; returns custom metadata
+        bytes (or None if no fields configured)."""
+        config = self._require_configured()
+        start = time.monotonic()
+        log.debug("Copying log segment data: %s", metadata)
+
+        requires_compression = self._requires_compression(segment_data)
+        data_key: Optional[DataKeyAndAAD] = None
+        if config.encryption_enabled:
+            data_key = AesEncryptionProvider.create_data_key_and_aad()
+
+        include = [
+            SegmentCustomMetadataField[name]
+            for name in config.custom_metadata_fields_include
+        ]
+        custom_builder = SegmentCustomMetadataBuilder(
+            include, self._object_key_factory.prefix, metadata
+        )
+
+        uploaded_keys: list[ObjectKey] = []
+        try:
+            chunk_index = self._upload_segment_log(
+                metadata, segment_data, requires_compression, data_key,
+                custom_builder, uploaded_keys,
+            )
+            segment_indexes = self._upload_indexes(
+                metadata, segment_data, data_key, custom_builder, uploaded_keys
+            )
+            self._upload_manifest(
+                metadata, chunk_index, segment_indexes, requires_compression,
+                data_key, custom_builder, uploaded_keys,
+            )
+        except Exception as e:
+            # Orphan cleanup: a failed copy must not leave partial objects
+            # (reference :258-267); the broker will retry the whole copy.
+            try:
+                self._delete_keys(uploaded_keys)
+            except Exception:
+                log.warning("Failed to clean up partial upload for %s", metadata, exc_info=True)
+            if isinstance(e, RemoteStorageException):
+                raise
+            raise RemoteStorageException(f"Failed to copy segment {metadata}") from e
+
+        log.debug(
+            "Copied %s in %.3fs", metadata, time.monotonic() - start
+        )
+        if not include:
+            return None
+        return serialize_custom_metadata(custom_builder.build())
+
+    def _requires_compression(self, segment_data: LogSegmentData) -> bool:
+        config = self._require_configured()
+        if not config.compression_enabled:
+            return False
+        if not config.compression_heuristic_enabled:
+            return True
+        try:
+            return not segment_looks_compressed(segment_data.log_segment)
+        except InvalidRecordBatchException:
+            log.warning(
+                "Failed to check compression on log segment: %s", segment_data.log_segment,
+                exc_info=True,
+            )
+            return False
+
+    def _transform_opts(
+        self, requires_compression: bool, data_key: Optional[DataKeyAndAAD]
+    ) -> TransformOptions:
+        config = self._require_configured()
+        return TransformOptions(
+            compression=requires_compression,
+            compression_codec=config.compression_codec,
+            encryption=data_key,
+        )
+
+    def _upload_segment_log(
+        self, metadata, segment_data, requires_compression, data_key,
+        custom_builder, uploaded_keys,
+    ):
+        config = self._config
+        key = self._object_key_factory.key(metadata, Suffix.LOG)
+        file_size = Path(segment_data.log_segment).stat().st_size
+        with open(segment_data.log_segment, "rb") as source:
+            transformation = SegmentTransformation(
+                source, file_size, config.chunk_size,
+                self._transform_backend,
+                self._transform_opts(requires_compression, data_key),
+            )
+            stream: BinaryIO = transformation.stream()
+            if self._rate_bucket is not None:
+                stream = RateLimitedStream(stream, self._rate_bucket)
+            uploaded_keys.append(key)
+            uploaded = self._storage.upload(stream, key)
+        custom_builder.add_upload_result(Suffix.LOG, uploaded)
+        log.debug("Uploaded segment log for %s, size: %d", metadata, uploaded)
+        return transformation.chunk_index
+
+    def _upload_indexes(
+        self, metadata, segment_data: LogSegmentData, data_key, custom_builder, uploaded_keys
+    ):
+        """Each index is transformed as a single chunk (encrypt-only), then all
+        are concatenated into one `.indexes` object (reference :287-354,
+        transformIndex :455-490; empty indexes record size 0 and upload no
+        bytes)."""
+        builder = SegmentIndexesV1Builder()
+        parts: list[bytes] = []
+
+        def transform_one(index_type: IndexType, stream: BinaryIO, size: int) -> None:
+            if size > 0:
+                tr = SegmentTransformation(
+                    stream, size, self._config.chunk_size,
+                    self._transform_backend,
+                    self._transform_opts(False, data_key),
+                    chunking_disabled=True,
+                )
+                blob = tr.stream().read()
+                parts.append(blob)
+                builder.add(index_type, len(blob))
+            else:
+                builder.add(index_type, 0)
+
+        with ClosableStreamHolder() as holder:
+            for index_type, path in (
+                (IndexType.OFFSET, segment_data.offset_index),
+                (IndexType.TIMESTAMP, segment_data.time_index),
+                (IndexType.PRODUCER_SNAPSHOT, segment_data.producer_snapshot_index),
+            ):
+                size = Path(path).stat().st_size
+                transform_one(index_type, holder.add(open(path, "rb")), size)
+            transform_one(
+                IndexType.LEADER_EPOCH,
+                io.BytesIO(segment_data.leader_epoch_index),
+                len(segment_data.leader_epoch_index),
+            )
+            if segment_data.transaction_index is not None:
+                size = Path(segment_data.transaction_index).stat().st_size
+                transform_one(
+                    IndexType.TRANSACTION,
+                    holder.add(open(segment_data.transaction_index, "rb")),
+                    size,
+                )
+
+        key = self._object_key_factory.key(metadata, Suffix.INDEXES)
+        uploaded_keys.append(key)
+        uploaded = self._storage.upload(io.BytesIO(b"".join(parts)), key)
+        custom_builder.add_upload_result(Suffix.INDEXES, uploaded)
+        log.debug("Uploaded indexes file for %s, size: %d", metadata, uploaded)
+        return builder.build()
+
+    def _upload_manifest(
+        self, metadata, chunk_index, segment_indexes, requires_compression,
+        data_key, custom_builder, uploaded_keys,
+    ) -> None:
+        config = self._config
+        encryption_metadata = None
+        encoder = None
+        if data_key is not None:
+            encryption_metadata = SegmentEncryptionMetadataV1(data_key.data_key, data_key.aad)
+            encoder = self._rsa.data_key_encoder
+        manifest = SegmentManifestV1(
+            chunk_index=chunk_index,
+            segment_indexes=segment_indexes,
+            compression=requires_compression,
+            encryption=encryption_metadata,
+            remote_log_segment_metadata=metadata,
+            compression_codec=config.compression_codec if requires_compression else None,
+        )
+        text = manifest_to_json(manifest, data_key_encoder=encoder)
+        key = self._object_key_factory.key(metadata, Suffix.MANIFEST)
+        uploaded_keys.append(key)
+        uploaded = self._storage.upload(io.BytesIO(text.encode("utf-8")), key)
+        custom_builder.add_upload_result(Suffix.MANIFEST, uploaded)
+        log.debug("Uploaded segment manifest for %s, size: %d", metadata, uploaded)
+
+    # ------------------------------------------------------------------ fetch
+    def _object_key(self, metadata: RemoteLogSegmentMetadata, suffix: Suffix) -> ObjectKey:
+        """Custom metadata (if stored) overrides prefix/key so fetches survive
+        `key.prefix` changes (reference :654-665)."""
+        fields = deserialize_custom_metadata(metadata.custom_metadata)
+        if fields:
+            return self._object_key_factory.key_from_fields(fields, metadata, suffix)
+        return self._object_key_factory.key(metadata, suffix)
+
+    def fetch_segment_manifest(self, metadata: RemoteLogSegmentMetadata) -> SegmentManifestV1:
+        key = self._object_key(metadata, Suffix.MANIFEST)
+        return self._fetch_manifest_by_key(key)
+
+    def _fetch_manifest_by_key(self, key: ObjectKey) -> SegmentManifestV1:
+        try:
+            with self._storage.fetch(key) as stream:
+                text = stream.read()
+        except KeyNotFoundException as e:
+            raise RemoteResourceNotFoundException(str(e)) from e
+        decoder = self._rsa.data_key_decoder if self._rsa is not None else None
+        return manifest_from_json(text, data_key_decoder=decoder)
+
+    def fetch_log_segment(
+        self,
+        metadata: RemoteLogSegmentMetadata,
+        start_position: int,
+        end_position: Optional[int] = None,
+    ) -> BinaryIO:
+        config = self._require_configured()
+        if start_position < 0:
+            raise ValueError(f"startPosition must be non-negative, {start_position} given")
+        if end_position is not None and end_position < start_position:
+            raise ValueError(
+                f"endPosition {end_position} must be >= startPosition {start_position}"
+            )
+        try:
+            manifest = self.fetch_segment_manifest(metadata)
+            file_size = manifest.chunk_index.original_file_size
+            if start_position >= file_size:
+                raise InvalidStartPosition(
+                    f"Start position {start_position} is outside segment of size {file_size}"
+                )
+            effective_end = min(
+                end_position if end_position is not None else file_size - 1,
+                file_size - 1,
+            )
+            byte_range = BytesRange.of(start_position, effective_end)
+            key = self._object_key(metadata, Suffix.LOG)
+            return FetchChunkEnumeration(
+                self._chunk_manager, key, manifest, byte_range
+            ).to_stream()
+        except (RemoteStorageException, InvalidStartPosition):
+            raise
+        except KeyNotFoundException as e:
+            raise RemoteResourceNotFoundException(str(e)) from e
+        except StorageBackendException as e:
+            raise RemoteStorageException(str(e)) from e
+
+    def fetch_index(self, metadata: RemoteLogSegmentMetadata, index_type: IndexType) -> BinaryIO:
+        self._require_configured()
+        try:
+            manifest = self.fetch_segment_manifest(metadata)
+            segment_index = manifest.segment_indexes.segment_index(index_type)
+            if segment_index is None:
+                raise RemoteResourceNotFoundException(
+                    f"Index {index_type.name} not found on {self._object_key(metadata, Suffix.INDEXES)}"
+                )
+            if segment_index.size == 0:
+                return io.BytesIO(b"")
+            key = self._object_key(metadata, Suffix.INDEXES)
+            return io.BytesIO(self._fetch_index_bytes(key, segment_index.range(), manifest))
+        except KeyNotFoundException as e:
+            raise RemoteResourceNotFoundException(str(e)) from e
+        except StorageBackendException as e:
+            raise RemoteStorageException(str(e)) from e
+
+    def _fetch_index_bytes(
+        self, key: ObjectKey, byte_range: BytesRange, manifest: SegmentManifestV1
+    ) -> bytes:
+        with self._storage.fetch(key, byte_range) as stream:
+            blob = stream.read()
+        opts = DetransformOptions(
+            compression=False,
+            encryption=(
+                DataKeyAndAAD(manifest.encryption.data_key, manifest.encryption.aad)
+                if manifest.encryption is not None
+                else None
+            ),
+        )
+        return self._transform_backend.detransform([blob], opts)[0]
+
+    # ----------------------------------------------------------------- delete
+    def delete_log_segment_data(self, metadata: RemoteLogSegmentMetadata) -> None:
+        self._require_configured()
+        log.debug("Deleting log segment data for %s", metadata)
+        try:
+            keys = [self._object_key(metadata, s) for s in Suffix]
+            self._delete_keys(keys)
+        except StorageBackendException as e:
+            raise RemoteStorageException(f"Failed to delete {metadata}") from e
+
+    def _delete_keys(self, keys: list[ObjectKey]) -> None:
+        if self._storage is not None and keys:
+            self._storage.delete_all(keys)
+
+    def close(self) -> None:
+        if self._transform_backend is not None:
+            self._transform_backend.close()
+
+
+class InvalidStartPosition(RemoteStorageException):
+    """Requested fetch start beyond segment size."""
