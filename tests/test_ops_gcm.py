@@ -1,0 +1,133 @@
+"""Kernel correctness: AES block cipher vs FIPS-197, GF(2^128) math, and the
+batched GCM path vs the `cryptography` oracle."""
+
+from __future__ import annotations
+
+import os
+import secrets
+
+import numpy as np
+import pytest
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+from tieredstorage_tpu.ops import gf128
+from tieredstorage_tpu.ops.aes import (
+    SBOX,
+    aes_decrypt_blocks,
+    aes_encrypt_blocks,
+    key_expansion,
+)
+from tieredstorage_tpu.ops.gcm import gcm_decrypt_chunks, gcm_encrypt_chunks, make_context
+
+import jax.numpy as jnp
+
+
+class TestAesBlock:
+    def test_sbox_known_entries(self):
+        # FIPS-197 Figure 7 spot values.
+        assert SBOX[0x00] == 0x63
+        assert SBOX[0x01] == 0x7C
+        assert SBOX[0x53] == 0xED
+        assert SBOX[0xFF] == 0x16
+
+    def test_fips197_aes256_vector(self):
+        # FIPS-197 Appendix C.3.
+        key = bytes(range(32))
+        pt = bytes.fromhex("00112233445566778899aabbccddeeff")
+        expected = bytes.fromhex("8ea2b7ca516745bfeafc49904b496089")
+        rk = jnp.asarray(key_expansion(key))
+        ct = np.asarray(aes_encrypt_blocks(rk, jnp.frombuffer(pt, dtype=np.uint8)[None, :]))
+        assert ct.tobytes() == expected
+        back = np.asarray(aes_decrypt_blocks(rk, jnp.asarray(ct)))
+        assert back.tobytes() == pt
+
+    def test_batch_matches_singles(self):
+        key = secrets.token_bytes(32)
+        rk = jnp.asarray(key_expansion(key))
+        blocks = np.frombuffer(secrets.token_bytes(16 * 7), dtype=np.uint8).reshape(7, 16)
+        batch_out = np.asarray(aes_encrypt_blocks(rk, jnp.asarray(blocks)))
+        for i in range(7):
+            single = np.asarray(aes_encrypt_blocks(rk, jnp.asarray(blocks[i : i + 1])))
+            assert (batch_out[i] == single[0]).all()
+
+
+class TestGf128:
+    def test_identity(self):
+        one = 1 << 127
+        x = int.from_bytes(secrets.token_bytes(16), "big")
+        assert gf128.gcm_mult(x, one) == x
+        assert gf128.gcm_mult(one, x) == x
+
+    def test_commutative(self):
+        a = int.from_bytes(secrets.token_bytes(16), "big")
+        b = int.from_bytes(secrets.token_bytes(16), "big")
+        assert gf128.gcm_mult(a, b) == gf128.gcm_mult(b, a)
+
+    def test_pow(self):
+        h = int.from_bytes(secrets.token_bytes(16), "big")
+        assert gf128.gcm_pow(h, 0) == 1 << 127
+        assert gf128.gcm_pow(h, 1) == h
+        assert gf128.gcm_pow(h, 3) == gf128.gcm_mult(gf128.gcm_mult(h, h), h)
+
+    def test_mult_matrix_matches_mult(self):
+        c = int.from_bytes(secrets.token_bytes(16), "big")
+        m = gf128.mult_matrix(c)
+        for _ in range(5):
+            a = int.from_bytes(secrets.token_bytes(16), "big")
+            expected = gf128.gcm_mult(a, c)
+            got_bits = (m @ gf128.int_to_bitvec(a)) % 2
+            assert gf128.bitvec_to_int(got_bits) == expected
+
+    def test_bitvec_round_trip(self):
+        v = int.from_bytes(secrets.token_bytes(16), "big")
+        assert gf128.bitvec_to_int(gf128.int_to_bitvec(v)) == v
+
+
+@pytest.mark.parametrize("chunk_bytes", [16, 48, 1000, 4096, 65536 + 8])
+@pytest.mark.parametrize("batch", [1, 3])
+class TestGcmVsOracle:
+    def test_encrypt_matches_cryptography(self, chunk_bytes, batch):
+        key = secrets.token_bytes(32)
+        aad = secrets.token_bytes(32)
+        ctx = make_context(key, aad, chunk_bytes)
+        ivs = np.frombuffer(secrets.token_bytes(12 * batch), dtype=np.uint8).reshape(batch, 12)
+        pt = np.frombuffer(secrets.token_bytes(chunk_bytes * batch), dtype=np.uint8).reshape(
+            batch, chunk_bytes
+        )
+        ct, tags = gcm_encrypt_chunks(ctx, ivs, pt)
+        ct, tags = np.asarray(ct), np.asarray(tags)
+        oracle = AESGCM(key)
+        for i in range(batch):
+            expected = oracle.encrypt(ivs[i].tobytes(), pt[i].tobytes(), aad)
+            assert ct[i].tobytes() == expected[:-16], f"ciphertext mismatch row {i}"
+            assert tags[i].tobytes() == expected[-16:], f"tag mismatch row {i}"
+
+    def test_decrypt_round_trip_and_tag(self, chunk_bytes, batch):
+        key = secrets.token_bytes(32)
+        aad = secrets.token_bytes(32)
+        ctx = make_context(key, aad, chunk_bytes)
+        ivs = np.frombuffer(secrets.token_bytes(12 * batch), dtype=np.uint8).reshape(batch, 12)
+        pt = np.frombuffer(secrets.token_bytes(chunk_bytes * batch), dtype=np.uint8).reshape(
+            batch, chunk_bytes
+        )
+        ct, tags = gcm_encrypt_chunks(ctx, ivs, pt)
+        back, expected_tags = gcm_decrypt_chunks(ctx, ivs, np.asarray(ct))
+        assert (np.asarray(back) == pt).all()
+        assert (np.asarray(expected_tags) == np.asarray(tags)).all()
+        # Tamper: expected tag diverges.
+        bad = np.array(ct)
+        bad[0, 0] ^= 0xFF
+        _, tampered_tags = gcm_decrypt_chunks(ctx, ivs, bad)
+        assert (np.asarray(tampered_tags)[0] != np.asarray(tags)[0]).any()
+
+
+def test_empty_aad_and_offsets():
+    # AAD-free GCM also matches (len(A)=0 path through the folded constant).
+    key = secrets.token_bytes(32)
+    ctx = make_context(key, b"", 1024)
+    iv = np.frombuffer(secrets.token_bytes(12), dtype=np.uint8).reshape(1, 12)
+    pt = np.frombuffer(secrets.token_bytes(1024), dtype=np.uint8).reshape(1, 1024)
+    ct, tags = gcm_encrypt_chunks(ctx, iv, pt)
+    expected = AESGCM(key).encrypt(iv[0].tobytes(), pt[0].tobytes(), None)
+    assert np.asarray(ct)[0].tobytes() == expected[:-16]
+    assert np.asarray(tags)[0].tobytes() == expected[-16:]

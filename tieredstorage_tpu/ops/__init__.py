@@ -1,0 +1,11 @@
+"""TPU kernels (JAX/XLA; Pallas where beneficial) for the transform hot path.
+
+These replace the native libraries the reference's transform pipeline
+delegates to (zstd-jni and JDK AES-GCM intrinsics; see SURVEY.md §2.2):
+
+- ops.aes    — AES-256 key schedule (host) + vectorized cipher/CTR (device)
+- ops.gf128  — host-side GF(2^128) math: GHASH constants as GF(2) bit
+               matrices so the device-side reduction runs on the MXU
+- ops.gcm    — batched AES-256-GCM over uint8[batch, chunk_size] arrays
+- ops.crc32c — CRC32C as a GF(2) linear-map tree (MXU)
+"""

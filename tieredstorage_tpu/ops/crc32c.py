@@ -1,0 +1,179 @@
+"""CRC32C (Castagnoli) as a GF(2) linear-map tree on the MXU.
+
+CRC with init=0/xorout=0 ("crc0") is linear over GF(2) in the message bits,
+so per-16-byte-block contributions are a 32x128 bit matrix, and combining a
+left span with a right span of k bytes is `Z^k(left) ^ right` where Z is the
+32x32 zero-byte state-evolution matrix. A log-tree with per-level matrices
+(Z^(16*2^j), squared host-side) reduces a whole chunk batch with int8 matmuls
+mod 2 — the same machinery as the GHASH kernel (ops/gcm.py).
+
+The standard CRC32C (init 0xFFFFFFFF, xorout 0xFFFFFFFF) is recovered with a
+length-dependent affine offset: crc(M) = crc0(M) ^ crc(0^len), the latter
+computed host-side in O(log len) matrix powers. Used for integrity accounting
+of transformed chunks (the reference has no integrity checksum of its own —
+it relies on the object stores' checksums; this is an extension that the
+manifest can carry per chunk).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_POLY_REFLECTED = 0x82F63B78
+
+
+def crc32c_reference(data: bytes, init: int = 0xFFFFFFFF, xorout: int = 0xFFFFFFFF) -> int:
+    """Bitwise software CRC32C (host oracle)."""
+    crc = init
+    for byte in data:
+        crc ^= byte
+        for _ in range(8):
+            crc = (crc >> 1) ^ (_POLY_REFLECTED if crc & 1 else 0)
+    return crc ^ xorout
+
+
+def _crc0(data: bytes) -> int:
+    return crc32c_reference(data, init=0, xorout=0)
+
+
+def _bits32(v: int) -> np.ndarray:
+    return np.frombuffer(v.to_bytes(4, "big"), dtype=np.uint8)[:, None] >> np.arange(
+        7, -1, -1, dtype=np.uint8
+    ) & 1
+
+
+def _bits32_vec(v: int) -> np.ndarray:
+    return _bits32(v).reshape(32).astype(np.uint8)
+
+
+def _vec32_to_int(bits: np.ndarray) -> int:
+    packed = np.packbits(bits.astype(np.uint8).reshape(4, 8), axis=1, bitorder="big")
+    return int.from_bytes(packed.tobytes(), "big")
+
+
+@functools.cache
+def _leaf_matrix() -> np.ndarray:
+    """uint8[32,128]: bits32(crc0(block)) = L @ bits(block), MSB-first bits."""
+    m = np.zeros((32, 128), dtype=np.uint8)
+    for bit in range(128):
+        block = bytearray(16)
+        block[bit // 8] = 0x80 >> (bit % 8)
+        m[:, bit] = _bits32_vec(_crc0(bytes(block)))
+    return m
+
+
+@functools.cache
+def _zero_byte_matrix() -> np.ndarray:
+    """uint8[32,32]: state evolution over ONE zero byte."""
+    m = np.zeros((32, 32), dtype=np.uint8)
+    for bit in range(32):
+        # Column for basis state e_bit (MSB-first indexing of the uint32),
+        # evolved through one zero byte with the bitwise step.
+        crc_val = 1 << (31 - bit)
+        for _ in range(8):
+            crc_val = (crc_val >> 1) ^ (_POLY_REFLECTED if crc_val & 1 else 0)
+        m[:, bit] = _bits32_vec(crc_val)
+    return m
+
+
+def _mat_mod2(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    return (a.astype(np.int64) @ b.astype(np.int64) % 2).astype(np.uint8)
+
+
+def _mat_pow(m: np.ndarray, e: int) -> np.ndarray:
+    result = np.eye(m.shape[0], dtype=np.uint8)
+    base = m
+    while e:
+        if e & 1:
+            result = _mat_mod2(result, base)
+        base = _mat_mod2(base, base)
+        e >>= 1
+    return result
+
+
+@functools.cache
+def _level_matrices(levels: int) -> np.ndarray:
+    """int8[levels,32,32] transposed: level j combines spans of 16*2^j bytes."""
+    z16 = _mat_pow(_zero_byte_matrix(), 16)
+    mats = np.zeros((levels, 32, 32), dtype=np.int8)
+    m = z16
+    for j in range(levels):
+        mats[j] = m.T.astype(np.int8)
+        m = _mat_mod2(m, m)
+    return mats
+
+
+@functools.cache
+def _length_offset(length: int) -> int:
+    """crc32c of `length` zero bytes, via matrix powers (O(log n))."""
+    state = _mat_pow(_zero_byte_matrix(), length) @ _bits32_vec(0xFFFFFFFF) % 2
+    return _vec32_to_int(state) ^ 0xFFFFFFFF
+
+
+_BIT_SHIFTS = jnp.arange(7, -1, -1, dtype=jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=("chunk_bytes", "levels"))
+def _crc0_batch(data: jnp.ndarray, leaf_t: jnp.ndarray, level_mats: jnp.ndarray,
+                *, chunk_bytes: int, levels: int) -> jnp.ndarray:
+    batch = data.shape[0]
+    n_blocks = chunk_bytes // 16
+    blocks = data.reshape(batch, n_blocks, 16)
+    bits = ((blocks[..., None] >> _BIT_SHIFTS) & 1).reshape(batch, n_blocks, 128)
+    vals = (
+        jax.lax.dot_general(
+            bits.astype(jnp.int8), leaf_t, (((2,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+        & 1
+    ).astype(jnp.uint8)  # [batch, n_blocks, 32]
+    # Left-pad to a power of two with zero states (crc0 of zero bytes = 0,
+    # and prepending zero bytes to the left span is the identity here
+    # because Z^k(0) = 0).
+    m_pow2 = 1 << levels
+    if m_pow2 > n_blocks:
+        vals = jnp.concatenate(
+            [jnp.zeros((batch, m_pow2 - n_blocks, 32), jnp.uint8), vals], axis=1
+        )
+    for j in range(levels):
+        pairs = vals.reshape(batch, -1, 2, 32)
+        left, right = pairs[:, :, 0, :], pairs[:, :, 1, :]
+        shifted = (
+            jax.lax.dot_general(
+                left.astype(jnp.int8), level_mats[j], (((2,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )
+            & 1
+        ).astype(jnp.uint8)
+        vals = shifted ^ right
+    return vals[:, 0, :]  # [batch, 32] bit vectors
+
+
+def crc32c_chunks(data: np.ndarray) -> np.ndarray:
+    """uint32[batch] CRC32C of each row of uint8[batch, chunk_bytes].
+
+    chunk_bytes must currently be a multiple of 16 (transformed chunks are
+    padded by the caller; arbitrary tails fold host-side if needed).
+    """
+    data = np.asarray(data, dtype=np.uint8)
+    batch, chunk_bytes = data.shape
+    if chunk_bytes % 16:
+        raise ValueError("chunk_bytes must be a multiple of 16")
+    n_blocks = chunk_bytes // 16
+    levels = max(1, (n_blocks - 1).bit_length())
+    bits = _crc0_batch(
+        jnp.asarray(data),
+        jnp.asarray(_leaf_matrix().T.astype(np.int8)),
+        jnp.asarray(_level_matrices(levels)),
+        chunk_bytes=chunk_bytes,
+        levels=levels,
+    )
+    bits = np.asarray(bits)
+    weights = (1 << np.arange(31, -1, -1, dtype=np.uint64)).astype(np.uint64)
+    crc0_vals = (bits.astype(np.uint64) * weights).sum(axis=1).astype(np.uint64)
+    # crc(M) = crc0(M) ^ crc(0^len); crc(0^len) already includes init+xorout.
+    return (crc0_vals ^ np.uint64(_length_offset(chunk_bytes))).astype(np.uint32)

@@ -1,0 +1,238 @@
+"""Batched AES-256-GCM over whole chunk arrays (the TPU transform hot path).
+
+One call encrypts/decrypts uint8[batch, chunk_bytes] with per-chunk IVs and a
+shared key+AAD (the per-segment DEK+AAD of the security layer), producing the
+same bytes as the host AES-GCM oracle:
+
+- CTR keystream: the block cipher (ops/aes.py) runs over all counter blocks
+  of the whole batch at once; counter 1 yields the tag mask E(J0), counters
+  2.. encrypt the data (NIST SP 800-38D).
+- GHASH: a log-tree reduction where level j multiplies by H^(2^j) via a
+  128x128 GF(2) bit matrix (ops/gf128.py), i.e. int8 matmuls mod 2 on the
+  MXU. Per-segment constants (AAD contribution, length block) fold into one
+  host-computed 128-bit vector.
+
+Shapes are static per (chunk_bytes, batch); the TPU transform backend keys
+its jit cache on them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tieredstorage_tpu.ops import gf128
+from tieredstorage_tpu.ops.aes import aes_encrypt_blocks, ctr_keystream, key_expansion
+
+TAG_SIZE = 16
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+@dataclasses.dataclass(frozen=True)
+class GcmContext:
+    """Host-precomputed per-(key, aad, chunk_size) constants for the kernel."""
+
+    round_keys: np.ndarray       # uint8[15,16]
+    level_mats: np.ndarray       # int8[levels,128,128] transposed mult matrices
+    final_mat: np.ndarray        # int8[128,128] transposed mult-by-H^2 matrix
+    const_bits: np.ndarray       # uint8[128] = bits(T(A)*H^(mC+2) ^ L*H)
+    chunk_bytes: int
+    n_blocks: int                # ceil(chunk_bytes/16)
+    levels: int                  # log2 of padded block count
+
+
+@functools.lru_cache(maxsize=64)
+def _context_cached(key: bytes, aad: bytes, chunk_bytes: int) -> GcmContext:
+    round_keys = key_expansion(key)
+    # H = E_K(0^128), computed with the same cipher host-side via numpy/jax cpu.
+    h_block = np.asarray(
+        aes_encrypt_blocks(jnp.asarray(round_keys), jnp.zeros((1, 16), jnp.uint8))
+    )[0]
+    h = int.from_bytes(h_block.tobytes(), "big")
+
+    m_c = _ceil_div(chunk_bytes, 16)
+    levels = max(1, (m_c - 1).bit_length())  # tree over next pow2 >= m_c
+
+    level_mats = gf128.ghash_level_matrices(h, levels)
+
+    # T(A) = sum_i A_i H^(mA-i) over the AAD blocks (zero-padded).
+    aad_blocks = [aad[i : i + 16] for i in range(0, len(aad), 16)]
+    t_a = 0
+    for i, blk in enumerate(aad_blocks):
+        power = gf128.gcm_pow(h, len(aad_blocks) - 1 - i)
+        t_a ^= gf128.gcm_mult(int.from_bytes(blk.ljust(16, b"\x00"), "big"), power)
+
+    # Length block: 64-bit bit-lengths of AAD and ciphertext.
+    len_block = int.from_bytes(
+        (len(aad) * 8).to_bytes(8, "big") + (chunk_bytes * 8).to_bytes(8, "big"), "big"
+    )
+    # GHASH(A||C||L) = T(A)*H^(mC+2) ^ T(C)*H^2 ^ L*H.
+    const = gf128.gcm_mult(t_a, gf128.gcm_pow(h, m_c + 2)) ^ gf128.gcm_mult(
+        len_block, h
+    )
+    final_mat = gf128.mult_matrix(gf128.gcm_mult(h, h))  # H^2
+
+    return GcmContext(
+        round_keys=round_keys,
+        level_mats=np.ascontiguousarray(
+            level_mats.transpose(0, 2, 1).astype(np.int8)
+        ),
+        final_mat=np.ascontiguousarray(final_mat.T.astype(np.int8)),
+        const_bits=gf128.int_to_bitvec(const),
+        chunk_bytes=chunk_bytes,
+        n_blocks=m_c,
+        levels=levels,
+    )
+
+
+def make_context(key: bytes, aad: bytes, chunk_bytes: int) -> GcmContext:
+    if len(key) != 32:
+        raise ValueError("AES-256 key required")
+    if chunk_bytes <= 0:
+        raise ValueError("chunk_bytes must be positive")
+    return _context_cached(bytes(key), bytes(aad), chunk_bytes)
+
+
+# --- device-side helpers ---
+
+_BIT_SHIFTS = jnp.arange(7, -1, -1, dtype=jnp.uint8)
+
+
+def _bytes_to_bits(x: jnp.ndarray) -> jnp.ndarray:
+    """uint8[..., n] -> uint8[..., n*8], MSB-first per byte (GCM bit order)."""
+    bits = (x[..., None] >> _BIT_SHIFTS) & 1
+    return bits.reshape(x.shape[:-1] + (x.shape[-1] * 8,))
+
+def _bits_to_bytes(bits: jnp.ndarray) -> jnp.ndarray:
+    b = bits.reshape(bits.shape[:-1] + (bits.shape[-1] // 8, 8)).astype(jnp.uint8)
+    weights = (jnp.uint8(1) << _BIT_SHIFTS).astype(jnp.uint8)
+    return (b * weights).sum(axis=-1, dtype=jnp.uint32).astype(jnp.uint8)
+
+
+def _ghash_tree(bits: jnp.ndarray, level_mats: jnp.ndarray, levels: int) -> jnp.ndarray:
+    """bits uint8[B, m, 128] (m = 2^levels) -> T(C) bits uint8[B, 128]."""
+    for j in range(levels):
+        pairs = bits.reshape(bits.shape[0], -1, 2, 128)
+        left, right = pairs[:, :, 0, :], pairs[:, :, 1, :]
+        prod = (
+            jax.lax.dot_general(
+                left.astype(jnp.int8),
+                level_mats[j],
+                (((2,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )
+            & 1
+        ).astype(jnp.uint8)
+        bits = prod ^ right
+    return bits[:, 0, :]
+
+
+def _ghash_of_ct(
+    ct_padded: jnp.ndarray, ctx_levels: int, n_blocks: int,
+    level_mats: jnp.ndarray, final_mat: jnp.ndarray, const_bits: jnp.ndarray,
+) -> jnp.ndarray:
+    """ct_padded uint8[B, n_blocks*16] (tail already zeroed) -> GHASH bits [B,128]."""
+    batch = ct_padded.shape[0]
+    blocks_bits = _bytes_to_bits(ct_padded.reshape(batch, n_blocks, 16))
+    m_pow2 = 1 << ctx_levels
+    if m_pow2 > n_blocks:
+        # Left-pad with zero blocks: leading zeros don't change the polynomial.
+        pad = jnp.zeros((batch, m_pow2 - n_blocks, 128), jnp.uint8)
+        blocks_bits = jnp.concatenate([pad, blocks_bits], axis=1)
+    t_c = _ghash_tree(blocks_bits, level_mats, ctx_levels)
+    ghash = (
+        jax.lax.dot_general(
+            t_c.astype(jnp.int8), final_mat, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+        & 1
+    ).astype(jnp.uint8)
+    return ghash ^ const_bits
+
+
+@functools.partial(
+    jax.jit, static_argnames=("chunk_bytes", "n_blocks", "levels", "decrypt")
+)
+def _gcm_process_batch(
+    round_keys: jnp.ndarray,
+    ivs: jnp.ndarray,
+    data: jnp.ndarray,
+    level_mats: jnp.ndarray,
+    final_mat: jnp.ndarray,
+    const_bits: jnp.ndarray,
+    *,
+    chunk_bytes: int,
+    n_blocks: int,
+    levels: int,
+    decrypt: bool,
+):
+    """Shared encrypt/decrypt core. data uint8[B, chunk_bytes].
+
+    Returns (output uint8[B, chunk_bytes], tags uint8[B, 16]); the tag is
+    always computed over the CIPHERTEXT (input when decrypting, output when
+    encrypting).
+    """
+    batch = data.shape[0]
+    padded_len = n_blocks * 16
+
+    ks = jax.vmap(
+        lambda iv: ctr_keystream(round_keys, iv, 1, n_blocks + 1)
+    )(ivs)  # [B, n_blocks+1, 16]
+    tag_mask = ks[:, 0, :]
+    keystream = ks[:, 1:, :].reshape(batch, padded_len)[:, :chunk_bytes]
+
+    output = data ^ keystream
+
+    ct = data if decrypt else output
+    if padded_len != chunk_bytes:
+        ct_padded = jnp.zeros((batch, padded_len), jnp.uint8).at[:, :chunk_bytes].set(ct)
+    else:
+        ct_padded = ct
+    ghash = _ghash_of_ct(ct_padded, levels, n_blocks, level_mats, final_mat, const_bits)
+    tags = _bits_to_bytes(ghash) ^ tag_mask
+    return output, tags
+
+
+def gcm_encrypt_chunks(ctx: GcmContext, ivs: np.ndarray, plaintext: np.ndarray):
+    """plaintext uint8[B, ctx.chunk_bytes], ivs uint8[B,12] ->
+    (ciphertext uint8[B, chunk_bytes], tags uint8[B,16])."""
+    ct, tags = _gcm_process_batch(
+        jnp.asarray(ctx.round_keys),
+        jnp.asarray(ivs, dtype=jnp.uint8),
+        jnp.asarray(plaintext, dtype=jnp.uint8),
+        jnp.asarray(ctx.level_mats),
+        jnp.asarray(ctx.final_mat),
+        jnp.asarray(ctx.const_bits),
+        chunk_bytes=ctx.chunk_bytes,
+        n_blocks=ctx.n_blocks,
+        levels=ctx.levels,
+        decrypt=False,
+    )
+    return ct, tags
+
+
+def gcm_decrypt_chunks(ctx: GcmContext, ivs: np.ndarray, ciphertext: np.ndarray):
+    """Returns (plaintext uint8[B, chunk_bytes], expected_tags uint8[B,16]).
+
+    The caller compares expected_tags against the received tags (constant-time
+    comparison is not required server-side here, but verification is
+    mandatory — the TPU transform backend raises on mismatch)."""
+    return _gcm_process_batch(
+        jnp.asarray(ctx.round_keys),
+        jnp.asarray(ivs, dtype=jnp.uint8),
+        jnp.asarray(ciphertext, dtype=jnp.uint8),
+        jnp.asarray(ctx.level_mats),
+        jnp.asarray(ctx.final_mat),
+        jnp.asarray(ctx.const_bits),
+        chunk_bytes=ctx.chunk_bytes,
+        n_blocks=ctx.n_blocks,
+        levels=ctx.levels,
+        decrypt=True,
+    )

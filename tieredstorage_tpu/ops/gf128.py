@@ -1,0 +1,110 @@
+"""Host-side GF(2^128) math for GHASH, in GCM's reflected-bit convention.
+
+GHASH multiplication by a FIXED field element C is linear over GF(2), so it
+is exactly a 128x128 bit-matrix apply. The device-side GHASH reduction
+(ops/gcm.py) is a log-tree whose level-j combine multiplies by H^(2^j); this
+module builds those matrices (one per level, per segment key) so the entire
+reduction becomes int8 matmuls (mod 2) on the MXU — no carryless-multiply
+instruction needed, which TPUs don't have.
+
+Conventions: a field element is a 128-bit Python int whose bit i (from the
+MSB end) is the coefficient of x^i — i.e. int.from_bytes(block, "big") with
+GCM's bit-reflected polynomial P(x) = x^128 + x^7 + x^2 + x + 1, where the
+block's first byte's MSB is the x^0 coefficient. In this int encoding the
+x^0 coefficient sits at bit 127 and multiplication by x is a right shift
+with conditional reduction by R = 0xE1 << 120.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_R = 0xE1000000000000000000000000000000  # reduction constant (reflected P)
+_MASK = (1 << 128) - 1
+
+
+def gcm_mult(x: int, y: int) -> int:
+    """GF(2^128) product in GCM convention (both operands as 128-bit ints)."""
+    z = 0
+    v = x
+    for i in range(127, -1, -1):
+        if (y >> i) & 1:
+            z ^= v
+        if v & 1:
+            v = (v >> 1) ^ _R
+        else:
+            v >>= 1
+    return z
+
+
+def mult_by_x(v: int) -> int:
+    """Multiply by x (one reflected shift step)."""
+    if v & 1:
+        return (v >> 1) ^ _R
+    return v >> 1
+
+
+def gcm_pow(h: int, exponent: int) -> int:
+    """H^exponent by square-and-multiply."""
+    result = 1 << 127  # the field's multiplicative identity in this encoding
+    base = h
+    e = exponent
+    while e:
+        if e & 1:
+            result = gcm_mult(result, base)
+        base = gcm_mult(base, base)
+        e >>= 1
+    return result
+
+
+def _int_to_bits(v: int) -> np.ndarray:
+    """128-bit int -> uint8[128] bit vector, index 0 = MSB (byte-order bits)."""
+    return np.frombuffer(v.to_bytes(16, "big"), dtype=np.uint8)[:, None] >> np.arange(
+        7, -1, -1, dtype=np.uint8
+    ).reshape(1, 8) & 1
+
+
+def int_to_bitvec(v: int) -> np.ndarray:
+    return _int_to_bits(v).reshape(128).astype(np.uint8)
+
+
+def bitvec_to_int(bits: np.ndarray) -> int:
+    packed = np.packbits(bits.astype(np.uint8).reshape(16, 8), axis=1, bitorder="big")
+    return int.from_bytes(packed.tobytes(), "big")
+
+
+def mult_matrix(c: int) -> np.ndarray:
+    """uint8[128,128] matrix M with bits(a*c) = M @ bits(a) mod 2.
+
+    Column i is c * x^i, built incrementally with 128 shift-reduce steps
+    (c * x^(i+1) = (c * x^i) * x), so matrix construction is O(128) field
+    steps, not O(128) full multiplications.
+    """
+    m = np.zeros((128, 128), dtype=np.uint8)
+    col = c
+    for i in range(128):
+        m[:, i] = int_to_bitvec(col)
+        col = mult_by_x(col)
+    return m
+
+
+def ghash_level_matrices(h: int, levels: int) -> np.ndarray:
+    """uint8[levels,128,128]: level j's combine matrix = mult by H^(2^j).
+
+    Level 0 pairs single blocks (L*H^1 ^ R), level 1 pairs 2-block nodes
+    (L*H^2 ^ R), etc. H^(2^(j+1)) is the square of H^(2^j).
+    """
+    mats = np.zeros((levels, 128, 128), dtype=np.uint8)
+    c = h
+    for j in range(levels):
+        mats[j] = mult_matrix(c)
+        c = gcm_mult(c, c)
+    return mats
+
+
+def ghash_reference(h: int, blocks: list[bytes]) -> int:
+    """Straightforward serial GHASH for testing: Y_i = (Y_{i-1} ^ X_i) * H."""
+    y = 0
+    for b in blocks:
+        y = gcm_mult(y ^ int.from_bytes(b.ljust(16, b"\x00"), "big"), h)
+    return y
