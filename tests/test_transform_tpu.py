@@ -1,0 +1,162 @@
+"""TPU transform backend: equivalence with the CPU oracle backend, mesh
+sharding on the virtual CPU mesh, tag verification, full RSM lifecycle."""
+
+from __future__ import annotations
+
+import io
+import random
+
+import numpy as np
+import pytest
+
+from tieredstorage_tpu.security.aes import AesEncryptionProvider, IV_SIZE
+from tieredstorage_tpu.transform import (
+    CpuTransformBackend,
+    DetransformOptions,
+    SegmentTransformation,
+    TransformOptions,
+)
+from tieredstorage_tpu.transform.tpu import AuthenticationError, TpuTransformBackend
+
+CHUNK = 1024
+
+
+@pytest.fixture(scope="module")
+def key_pair():
+    return AesEncryptionProvider.create_data_key_and_aad()
+
+
+@pytest.fixture(scope="module")
+def chunks():
+    rng = random.Random(3)
+    sizes = [CHUNK, CHUNK, CHUNK, 133]
+    return [bytes(rng.getrandbits(8) for _ in range(s)) for s in sizes]
+
+
+def det_ivs(n):
+    return [bytes([i + 1]) * IV_SIZE for i in range(n)]
+
+
+class TestEquivalenceWithCpuBackend:
+    @pytest.mark.parametrize("compression", [False, True])
+    def test_encrypt_bytes_identical_with_same_ivs(self, key_pair, chunks, compression):
+        opts = TransformOptions(
+            compression=compression, encryption=key_pair, ivs=det_ivs(len(chunks))
+        )
+        cpu_out = CpuTransformBackend().transform(chunks, opts)
+        tpu_out = TpuTransformBackend().transform(chunks, opts)
+        assert [len(a) for a in cpu_out] == [len(b) for b in tpu_out]
+        for i, (a, b) in enumerate(zip(cpu_out, tpu_out)):
+            assert a == b, f"chunk {i} differs"
+
+    def test_compression_only_identical(self, key_pair, chunks):
+        opts = TransformOptions(compression=True)
+        assert CpuTransformBackend().transform(chunks, opts) == TpuTransformBackend().transform(
+            chunks, opts
+        )
+
+    @pytest.mark.parametrize("compression", [False, True])
+    def test_cross_backend_round_trip(self, key_pair, chunks, compression):
+        # CPU encrypts -> TPU decrypts, and vice versa.
+        opts = TransformOptions(compression=compression, encryption=key_pair)
+        d_opts = DetransformOptions(compression=compression, encryption=key_pair)
+        cpu, tpu = CpuTransformBackend(), TpuTransformBackend()
+        assert tpu.detransform(cpu.transform(chunks, opts), d_opts) == list(chunks)
+        assert cpu.detransform(tpu.transform(chunks, opts), d_opts) == list(chunks)
+
+    def test_uniform_batch_fast_path(self, key_pair):
+        chunks = [bytes([i]) * CHUNK for i in range(8)]
+        opts = TransformOptions(encryption=key_pair)
+        d_opts = DetransformOptions(encryption=key_pair)
+        tpu = TpuTransformBackend()
+        assert tpu.detransform(tpu.transform(chunks, opts), d_opts) == chunks
+
+
+class TestTagVerification:
+    def test_tampered_ciphertext_rejected(self, key_pair, chunks):
+        tpu = TpuTransformBackend()
+        opts = TransformOptions(encryption=key_pair)
+        out = tpu.transform(chunks, opts)
+        bad = bytearray(out[1])
+        bad[IV_SIZE + 3] ^= 0x01
+        out[1] = bytes(bad)
+        with pytest.raises(AuthenticationError, match=r"\[1\]"):
+            tpu.detransform(out, DetransformOptions(encryption=key_pair))
+
+    def test_truncated_chunk_rejected(self, key_pair):
+        tpu = TpuTransformBackend()
+        with pytest.raises(ValueError, match="shorter"):
+            tpu.detransform([b"\x00" * 10], DetransformOptions(encryption=key_pair))
+
+
+class TestMeshSharding:
+    def test_sharded_batch_matches_unsharded(self, key_pair):
+        from tieredstorage_tpu.parallel.mesh import data_mesh
+
+        mesh = data_mesh()  # 8 virtual CPU devices from conftest
+        assert mesh.devices.size == 8
+        chunks = [bytes([i]) * CHUNK for i in range(11)]  # not divisible by 8
+        ivs = det_ivs(len(chunks))
+        opts = TransformOptions(encryption=key_pair, ivs=ivs)
+        plain = TpuTransformBackend().transform(chunks, opts)
+        sharded = TpuTransformBackend(mesh=mesh).transform(chunks, opts)
+        assert plain == sharded
+
+    def test_sharded_varlen_and_decrypt(self, key_pair, chunks):
+        from tieredstorage_tpu.parallel.mesh import data_mesh
+
+        mesh = data_mesh(4)
+        tpu = TpuTransformBackend(mesh=mesh)
+        opts = TransformOptions(compression=True, encryption=key_pair)
+        out = tpu.transform(chunks, opts)
+        back = tpu.detransform(
+            out, DetransformOptions(compression=True, encryption=key_pair)
+        )
+        assert back == list(chunks)
+
+
+class TestRsmWithTpuBackend:
+    def test_lifecycle(self, tmp_path, key_pair):
+        from tests.test_rsm_lifecycle import make_segment_data, make_rsm
+
+        data = make_segment_data(tmp_path, with_txn=False)
+        storage_root = tmp_path / "remote"
+        storage_root.mkdir()
+        from tieredstorage_tpu.rsm import RemoteStorageManager
+        from tieredstorage_tpu.security.rsa import generate_key_pair_pem_files
+
+        pub, priv = generate_key_pair_pem_files(tmp_path, prefix="k")
+        rsm = RemoteStorageManager()
+        rsm.configure({
+            "storage.backend.class": "tieredstorage_tpu.storage.filesystem.FileSystemStorage",
+            "storage.root": str(storage_root),
+            "transform.backend.class": "tieredstorage_tpu.transform.tpu.TpuTransformBackend",
+            "chunk.size": CHUNK,
+            "compression.enabled": True,
+            "encryption.enabled": True,
+            "encryption.key.pair.id": "key1",
+            "encryption.key.pairs": "key1",
+            "encryption.key.pairs.key1.public.key.file": str(pub),
+            "encryption.key.pairs.key1.private.key.file": str(priv),
+        })
+        from tests.test_rsm_lifecycle import (
+            TOPIC_ID, SEGMENT_ID,
+        )
+        from tieredstorage_tpu.metadata import (
+            RemoteLogSegmentId, RemoteLogSegmentMetadata, TopicIdPartition, TopicPartition,
+        )
+
+        md = RemoteLogSegmentMetadata(
+            remote_log_segment_id=RemoteLogSegmentId(
+                TopicIdPartition(TOPIC_ID, TopicPartition("topic", 7)), SEGMENT_ID
+            ),
+            start_offset=23,
+            end_offset=2000,
+        )
+        rsm.copy_log_segment_data(md, data)
+        original = data.log_segment.read_bytes()
+        with rsm.fetch_log_segment(md, 0) as s:
+            assert s.read() == original
+        with rsm.fetch_log_segment(md, 1000, 5000) as s:
+            assert s.read() == original[1000:5001]
+        rsm.delete_log_segment_data(md)

@@ -218,6 +218,168 @@ def gcm_encrypt_chunks(ctx: GcmContext, ivs: np.ndarray, plaintext: np.ndarray):
     return ct, tags
 
 
+# --- variable-length batches (encrypt-after-compress path) ---
+#
+# Chunks in one batch may have different byte lengths (compressed sizes).
+# The CTR keystream pads/truncates trivially; for GHASH, each row's block
+# sequence [AAD blocks, C blocks, length block] is built left-aligned and
+# then rotated right so it ends exactly at the tree's last slot — leading
+# zero blocks don't change the polynomial, so one fixed-shape tree tags all
+# rows correctly regardless of their true lengths.
+
+
+@dataclasses.dataclass(frozen=True)
+class GcmVarlenContext:
+    round_keys: np.ndarray   # uint8[15,16]
+    aad_blocks: np.ndarray   # uint8[m_A,16] zero-padded AAD blocks
+    level_mats: np.ndarray   # int8[levels,128,128] (transposed)
+    h_mat: np.ndarray        # int8[128,128] transposed mult-by-H matrix
+    aad_bit_len: int
+    max_bytes: int
+    m_max: int               # max data blocks
+    m_cap: int               # padded sequence slots (power of two)
+    levels: int
+
+
+@functools.lru_cache(maxsize=64)
+def _varlen_context_cached(key: bytes, aad: bytes, max_bytes: int) -> GcmVarlenContext:
+    round_keys = key_expansion(key)
+    h_block = np.asarray(
+        aes_encrypt_blocks(jnp.asarray(round_keys), np.zeros((1, 16), np.uint8))
+    )[0]
+    h = int.from_bytes(h_block.tobytes(), "big")
+    m_max = _ceil_div(max_bytes, 16)
+    m_a = _ceil_div(len(aad), 16)
+    seq_len = m_a + m_max + 1
+    levels = max(1, (seq_len - 1).bit_length())
+    aad_padded = np.frombuffer(
+        aad + b"\x00" * (m_a * 16 - len(aad)), dtype=np.uint8
+    ).reshape(m_a, 16) if m_a else np.zeros((0, 16), np.uint8)
+    return GcmVarlenContext(
+        round_keys=round_keys,
+        aad_blocks=aad_padded,
+        level_mats=np.ascontiguousarray(
+            gf128.ghash_level_matrices(h, levels).transpose(0, 2, 1).astype(np.int8)
+        ),
+        h_mat=np.ascontiguousarray(gf128.mult_matrix(h).T.astype(np.int8)),
+        aad_bit_len=len(aad) * 8,
+        max_bytes=max_bytes,
+        m_max=m_max,
+        m_cap=1 << levels,
+        levels=levels,
+    )
+
+
+def make_varlen_context(key: bytes, aad: bytes, max_bytes: int) -> GcmVarlenContext:
+    if len(key) != 32:
+        raise ValueError("AES-256 key required")
+    # Round the shape up to a multiple of 16 so jit cache entries are shared
+    # across nearby compressed sizes.
+    padded = max(16, _ceil_div(max_bytes, 16) * 16)
+    return _varlen_context_cached(bytes(key), bytes(aad), padded)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("max_bytes", "m_max", "m_a", "m_cap", "levels", "decrypt")
+)
+def _gcm_varlen_batch(
+    round_keys, ivs, data, lengths, len_blocks, aad_blocks, level_mats, h_mat,
+    *, max_bytes: int, m_max: int, m_a: int, m_cap: int, levels: int, decrypt: bool,
+):
+    """data uint8[B, max_bytes] left-aligned (zero tail), lengths int32[B],
+    len_blocks uint8[B,16] (host-built GCM length blocks).
+    Returns (output uint8[B, max_bytes], tags uint8[B, 16])."""
+    batch = data.shape[0]
+
+    ks = jax.vmap(lambda iv: ctr_keystream(round_keys, iv, 1, m_max + 1))(ivs)
+    tag_mask = ks[:, 0, :]
+    keystream = ks[:, 1:, :].reshape(batch, m_max * 16)[:, :max_bytes]
+
+    byte_mask = (
+        jnp.arange(max_bytes, dtype=jnp.int32)[None, :] < lengths[:, None]
+    ).astype(jnp.uint8)
+    output = (data ^ keystream) * byte_mask
+
+    ct = data if decrypt else output  # ct is already masked in both directions
+    ct_blocks = ct.reshape(batch, m_max, 16)
+
+    n_blocks = _ceil_div_dev(lengths)  # int32[B] data blocks per row
+    seq = jnp.concatenate(
+        [
+            jnp.broadcast_to(aad_blocks, (batch, m_a, 16)).astype(jnp.uint8),
+            ct_blocks,
+            jnp.zeros((batch, m_cap - m_a - m_max, 16), jnp.uint8),
+        ],
+        axis=1,
+    )
+    # Place each row's length block right after its data blocks.
+    l_pos = m_a + n_blocks  # int32[B]
+    onehot = (
+        jnp.arange(m_cap, dtype=jnp.int32)[None, :] == l_pos[:, None]
+    ).astype(jnp.uint8)
+    seq = seq ^ (onehot[:, :, None] * len_blocks[:, None, :])
+    # Rotate right so the sequence ends at slot m_cap-1.
+    shift = m_cap - (l_pos + 1)
+    idx = (jnp.arange(m_cap, dtype=jnp.int32)[None, :] - shift[:, None]) % m_cap
+    seq = jnp.take_along_axis(seq, idx[:, :, None], axis=1)
+
+    bits = _bytes_to_bits(seq)
+    t = _ghash_tree(bits, level_mats, levels)
+    ghash = (
+        jax.lax.dot_general(
+            t.astype(jnp.int8), h_mat, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+        & 1
+    ).astype(jnp.uint8)
+    tags = _bits_to_bytes(ghash) ^ tag_mask
+    return output, tags
+
+
+def _ceil_div_dev(lengths: jnp.ndarray) -> jnp.ndarray:
+    return (lengths + 15) // 16
+
+
+def _host_len_blocks(ctx: GcmVarlenContext, lengths: np.ndarray) -> np.ndarray:
+    out = np.zeros((len(lengths), 16), dtype=np.uint8)
+    for i, l in enumerate(lengths):
+        out[i] = np.frombuffer(
+            ctx.aad_bit_len.to_bytes(8, "big") + (int(l) * 8).to_bytes(8, "big"),
+            dtype=np.uint8,
+        )
+    return out
+
+
+def _run_varlen(ctx: GcmVarlenContext, ivs, data, lengths, decrypt: bool):
+    lengths = np.asarray(lengths, dtype=np.int32)
+    return _gcm_varlen_batch(
+        jnp.asarray(ctx.round_keys),
+        jnp.asarray(ivs, dtype=jnp.uint8),
+        jnp.asarray(data, dtype=jnp.uint8),
+        jnp.asarray(lengths),
+        jnp.asarray(_host_len_blocks(ctx, lengths)),
+        jnp.asarray(ctx.aad_blocks),
+        jnp.asarray(ctx.level_mats),
+        jnp.asarray(ctx.h_mat),
+        max_bytes=ctx.max_bytes,
+        m_max=ctx.m_max,
+        m_a=ctx.aad_blocks.shape[0],
+        m_cap=ctx.m_cap,
+        levels=ctx.levels,
+        decrypt=decrypt,
+    )
+
+
+def gcm_encrypt_varlen(ctx: GcmVarlenContext, ivs, plaintext, lengths):
+    """plaintext uint8[B, ctx.max_bytes] (rows zero-padded past their length)."""
+    return _run_varlen(ctx, ivs, plaintext, lengths, decrypt=False)
+
+
+def gcm_decrypt_varlen(ctx: GcmVarlenContext, ivs, ciphertext, lengths):
+    """Returns (plaintext, expected_tags) — caller verifies tags."""
+    return _run_varlen(ctx, ivs, ciphertext, lengths, decrypt=True)
+
+
 def gcm_decrypt_chunks(ctx: GcmContext, ivs: np.ndarray, ciphertext: np.ndarray):
     """Returns (plaintext uint8[B, chunk_bytes], expected_tags uint8[B,16]).
 

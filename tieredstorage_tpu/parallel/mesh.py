@@ -1,0 +1,39 @@
+"""Device mesh helpers for sharding chunk batches across chips."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+
+
+def data_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """1-D mesh over the first `n_devices` devices (default: all)."""
+    devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(f"Requested {n_devices} devices, have {len(devices)}")
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (DATA_AXIS,))
+
+
+def shard_rows(mesh: Mesh, array) -> jax.Array:
+    """Place an array with its leading (batch) axis sharded over the mesh.
+
+    The batch must be divisible by the mesh size — callers pad with dummy
+    rows (the transform backend does) before sharding.
+    """
+    spec = P(DATA_AXIS, *([None] * (array.ndim - 1)))
+    return jax.device_put(array, NamedSharding(mesh, spec))
+
+
+def pad_batch(n_rows: int, mesh: Optional[Mesh]) -> int:
+    """Rows to add so the batch divides evenly across the mesh."""
+    if mesh is None:
+        return 0
+    size = mesh.devices.size
+    return (-n_rows) % size

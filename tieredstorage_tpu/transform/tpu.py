@@ -1,0 +1,193 @@
+"""TPU transform backend: batched device AES-GCM (+host zstd until the
+TPU-native codec lands), pluggable at `transform.backend.class`.
+
+The point of the framework (BASELINE north star): whole windows of chunks are
+shipped to the device as uint8[batch, chunk_size] arrays and encrypted/
+decrypted by the vmapped AES-CTR + MXU-GHASH kernels (ops/gcm.py), with the
+per-chunk IV array generated host-side and the chunk batch optionally sharded
+across a device mesh (parallel/mesh.py). Wire format is identical to the CPU
+backend and the reference: per-chunk zstd frame (content size pledged), then
+IV || ciphertext || tag.
+"""
+
+from __future__ import annotations
+
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional, Sequence
+
+import numpy as np
+
+import zstandard
+
+from tieredstorage_tpu.ops.gcm import (
+    gcm_decrypt_chunks,
+    gcm_decrypt_varlen,
+    gcm_encrypt_chunks,
+    gcm_encrypt_varlen,
+    make_context,
+    make_varlen_context,
+)
+from tieredstorage_tpu.parallel.mesh import data_mesh, pad_batch, shard_rows
+from tieredstorage_tpu.security.aes import IV_SIZE, TAG_SIZE
+from tieredstorage_tpu.transform.api import (
+    ZSTD,
+    DetransformOptions,
+    TransformBackend,
+    TransformOptions,
+)
+
+
+class AuthenticationError(ValueError):
+    """GCM tag verification failed on detransform (corrupt or forged data)."""
+
+
+class TpuTransformBackend(TransformBackend):
+    preferred_batch_chunks = 256
+
+    def __init__(self, mesh=None):
+        self._mesh = mesh
+        self._pool: Optional[ThreadPoolExecutor] = None
+
+    def configure(self, configs: dict) -> None:
+        if "batch.chunks" in configs:
+            self.preferred_batch_chunks = int(configs["batch.chunks"])
+        n = configs.get("mesh.devices")
+        if n:
+            self._mesh = data_mesh(int(n))
+
+    def _zstd_pool(self) -> ThreadPoolExecutor:
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(max_workers=min(32, os.cpu_count() or 4))
+        return self._pool
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+
+    # ------------------------------------------------------------- transform
+    def transform(self, chunks: Sequence[bytes], opts: TransformOptions) -> list[bytes]:
+        out = list(chunks)
+        if not out:
+            return []
+        if opts.compression:
+            if opts.compression_codec != ZSTD:
+                raise ValueError(f"Codec {opts.compression_codec!r} not yet implemented")
+            level = opts.compression_level
+            out = list(
+                self._zstd_pool().map(
+                    lambda c: zstandard.ZstdCompressor(
+                        level=level, write_content_size=True
+                    ).compress(c),
+                    out,
+                )
+            )
+        if opts.encryption is not None:
+            out = self._encrypt_batch(out, opts)
+        return out
+
+    def _make_ivs(self, n: int, opts: TransformOptions) -> np.ndarray:
+        if opts.ivs is not None:
+            if len(opts.ivs) < n:
+                raise ValueError("Not enough IVs for the chunk batch")
+            return np.stack(
+                [np.frombuffer(iv, dtype=np.uint8) for iv in opts.ivs[:n]]
+            )
+        return np.frombuffer(os.urandom(IV_SIZE * n), dtype=np.uint8).reshape(n, IV_SIZE)
+
+    def _encrypt_batch(self, chunks: list[bytes], opts: TransformOptions) -> list[bytes]:
+        enc = opts.encryption
+        sizes = [len(c) for c in chunks]
+        ivs = self._make_ivs(len(chunks), opts)
+
+        if len(set(sizes)) == 1:
+            ctx = make_context(enc.data_key, enc.aad, sizes[0])
+            data = np.stack([np.frombuffer(c, dtype=np.uint8) for c in chunks])
+            data, ivs_padded, pad = self._maybe_shard(data, ivs)
+            ct, tags = gcm_encrypt_chunks(ctx, ivs_padded, data)
+            ct, tags = np.asarray(ct), np.asarray(tags)
+        else:
+            max_bytes = max(sizes)
+            ctx = make_varlen_context(enc.data_key, enc.aad, max_bytes)
+            data = np.zeros((len(chunks), ctx.max_bytes), dtype=np.uint8)
+            for i, c in enumerate(chunks):
+                data[i, : len(c)] = np.frombuffer(c, dtype=np.uint8)
+            lengths = np.asarray(sizes, dtype=np.int32)
+            data, ivs_padded, pad = self._maybe_shard(data, ivs)
+            if pad:
+                lengths = np.concatenate([lengths, np.full(pad, 16, np.int32)])
+            ct, tags = gcm_encrypt_varlen(ctx, ivs_padded, data, lengths)
+            ct, tags = np.asarray(ct), np.asarray(tags)
+
+        return [
+            ivs[i].tobytes() + ct[i, : sizes[i]].tobytes() + tags[i].tobytes()
+            for i in range(len(chunks))
+        ]
+
+    def _maybe_shard(self, data: np.ndarray, ivs: np.ndarray):
+        pad = pad_batch(data.shape[0], self._mesh)
+        if pad:
+            data = np.concatenate([data, np.zeros((pad,) + data.shape[1:], np.uint8)])
+            ivs = np.concatenate([ivs, np.zeros((pad, IV_SIZE), np.uint8)])
+        if self._mesh is not None:
+            data = shard_rows(self._mesh, data)
+            ivs = shard_rows(self._mesh, ivs)
+        return data, ivs, pad
+
+    # ----------------------------------------------------------- detransform
+    def detransform(self, chunks: Sequence[bytes], opts: DetransformOptions) -> list[bytes]:
+        out = list(chunks)
+        if not out:
+            return []
+        if opts.encryption is not None:
+            out = self._decrypt_batch(out, opts)
+        if opts.compression:
+            if opts.compression_codec != ZSTD:
+                raise ValueError(f"Codec {opts.compression_codec!r} not yet implemented")
+            dctx = zstandard.ZstdDecompressor()
+            out = list(self._zstd_pool().map(lambda c: dctx.decompress(c), out))
+        return out
+
+    def _decrypt_batch(self, chunks: list[bytes], opts: DetransformOptions) -> list[bytes]:
+        enc = opts.encryption
+        for i, c in enumerate(chunks):
+            if len(c) < IV_SIZE + TAG_SIZE:
+                raise ValueError(f"Encrypted chunk {i} shorter than IV+tag")
+        ivs = np.stack(
+            [np.frombuffer(c[:IV_SIZE], dtype=np.uint8) for c in chunks]
+        )
+        received_tags = np.stack(
+            [np.frombuffer(c[-TAG_SIZE:], dtype=np.uint8) for c in chunks]
+        )
+        sizes = [len(c) - IV_SIZE - TAG_SIZE for c in chunks]
+
+        if len(set(sizes)) == 1:
+            ctx = make_context(enc.data_key, enc.aad, sizes[0])
+            data = np.stack(
+                [np.frombuffer(c[IV_SIZE:-TAG_SIZE], dtype=np.uint8) for c in chunks]
+            )
+            data, ivs_padded, pad = self._maybe_shard(data, ivs)
+            pt, expected_tags = gcm_decrypt_chunks(ctx, ivs_padded, data)
+        else:
+            max_bytes = max(sizes)
+            ctx = make_varlen_context(enc.data_key, enc.aad, max_bytes)
+            data = np.zeros((len(chunks), ctx.max_bytes), dtype=np.uint8)
+            for i, c in enumerate(chunks):
+                data[i, : sizes[i]] = np.frombuffer(c[IV_SIZE:-TAG_SIZE], dtype=np.uint8)
+            lengths = np.asarray(sizes, dtype=np.int32)
+            data, ivs_padded, pad = self._maybe_shard(data, ivs)
+            if pad:
+                lengths = np.concatenate([lengths, np.full(pad, 16, np.int32)])
+            pt, expected_tags = gcm_decrypt_varlen(ctx, ivs_padded, data, lengths)
+
+        pt = np.asarray(pt)
+        expected_tags = np.asarray(expected_tags)[: len(chunks)]
+        bad = [
+            i
+            for i in range(len(chunks))
+            if expected_tags[i].tobytes() != received_tags[i].tobytes()
+        ]
+        if bad:
+            raise AuthenticationError(f"GCM tag mismatch on chunks {bad}")
+        return [pt[i, : sizes[i]].tobytes() for i in range(len(chunks))]
