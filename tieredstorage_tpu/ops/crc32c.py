@@ -153,6 +153,33 @@ def _crc0_batch(data: jnp.ndarray, leaf_t: jnp.ndarray, level_mats: jnp.ndarray,
     return vals[:, 0, :]  # [batch, 32] bit vectors
 
 
+def crc32c_constants(chunk_bytes: int):
+    """Host-precomputed constants for `crc32c_chunks_device` at a chunk size."""
+    if chunk_bytes % 16:
+        raise ValueError("chunk_bytes must be a multiple of 16")
+    n_blocks = chunk_bytes // 16
+    levels = max(1, (n_blocks - 1).bit_length())
+    return (
+        jnp.asarray(_leaf_matrix().T.astype(np.int8)),
+        jnp.asarray(_level_matrices(levels)),
+        chunk_bytes,
+        levels,
+        np.uint32(_length_offset(chunk_bytes)),
+    )
+
+
+def crc32c_chunks_device(data, leaf_t, level_mats, chunk_bytes, levels, length_offset):
+    """Device-resident CRC32C: uint8[batch, chunk_bytes] -> uint32[batch].
+
+    Composable under an outer jit/shard_map (unlike `crc32c_chunks`, which
+    round-trips through numpy on the host).
+    """
+    bits = _crc0_batch(data, leaf_t, level_mats, chunk_bytes=chunk_bytes, levels=levels)
+    weights = jnp.asarray((1 << np.arange(31, -1, -1)).astype(np.uint32))
+    vals = jnp.sum(bits.astype(jnp.uint32) * weights, axis=1)
+    return vals ^ jnp.uint32(length_offset)
+
+
 def crc32c_chunks(data: np.ndarray) -> np.ndarray:
     """uint32[batch] CRC32C of each row of uint8[batch, chunk_bytes].
 

@@ -48,14 +48,19 @@ class GcmContext:
     levels: int                  # log2 of padded block count
 
 
-@functools.lru_cache(maxsize=64)
-def _context_cached(key: bytes, aad: bytes, chunk_bytes: int) -> GcmContext:
+@functools.lru_cache(maxsize=16)
+def _derive_h(key: bytes) -> tuple[np.ndarray, int]:
+    """Round keys and the GHASH key H = E_K(0^128) for an AES-256 key."""
     round_keys = key_expansion(key)
-    # H = E_K(0^128), computed with the same cipher host-side via numpy/jax cpu.
     h_block = np.asarray(
         aes_encrypt_blocks(jnp.asarray(round_keys), jnp.zeros((1, 16), jnp.uint8))
     )[0]
-    h = int.from_bytes(h_block.tobytes(), "big")
+    return round_keys, int.from_bytes(h_block.tobytes(), "big")
+
+
+@functools.lru_cache(maxsize=64)
+def _context_cached(key: bytes, aad: bytes, chunk_bytes: int) -> GcmContext:
+    round_keys, h = _derive_h(key)
 
     m_c = _ceil_div(chunk_bytes, 16)
     levels = max(1, (m_c - 1).bit_length())  # tree over next pow2 >= m_c
@@ -243,11 +248,7 @@ class GcmVarlenContext:
 
 @functools.lru_cache(maxsize=64)
 def _varlen_context_cached(key: bytes, aad: bytes, max_bytes: int) -> GcmVarlenContext:
-    round_keys = key_expansion(key)
-    h_block = np.asarray(
-        aes_encrypt_blocks(jnp.asarray(round_keys), np.zeros((1, 16), np.uint8))
-    )[0]
-    h = int.from_bytes(h_block.tobytes(), "big")
+    round_keys, h = _derive_h(key)
     m_max = _ceil_div(max_bytes, 16)
     m_a = _ceil_div(len(aad), 16)
     seq_len = m_a + m_max + 1
@@ -398,3 +399,8 @@ def gcm_decrypt_chunks(ctx: GcmContext, ivs: np.ndarray, ciphertext: np.ndarray)
         levels=ctx.levels,
         decrypt=True,
     )
+
+
+#: Public alias for composing the GCM core under an outer jit/shard_map
+#: (e.g. the multichip dry-run step); same contract as `_gcm_process_batch`.
+gcm_process_batch_device = _gcm_process_batch

@@ -21,6 +21,14 @@ from tieredstorage_tpu.security.aes import DataKeyAndAAD
 ZSTD = "zstd"
 
 
+class AuthenticationError(ValueError):
+    """GCM tag verification failed on detransform (corrupt or forged data).
+
+    Part of the backend contract: every TransformBackend raises this type so
+    callers see the same failure regardless of `transform.backend.class`.
+    """
+
+
 @dataclasses.dataclass(frozen=True)
 class TransformOptions:
     """Per-segment transform context (upload direction)."""

@@ -13,10 +13,12 @@ from __future__ import annotations
 from typing import Sequence
 
 import zstandard
+from cryptography.exceptions import InvalidTag
 
 from tieredstorage_tpu.security.aes import AesEncryptionProvider
 from tieredstorage_tpu.transform.api import (
     ZSTD,
+    AuthenticationError,
     DetransformOptions,
     TransformBackend,
     TransformOptions,
@@ -55,9 +57,17 @@ class CpuTransformBackend(TransformBackend):
         out = list(chunks)
         if opts.encryption is not None:
             enc = opts.encryption
-            out = [
-                AesEncryptionProvider.decrypt_chunk(c, enc.data_key, enc.aad) for c in out
-            ]
+            decrypted = []
+            for i, c in enumerate(out):
+                try:
+                    decrypted.append(
+                        AesEncryptionProvider.decrypt_chunk(c, enc.data_key, enc.aad)
+                    )
+                except InvalidTag:
+                    raise AuthenticationError(
+                        f"GCM tag mismatch on chunks [{i}]"
+                    ) from None
+            out = decrypted
         if opts.compression:
             if opts.compression_codec != ZSTD:
                 raise ValueError(

@@ -32,14 +32,11 @@ from tieredstorage_tpu.parallel.mesh import data_mesh, pad_batch, shard_rows
 from tieredstorage_tpu.security.aes import IV_SIZE, TAG_SIZE
 from tieredstorage_tpu.transform.api import (
     ZSTD,
+    AuthenticationError,
     DetransformOptions,
     TransformBackend,
     TransformOptions,
 )
-
-
-class AuthenticationError(ValueError):
-    """GCM tag verification failed on detransform (corrupt or forged data)."""
 
 
 class TpuTransformBackend(TransformBackend):
@@ -145,8 +142,13 @@ class TpuTransformBackend(TransformBackend):
         if opts.compression:
             if opts.compression_codec != ZSTD:
                 raise ValueError(f"Codec {opts.compression_codec!r} not yet implemented")
-            dctx = zstandard.ZstdDecompressor()
-            out = list(self._zstd_pool().map(lambda c: dctx.decompress(c), out))
+            # One DCtx per chunk: zstandard (de)compressor objects are not
+            # thread-safe across the pool's workers.
+            out = list(
+                self._zstd_pool().map(
+                    lambda c: zstandard.ZstdDecompressor().decompress(c), out
+                )
+            )
         return out
 
     def _decrypt_batch(self, chunks: list[bytes], opts: DetransformOptions) -> list[bytes]:
