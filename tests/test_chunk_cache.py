@@ -1,0 +1,309 @@
+"""Chunk cache tests: LoadingCache semantics, memory/disk caches, factory,
+prefetch, and the RSM wired with a cache.
+
+Reference model: core/src/test/java/.../fetch/cache/ChunkCacheTest.java and
+the Caffeine semantics described at ChunkCache.java:76-184.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from tieredstorage_tpu.config.cache_config import DiskChunkCacheConfig
+from tieredstorage_tpu.config.configdef import ConfigException
+from tieredstorage_tpu.fetch.cache import ChunkKey, DiskChunkCache, MemoryChunkCache
+from tieredstorage_tpu.fetch.cache.chunk_cache import ChunkCacheTimeoutException
+from tieredstorage_tpu.fetch.chunk_manager import ChunkManager, DefaultChunkManager
+from tieredstorage_tpu.fetch.factory import ChunkManagerFactory
+from tieredstorage_tpu.manifest.chunk_index import FixedSizeChunkIndex
+from tieredstorage_tpu.manifest.segment_indexes import SegmentIndexesV1Builder, IndexType
+from tieredstorage_tpu.manifest.segment_manifest import SegmentManifestV1
+from tieredstorage_tpu.storage.core import ObjectKey
+from tieredstorage_tpu.utils.caching import LoadingCache, RemovalCause
+
+CHUNK = 64
+N_CHUNKS = 16
+FILE_SIZE = CHUNK * N_CHUNKS
+
+
+def make_manifest(n_chunks: int = N_CHUNKS) -> SegmentManifestV1:
+    index = FixedSizeChunkIndex(
+        original_chunk_size=CHUNK,
+        original_file_size=CHUNK * n_chunks,
+        transformed_chunk_size=CHUNK,
+        final_transformed_chunk_size=CHUNK,
+    )
+    builder = SegmentIndexesV1Builder()
+    for t in (IndexType.OFFSET, IndexType.TIMESTAMP, IndexType.PRODUCER_SNAPSHOT,
+              IndexType.LEADER_EPOCH):
+        builder.add(t, 0)
+    return SegmentManifestV1(
+        chunk_index=index,
+        segment_indexes=builder.build(),
+        compression=False,
+        encryption=None,
+        remote_log_segment_metadata=None,
+    )
+
+
+class CountingChunkManager(ChunkManager):
+    """Fake delegate: chunk i is bytes([i]) * CHUNK; counts batch calls."""
+
+    def __init__(self, delay_s: float = 0.0):
+        self.calls: list[list[int]] = []
+        self.delay_s = delay_s
+        self._lock = threading.Lock()
+
+    def get_chunk(self, objects_key, manifest, chunk_id):
+        import io
+        return io.BytesIO(self.get_chunks(objects_key, manifest, [chunk_id])[0])
+
+    def get_chunks(self, objects_key, manifest, chunk_ids):
+        with self._lock:
+            self.calls.append(list(chunk_ids))
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return [bytes([cid % 256]) * CHUNK for cid in chunk_ids]
+
+
+KEY = ObjectKey(value="pre/topic-xxx/7/00000000000000000023-uuid.log")
+
+
+# --------------------------------------------------------------- LoadingCache
+class TestLoadingCache:
+    def test_single_flight(self):
+        pool = ThreadPoolExecutor(8)
+        cache = LoadingCache(executor=pool)
+        loads = []
+        barrier = threading.Barrier(4)
+
+        def loader():
+            loads.append(1)
+            time.sleep(0.05)
+            return "v"
+
+        def get():
+            barrier.wait()
+            return cache.get("k", loader, timeout=5)
+
+        results = list(ThreadPoolExecutor(4).map(lambda _: get(), range(4)))
+        assert results == ["v"] * 4
+        assert len(loads) == 1
+        assert cache.stats.hits == 3
+        assert cache.stats.misses == 1
+
+    def test_weight_eviction_lru(self):
+        pool = ThreadPoolExecutor(2)
+        removed = []
+        cache = LoadingCache(
+            executor=pool, max_weight=10, weigher=len,
+            removal_listener=lambda k, v, c: removed.append((k, c)),
+        )
+        cache.get("a", lambda: "x" * 4, timeout=5)
+        cache.get("b", lambda: "y" * 4, timeout=5)
+        cache.get("a", lambda: "!", timeout=5)  # refresh a's recency
+        cache.get("c", lambda: "z" * 4, timeout=5)  # over budget: evict LRU = b
+        time.sleep(0.05)
+        assert ("b", RemovalCause.SIZE) in removed
+        assert cache.get_if_present("a") is not None
+        assert cache.get_if_present("c") is not None
+
+    def test_expire_after_access(self):
+        now = [0.0]
+        pool = ThreadPoolExecutor(2)
+        removed = []
+        cache = LoadingCache(
+            executor=pool, expire_after_access_s=10,
+            removal_listener=lambda k, v, c: removed.append((k, c)),
+            time_source=lambda: now[0],
+        )
+        cache.get("a", lambda: "v", timeout=5)
+        now[0] = 5
+        assert cache.get_if_present("a") is not None  # refreshes access time
+        now[0] = 14
+        assert cache.get_if_present("a") is not None
+        now[0] = 30
+        assert cache.get_if_present("a") is None
+        time.sleep(0.05)
+        assert ("a", RemovalCause.EXPIRED) in removed
+
+    def test_load_failure_not_cached(self):
+        pool = ThreadPoolExecutor(2)
+        cache = LoadingCache(executor=pool)
+        with pytest.raises(RuntimeError):
+            cache.get("k", lambda: (_ for _ in ()).throw(RuntimeError("boom")), timeout=5)
+        assert cache.stats.load_failures == 1
+        # Next get retries the loader.
+        assert cache.get("k", lambda: "ok", timeout=5) == "ok"
+
+
+# -------------------------------------------------------------- chunk caches
+class TestMemoryChunkCache:
+    def test_hit_serves_without_delegate_call(self):
+        delegate = CountingChunkManager()
+        cache = MemoryChunkCache(delegate)
+        cache.configure({"size": -1})
+        manifest = make_manifest()
+        a = cache.get_chunk(KEY, manifest, 3).read()
+        b = cache.get_chunk(KEY, manifest, 3).read()
+        assert a == b == bytes([3]) * CHUNK
+        assert delegate.calls == [[3]]
+        assert cache.stats.hits == 1
+
+    def test_window_fetches_missing_in_one_batch(self):
+        delegate = CountingChunkManager()
+        cache = MemoryChunkCache(delegate)
+        cache.configure({"size": -1})
+        manifest = make_manifest()
+        cache.get_chunk(KEY, manifest, 2).read()
+        out = cache.get_chunks(KEY, manifest, [1, 2, 3, 4])
+        assert out == [bytes([i]) * CHUNK for i in (1, 2, 3, 4)]
+        # One single-chunk load + one batched load of the 3 missing chunks.
+        assert sorted(map(sorted, delegate.calls)) == [[1, 3, 4], [2]]
+
+    def test_prefetch_populates_following_chunks(self):
+        delegate = CountingChunkManager()
+        cache = MemoryChunkCache(delegate)
+        cache.configure({"size": -1, "prefetch.max.size": CHUNK * 2})
+        # 3-chunk segment so later accesses have nothing new to prefetch
+        # (deterministic delegate call set).
+        manifest = make_manifest(n_chunks=3)
+        cache.get_chunk(KEY, manifest, 0).read()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if (cache._cache.get_if_present(ChunkKey.of(KEY, 1)) is not None
+                    and cache._cache.get_if_present(ChunkKey.of(KEY, 2)) is not None):
+                break
+            time.sleep(0.01)
+        # Chunks 1 and 2 were prefetched; serving them adds no delegate call.
+        n_calls = len(delegate.calls)
+        cache.get_chunk(KEY, manifest, 1).read()
+        cache.get_chunk(KEY, manifest, 2).read()
+        assert len(delegate.calls) == n_calls
+        flat = sorted(c for call in delegate.calls for c in call)
+        assert flat == [0, 1, 2]
+
+    def test_get_timeout(self):
+        delegate = CountingChunkManager(delay_s=1.0)
+        cache = MemoryChunkCache(delegate)
+        cache.configure({"size": -1, "get.timeout.ms": 50})
+        with pytest.raises(ChunkCacheTimeoutException):
+            cache.get_chunk(KEY, make_manifest(), 0)
+
+
+class TestDiskChunkCache:
+    def test_cache_files_lifecycle(self, tmp_path):
+        delegate = CountingChunkManager()
+        cache = DiskChunkCache(delegate)
+        cache.configure({"size": -1, "path": str(tmp_path)})
+        manifest = make_manifest()
+        data = cache.get_chunk(KEY, manifest, 5).read()
+        assert data == bytes([5]) * CHUNK
+        # Cached under the key path plus a generation suffix.
+        [cached_file] = (tmp_path / "cache").glob(f"{ChunkKey.of(KEY, 5).path}.*")
+        assert cached_file.read_bytes() == data
+        assert list((tmp_path / "temp").iterdir()) == []
+        cache._cache.invalidate(ChunkKey.of(KEY, 5))
+        time.sleep(0.05)
+        assert not cached_file.exists()
+
+    def test_size_eviction_deletes_files(self, tmp_path):
+        delegate = CountingChunkManager()
+        cache = DiskChunkCache(delegate)
+        cache.configure({"size": CHUNK * 2, "path": str(tmp_path)})
+        manifest = make_manifest()
+        for cid in range(4):
+            cache.get_chunk(KEY, manifest, cid).read()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            files = list((tmp_path / "cache").iterdir())
+            if len(files) <= 2:
+                break
+            time.sleep(0.01)
+        assert len(list((tmp_path / "cache").iterdir())) <= 2
+
+    def test_startup_wipes_directory(self, tmp_path):
+        (tmp_path / "cache").mkdir()
+        (tmp_path / "cache" / "stale-file").write_bytes(b"old")
+        DiskChunkCacheConfig({"size": -1, "path": str(tmp_path)})
+        assert not (tmp_path / "cache" / "stale-file").exists()
+
+    def test_path_must_exist(self, tmp_path):
+        with pytest.raises(ConfigException):
+            DiskChunkCacheConfig({"size": -1, "path": str(tmp_path / "nope")})
+
+
+# ------------------------------------------------------------------- factory
+class TestChunkManagerFactory:
+    def test_no_cache_by_default(self):
+        factory = ChunkManagerFactory()
+        factory.configure({})
+        cm = factory.init_chunk_manager(None, None)
+        assert isinstance(cm, DefaultChunkManager)
+
+    def test_wraps_in_configured_cache(self, tmp_path):
+        factory = ChunkManagerFactory()
+        factory.configure({
+            "fetch.chunk.cache.class":
+                "tieredstorage_tpu.fetch.cache.disk.DiskChunkCache",
+            "fetch.chunk.cache.size": 1024,
+            "fetch.chunk.cache.path": str(tmp_path),
+        })
+        cm = factory.init_chunk_manager(None, None)
+        assert isinstance(cm, DiskChunkCache)
+        assert cm._config.cache_size == 1024
+
+    def test_invalid_class_rejected(self):
+        factory = ChunkManagerFactory()
+        with pytest.raises(ConfigException):
+            factory.configure({"fetch.chunk.cache.class": "io.BytesIO"})
+
+
+# --------------------------------------------------- RSM with caches (matrix)
+@pytest.mark.parametrize("cache_class", [
+    "tieredstorage_tpu.fetch.cache.memory.MemoryChunkCache",
+    "tieredstorage_tpu.fetch.cache.disk.DiskChunkCache",
+])
+@pytest.mark.parametrize("compression,encryption", [(False, False), (True, True)])
+def test_rsm_lifecycle_with_chunk_cache(tmp_path, cache_class, compression, encryption):
+    from tests.test_rsm_lifecycle import (
+        CHUNK_SIZE, SEGMENT_SIZE, make_rsm, make_segment_data, segment_metadata as _,
+    )
+    from tests.test_rsm_lifecycle import RemoteLogSegmentMetadata, RemoteLogSegmentId
+    from tests.test_rsm_lifecycle import TopicIdPartition, TopicPartition, TOPIC_ID, SEGMENT_ID
+
+    extra = {
+        "fetch.chunk.cache.class": cache_class,
+        "fetch.chunk.cache.size": -1,
+        "fetch.chunk.cache.prefetch.max.size": 4 * CHUNK_SIZE,
+    }
+    if cache_class.endswith("DiskChunkCache"):
+        cache_dir = tmp_path / "chunk-cache"
+        cache_dir.mkdir()
+        extra["fetch.chunk.cache.path"] = str(cache_dir)
+    rsm, storage_root = make_rsm(
+        tmp_path, compression, encryption, extra_configs=extra
+    )
+    metadata = RemoteLogSegmentMetadata(
+        remote_log_segment_id=RemoteLogSegmentId(
+            TopicIdPartition(TOPIC_ID, TopicPartition("topic", 7)), SEGMENT_ID
+        ),
+        start_offset=23, end_offset=2000, segment_size_in_bytes=SEGMENT_SIZE,
+    )
+    segment_data = make_segment_data(tmp_path, with_txn=True)
+    original = segment_data.log_segment.read_bytes()
+    rsm.copy_log_segment_data(metadata, segment_data)
+    # Twice: cold then cache-served; both must round-trip the same bytes.
+    for _round in range(2):
+        with rsm.fetch_log_segment(metadata, 0) as s:
+            assert s.read() == original
+        for start, end in [(0, 99), (1023, 1025), (SEGMENT_SIZE - 5, SEGMENT_SIZE - 1)]:
+            with rsm.fetch_log_segment(metadata, start, end) as s:
+                assert s.read() == original[start:end + 1]
+    cache = rsm._chunk_manager
+    assert cache.stats.hits > 0
+    rsm.close()

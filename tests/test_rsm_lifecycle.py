@@ -83,7 +83,8 @@ def make_segment_data(tmp_path: Path, with_txn: bool, compressed: bool = False) 
     )
 
 
-def make_rsm(tmp_path: Path, compression: bool, encryption: bool, chunk_size: int = CHUNK_SIZE):
+def make_rsm(tmp_path: Path, compression: bool, encryption: bool, chunk_size: int = CHUNK_SIZE,
+             extra_configs: dict | None = None):
     storage_root = tmp_path / "remote-storage"
     storage_root.mkdir(exist_ok=True)
     configs = {
@@ -95,6 +96,7 @@ def make_rsm(tmp_path: Path, compression: bool, encryption: bool, chunk_size: in
         "compression.enabled": compression,
         "encryption.enabled": encryption,
     }
+    configs.update(extra_configs or {})
     if encryption:
         pub, priv = generate_key_pair_pem_files(tmp_path, prefix="rsm")
         configs.update({

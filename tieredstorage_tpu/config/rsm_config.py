@@ -158,6 +158,9 @@ class RemoteStorageManagerConfig:
         return paths
 
     # --- accessors ---
+    def raw_props(self) -> dict[str, Any]:
+        return dict(self._props)
+
     @property
     def storage_backend_class(self) -> type:
         return self._values["storage.backend.class"]

@@ -1,0 +1,5 @@
+from tieredstorage_tpu.fetch.cache.chunk_cache import ChunkCache, ChunkKey
+from tieredstorage_tpu.fetch.cache.disk import DiskChunkCache
+from tieredstorage_tpu.fetch.cache.memory import MemoryChunkCache
+
+__all__ = ["ChunkCache", "ChunkKey", "DiskChunkCache", "MemoryChunkCache"]

@@ -1,0 +1,205 @@
+"""Caching chunk manager with single-flight population and async prefetch.
+
+Reference: core/.../fetch/cache/ChunkCache.java — `getChunk` computes through
+the async cache (miss → delegate fetch+detransform → `cacheChunk`; hit →
+`cachedChunkToInputStream`), bounded by `get.timeout.ms` (:76-131); on every
+access it asynchronously populates all chunks covering the next
+`prefetch.max.size` original bytes (`startPrefetching` :159-184); the cache is
+weight-bounded with expire-after-access and a removal listener (:139-157),
+running on its own pool (`thread.pool.size`).
+
+Extended TPU-first: `get_chunks` serves whole chunk windows — missing chunks
+in a window are fetched with ONE ranged request and detransformed in ONE
+batched backend call (the TPU detransform unit), then cached individually.
+"""
+
+from __future__ import annotations
+
+import abc
+import concurrent.futures
+import dataclasses
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, BinaryIO, Generic, Mapping, Optional, Sequence, TypeVar
+
+from tieredstorage_tpu.config.cache_config import ChunkCacheConfig
+from tieredstorage_tpu.fetch.chunk_manager import ChunkManager
+from tieredstorage_tpu.manifest.segment_manifest import SegmentManifestV1
+from tieredstorage_tpu.storage.core import ObjectKey
+from tieredstorage_tpu.utils.caching import LoadingCache, RemovalCause
+
+T = TypeVar("T")
+
+
+@dataclasses.dataclass(frozen=True)
+class ChunkKey:
+    """Cache key: segment object file name + chunk id (reference
+    fetch/ChunkKey.java:22-64); `path` is the on-disk cache file name."""
+
+    segment_file_name: str
+    chunk_id: int
+
+    @classmethod
+    def of(cls, object_key: ObjectKey, chunk_id: int) -> "ChunkKey":
+        return cls(object_key.value.rsplit("/", 1)[-1], chunk_id)
+
+    @property
+    def path(self) -> str:
+        return f"{self.segment_file_name}-{self.chunk_id}"
+
+
+class ChunkCacheTimeoutException(RuntimeError):
+    pass
+
+
+class ChunkCache(ChunkManager, Generic[T], abc.ABC):
+    """Wraps a delegate ChunkManager; subclasses define the cached form T
+    (bytes in memory, Path on disk)."""
+
+    def __init__(self, delegate: ChunkManager) -> None:
+        self._delegate = delegate
+        self._config: Optional[ChunkCacheConfig] = None
+        self._cache: Optional[LoadingCache[ChunkKey, T]] = None
+        self._executor: Optional[ThreadPoolExecutor] = None
+
+    # ------------------------------------------------------------------ setup
+    def configure(self, configs: Mapping[str, Any]) -> None:
+        self._config = self._parse_config(configs)
+        self._executor = ThreadPoolExecutor(
+            max_workers=self._config.thread_pool_size or None,
+            thread_name_prefix="chunk-cache",
+        )
+        self._cache = LoadingCache(
+            executor=self._executor,
+            max_weight=self._config.cache_size,
+            weigher=self.weight_of,
+            expire_after_access_s=self._config.retention_s,
+            removal_listener=self.on_removal,
+        )
+
+    def _parse_config(self, configs: Mapping[str, Any]) -> ChunkCacheConfig:
+        return ChunkCacheConfig(configs)
+
+    @property
+    def stats(self):
+        return self._cache.stats
+
+    def close(self) -> None:
+        # Drain in-flight loads before returning: callers close the transform
+        # backend right after, and a loader thread must not reach a closed
+        # backend (delegate.get_chunks -> backend.detransform).
+        if self._executor is not None:
+            self._executor.shutdown(wait=True, cancel_futures=True)
+
+    # ------------------------------------------------------------------ reads
+    def get_chunk(
+        self, objects_key: ObjectKey, manifest: SegmentManifestV1, chunk_id: int
+    ) -> BinaryIO:
+        self._start_prefetching(objects_key, manifest, chunk_id)
+        key = ChunkKey.of(objects_key, chunk_id)
+
+        def load() -> T:
+            data = self._delegate.get_chunks(objects_key, manifest, [chunk_id])[0]
+            return self.cache_chunk(key, data)
+
+        try:
+            value = self._cache.get(key, load, timeout=self._config.get_timeout_s)
+        except concurrent.futures.TimeoutError:
+            raise ChunkCacheTimeoutException(
+                f"Loading {key} timed out after {self._config.get_timeout_s}s"
+            ) from None
+        return self.cached_chunk_to_stream(value)
+
+    def get_chunks(
+        self, objects_key: ObjectKey, manifest: SegmentManifestV1, chunk_ids: Sequence[int]
+    ) -> list[bytes]:
+        """Window read: missing chunks of the window load through ONE delegate
+        batch (single ranged GET + one batched detransform), cached chunks are
+        served from the cache; single-flight is preserved per chunk."""
+        if not chunk_ids:
+            return []
+        self._start_prefetching(objects_key, manifest, chunk_ids[-1])
+        futures = self._populate_window(objects_key, manifest, chunk_ids)
+        out = []
+        for cid in chunk_ids:
+            try:
+                value = futures[cid].result(self._config.get_timeout_s)
+            except concurrent.futures.TimeoutError:
+                raise ChunkCacheTimeoutException(
+                    f"Loading chunk {cid} of {objects_key} timed out"
+                ) from None
+            with self.cached_chunk_to_stream(value) as stream:
+                out.append(stream.read())
+        return out
+
+    def _populate_window(
+        self,
+        objects_key: ObjectKey,
+        manifest: SegmentManifestV1,
+        chunk_ids: Sequence[int],
+    ) -> dict[int, "concurrent.futures.Future[T]"]:
+        """Batch-fetch every not-yet-cached chunk of the window with ONE
+        delegate call (in the calling thread — never holding an executor
+        worker across the network fetch), then register per-chunk cache
+        loaders that only persist the already-fetched bytes. Single-flight per
+        chunk is preserved: if another thread registered a key first,
+        get_future returns that load and our bytes for it go unused."""
+        missing: list[int] = []
+        futures: dict[int, "concurrent.futures.Future[T]"] = {}
+        for cid in chunk_ids:
+            present = self._cache.get_if_present(ChunkKey.of(objects_key, cid))
+            if present is not None:
+                futures[cid] = present
+            else:
+                missing.append(cid)
+        if missing:
+            fetched = dict(zip(
+                missing, self._delegate.get_chunks(objects_key, manifest, missing)
+            ))
+            for cid in missing:
+                key = ChunkKey.of(objects_key, cid)
+                data = fetched[cid]
+                futures[cid] = self._cache.get_future(
+                    key, lambda k=key, d=data: self.cache_chunk(k, d)
+                )
+        return futures
+
+    # --------------------------------------------------------------- prefetch
+    def _start_prefetching(
+        self, objects_key: ObjectKey, manifest: SegmentManifestV1, current_chunk_id: int
+    ) -> None:
+        prefetch_bytes = self._config.prefetch_max_size
+        if prefetch_bytes <= 0:
+            return
+        index = manifest.chunk_index
+        current = index._chunk_at(current_chunk_id)
+        start = current.original_position + current.original_size
+        if start >= index.original_file_size:
+            return
+        end = min(start + prefetch_bytes - 1, index.original_file_size - 1)
+        first = index.find_chunk_for_original_offset(start)
+        last = index.find_chunk_for_original_offset(end)
+        ids = [
+            cid
+            for cid in range(first.id, last.id + 1)
+            if self._cache.get_if_present(ChunkKey.of(objects_key, cid)) is None
+        ]
+        if not ids:
+            return
+        # Fire-and-forget: one batched load covers the whole prefetch window.
+        self._executor.submit(self._populate_window, objects_key, manifest, ids)
+
+    # ------------------------------------------------------------- subclasses
+    @abc.abstractmethod
+    def cache_chunk(self, chunk_key: ChunkKey, chunk: bytes) -> T:
+        """Persist the plaintext chunk in the cached form."""
+
+    @abc.abstractmethod
+    def cached_chunk_to_stream(self, cached: T) -> BinaryIO:
+        """Reopen a cached chunk as a readable stream."""
+
+    @abc.abstractmethod
+    def weight_of(self, cached: T) -> int:
+        """Weight of a cached chunk for the size bound."""
+
+    def on_removal(self, chunk_key: ChunkKey, cached: T, cause: RemovalCause) -> None:
+        """Removal listener; disk cache deletes the file here."""

@@ -1,0 +1,65 @@
+"""ChunkManagerFactory: optionally wrap the default manager in a chunk cache.
+
+Reference: core/.../fetch/ChunkManagerFactory.java:36-52 (reflective wrap of
+DefaultChunkManager in the configured ChunkCache subclass) and
+config/ChunkManagerFactoryConfig.java:29-55 (`fetch.chunk.cache.class`,
+subclass-of-ChunkCache validated, no cache when unset).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Optional
+
+from tieredstorage_tpu.config.configdef import (
+    ConfigDef,
+    ConfigKey,
+    subclass_of,
+    subset_with_prefix,
+)
+from tieredstorage_tpu.config.rsm_config import FETCH_CHUNK_CACHE_PREFIX
+from tieredstorage_tpu.fetch.cache.chunk_cache import ChunkCache
+from tieredstorage_tpu.fetch.chunk_manager import ChunkManager, DefaultChunkManager
+from tieredstorage_tpu.storage.core import ObjectFetcher
+from tieredstorage_tpu.transform.api import TransformBackend
+
+
+class ChunkManagerFactoryConfig:
+    def __init__(self, props: Mapping[str, Any]):
+        d = ConfigDef()
+        d.define(ConfigKey(
+            "fetch.chunk.cache.class", "class", default=None,
+            validator=subclass_of(ChunkCache), importance="medium",
+            doc="Chunk cache implementation. There are 2 implementations "
+                "included: MemoryChunkCache and DiskChunkCache. Unset means "
+                "no chunk caching.",
+        ))
+        self._values = d.parse(props)
+        self._props = dict(props)
+
+    @property
+    def chunk_cache_class(self) -> Optional[type]:
+        return self._values["fetch.chunk.cache.class"]
+
+    def chunk_cache_configs(self) -> dict[str, Any]:
+        # The stray "class" key the strip produces is ignored by the cache's
+        # ConfigDef (undefined keys are skipped by parse).
+        return subset_with_prefix(self._props, FETCH_CHUNK_CACHE_PREFIX)
+
+
+class ChunkManagerFactory:
+    def __init__(self) -> None:
+        self._config: Optional[ChunkManagerFactoryConfig] = None
+
+    def configure(self, configs: Mapping[str, Any]) -> None:
+        self._config = ChunkManagerFactoryConfig(configs)
+
+    def init_chunk_manager(
+        self, fetcher: ObjectFetcher, transform_backend: TransformBackend
+    ) -> ChunkManager:
+        default = DefaultChunkManager(fetcher, transform_backend)
+        cache_class = self._config.chunk_cache_class
+        if cache_class is None:
+            return default
+        cache: ChunkCache = cache_class(default)
+        cache.configure(self._config.chunk_cache_configs())
+        return cache

@@ -27,7 +27,8 @@ from tieredstorage_tpu.custom_metadata import (
     serialize_custom_metadata,
 )
 from tieredstorage_tpu.errors import RemoteResourceNotFoundException, RemoteStorageException
-from tieredstorage_tpu.fetch.chunk_manager import ChunkManager, DefaultChunkManager
+from tieredstorage_tpu.fetch.chunk_manager import ChunkManager
+from tieredstorage_tpu.fetch.factory import ChunkManagerFactory
 from tieredstorage_tpu.fetch.enumeration import FetchChunkEnumeration
 from tieredstorage_tpu.kafka_records import InvalidRecordBatchException, segment_looks_compressed
 from tieredstorage_tpu.manifest.encryption_metadata import SegmentEncryptionMetadataV1
@@ -95,7 +96,9 @@ class RemoteStorageManager:
         self._chunk_manager = self._build_chunk_manager(backend)
 
     def _build_chunk_manager(self, backend) -> ChunkManager:
-        return DefaultChunkManager(self._storage, backend)
+        factory = ChunkManagerFactory()
+        factory.configure(self._config.raw_props())
+        return factory.init_chunk_manager(self._storage, backend)
 
     def _require_configured(self) -> RemoteStorageManagerConfig:
         if self._config is None:
@@ -386,6 +389,8 @@ class RemoteStorageManager:
             self._storage.delete_all(keys)
 
     def close(self) -> None:
+        if self._chunk_manager is not None and hasattr(self._chunk_manager, "close"):
+            self._chunk_manager.close()
         if self._transform_backend is not None:
             self._transform_backend.close()
 
