@@ -226,6 +226,18 @@ class TestDiskChunkCache:
             time.sleep(0.01)
         assert len(list((tmp_path / "cache").iterdir())) <= 2
 
+    def test_window_larger_than_cache_bound_still_serves(self, tmp_path):
+        # Eviction can unlink a cached file between future resolution and
+        # reopen when the bound is smaller than one read window; the read
+        # path must retry and still serve correct bytes.
+        delegate = CountingChunkManager()
+        cache = DiskChunkCache(delegate)
+        cache.configure({"size": CHUNK * 2, "path": str(tmp_path)})
+        manifest = make_manifest()
+        for _ in range(3):
+            out = cache.get_chunks(KEY, manifest, [0, 1, 2, 3, 4, 5])
+            assert out == [bytes([i]) * CHUNK for i in range(6)]
+
     def test_startup_wipes_directory(self, tmp_path):
         (tmp_path / "cache").mkdir()
         (tmp_path / "cache" / "stale-file").write_bytes(b"old")

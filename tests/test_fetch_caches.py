@@ -1,0 +1,120 @@
+"""Manifest + segment-indexes cache tests.
+
+Reference model: fetch/manifest/MemorySegmentManifestCache (1000 entries/1h,
+:51-52) and fetch/index/MemorySegmentIndexesCache (10 MiB weight cap :55,
+single-flight supplier :93-120).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tieredstorage_tpu.errors import RemoteResourceNotFoundException
+from tieredstorage_tpu.fetch.index_cache import MemorySegmentIndexesCache
+from tieredstorage_tpu.fetch.manifest_cache import MemorySegmentManifestCache
+from tieredstorage_tpu.manifest.segment_indexes import IndexType
+from tieredstorage_tpu.storage.core import ObjectKey
+
+from tests.test_rsm_lifecycle import (
+    EXPECTED_MAIN,
+    make_rsm,
+    make_segment_data,
+)
+from tests.test_rsm_lifecycle import (
+    SEGMENT_SIZE, TOPIC_ID, SEGMENT_ID,
+    RemoteLogSegmentId, RemoteLogSegmentMetadata, TopicIdPartition, TopicPartition,
+)
+
+KEY = ObjectKey(value="a/b/c.rsm-manifest")
+
+
+def make_metadata():
+    return RemoteLogSegmentMetadata(
+        remote_log_segment_id=RemoteLogSegmentId(
+            TopicIdPartition(TOPIC_ID, TopicPartition("topic", 7)), SEGMENT_ID
+        ),
+        start_offset=23, end_offset=2000, segment_size_in_bytes=SEGMENT_SIZE,
+    )
+
+
+class TestManifestCacheUnit:
+    def test_single_load_then_hits(self):
+        cache = MemorySegmentManifestCache()
+        cache.configure({})
+        loads = []
+
+        def loader():
+            loads.append(1)
+            return "manifest"  # opaque to the cache
+
+        assert cache.get(KEY, loader) == "manifest"
+        assert cache.get(KEY, loader) == "manifest"
+        assert len(loads) == 1
+        assert cache.stats.hits == 1
+
+    def test_entry_count_eviction(self):
+        cache = MemorySegmentManifestCache()
+        cache.configure({"size": 2})
+        for i in range(4):
+            cache.get(ObjectKey(value=f"k{i}"), lambda i=i: f"m{i}")
+        import time
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and len(cache._cache) > 2:
+            time.sleep(0.01)
+        assert len(cache._cache) <= 2
+
+    def test_load_failure_propagates_and_retries(self):
+        cache = MemorySegmentManifestCache()
+        cache.configure({})
+        with pytest.raises(KeyError):
+            cache.get(KEY, lambda: (_ for _ in ()).throw(KeyError("gone")))
+        assert cache.get(KEY, lambda: "ok") == "ok"
+
+
+class TestIndexesCacheUnit:
+    def test_keyed_by_object_and_type(self):
+        cache = MemorySegmentIndexesCache()
+        cache.configure({})
+        a = cache.get(KEY, IndexType.OFFSET, lambda: b"offset-bytes")
+        b = cache.get(KEY, IndexType.TIMESTAMP, lambda: b"time-bytes")
+        assert (a, b) == (b"offset-bytes", b"time-bytes")
+        # Same (key, type) is a hit; different type was a separate load.
+        assert cache.get(KEY, IndexType.OFFSET, lambda: b"NEW") == b"offset-bytes"
+        assert cache.stats.misses == 2
+        assert cache.stats.hits == 1
+
+    def test_byte_weight_eviction(self):
+        cache = MemorySegmentIndexesCache()
+        cache.configure({"size": 100})
+        import time
+        for i in range(5):
+            cache.get(ObjectKey(value=f"k{i}"), IndexType.OFFSET, lambda: b"x" * 40)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and cache._cache.total_weight > 100:
+            time.sleep(0.01)
+        assert cache._cache.total_weight <= 100
+
+
+class TestRsmCaching:
+    def test_manifest_and_index_served_from_cache_after_object_deleted(self, tmp_path):
+        rsm, storage_root = make_rsm(tmp_path, compression=False, encryption=False)
+        metadata = make_metadata()
+        rsm.copy_log_segment_data(metadata, make_segment_data(tmp_path, with_txn=True))
+        original = (tmp_path / "00000000000000000023.log").read_bytes()
+
+        # Prime both caches.
+        with rsm.fetch_log_segment(metadata, 0, 99) as s:
+            assert s.read() == original[:100]
+        assert rsm.fetch_index(metadata, IndexType.OFFSET).read() == b"OFFSETIDX" * 16
+
+        # Remove manifest + indexes objects from the store: cached entries
+        # must keep serving, uncached index types must miss loudly.
+        (storage_root / f"test/{EXPECTED_MAIN}.rsm-manifest").unlink()
+        (storage_root / f"test/{EXPECTED_MAIN}.indexes").unlink()
+
+        with rsm.fetch_log_segment(metadata, 100, 199) as s:
+            assert s.read() == original[100:200]
+        assert rsm.fetch_index(metadata, IndexType.OFFSET).read() == b"OFFSETIDX" * 16
+        with pytest.raises(RemoteResourceNotFoundException):
+            rsm.fetch_index(metadata, IndexType.TIMESTAMP)
+        rsm.close()

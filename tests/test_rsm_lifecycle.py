@@ -175,8 +175,12 @@ class TestLifecycle:
         # --- delete removes everything ---
         rsm.delete_log_segment_data(segment_metadata)
         assert [p for p in storage_root.rglob("*") if p.is_file()] == []
+        # The manifest stays cached after delete (reference semantics: caches
+        # are not invalidated on delete), so the miss surfaces when the lazy
+        # stream first fetches a chunk of the deleted .log object.
         with pytest.raises(RemoteResourceNotFoundException):
-            rsm.fetch_log_segment(segment_metadata, 0)
+            with rsm.fetch_log_segment(segment_metadata, 0) as s:
+                s.read()
 
     def test_encrypted_bytes_differ_and_decrypt_via_manifest(
         self, tmp_path, segment_metadata, segment_data, compression, encryption

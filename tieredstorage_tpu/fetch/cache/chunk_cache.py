@@ -18,6 +18,8 @@ from __future__ import annotations
 import abc
 import concurrent.futures
 import dataclasses
+import io
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, BinaryIO, Generic, Mapping, Optional, Sequence, TypeVar
 
@@ -94,70 +96,97 @@ class ChunkCache(ChunkManager, Generic[T], abc.ABC):
     def get_chunk(
         self, objects_key: ObjectKey, manifest: SegmentManifestV1, chunk_id: int
     ) -> BinaryIO:
-        self._start_prefetching(objects_key, manifest, chunk_id)
-        key = ChunkKey.of(objects_key, chunk_id)
-
-        def load() -> T:
-            data = self._delegate.get_chunks(objects_key, manifest, [chunk_id])[0]
-            return self.cache_chunk(key, data)
-
-        try:
-            value = self._cache.get(key, load, timeout=self._config.get_timeout_s)
-        except concurrent.futures.TimeoutError:
-            raise ChunkCacheTimeoutException(
-                f"Loading {key} timed out after {self._config.get_timeout_s}s"
-            ) from None
-        return self.cached_chunk_to_stream(value)
+        data = self.get_chunks(objects_key, manifest, [chunk_id])[0]
+        return io.BytesIO(data)
 
     def get_chunks(
         self, objects_key: ObjectKey, manifest: SegmentManifestV1, chunk_ids: Sequence[int]
     ) -> list[bytes]:
         """Window read: missing chunks of the window load through ONE delegate
         batch (single ranged GET + one batched detransform), cached chunks are
-        served from the cache; single-flight is preserved per chunk."""
+        served from the cache; single-flight is preserved per chunk and the
+        whole window is bounded by ONE `get.timeout.ms` deadline."""
         if not chunk_ids:
             return []
+        deadline = time.monotonic() + self._config.get_timeout_s
         self._start_prefetching(objects_key, manifest, chunk_ids[-1])
-        futures = self._populate_window(objects_key, manifest, chunk_ids)
-        out = []
+        futures = self._populate_window(objects_key, manifest, chunk_ids, deadline)
+        out: dict[int, bytes] = {}
+        deleted: list[int] = []
         for cid in chunk_ids:
-            try:
-                value = futures[cid].result(self._config.get_timeout_s)
-            except concurrent.futures.TimeoutError:
-                raise ChunkCacheTimeoutException(
-                    f"Loading chunk {cid} of {objects_key} timed out"
-                ) from None
+            value = self._await(futures[cid], deadline, cid, objects_key)
+            data = self._read_cached(value)
+            if data is None:  # evicted + unlinked between resolve and open
+                self._cache.invalidate(ChunkKey.of(objects_key, cid))
+                deleted.append(cid)
+            else:
+                out[cid] = data
+        if deleted:
+            # Rare eviction race (cache bound smaller than the read window):
+            # re-fetch the affected chunks straight from the delegate, without
+            # re-caching — going through the cache again would just re-race
+            # with its own evictions.
+            refetched = self._delegate.get_chunks(objects_key, manifest, deleted)
+            out.update(zip(deleted, refetched))
+        return [out[cid] for cid in chunk_ids]
+
+    def _await(self, future, deadline: float, cid: int, objects_key: ObjectKey) -> T:
+        try:
+            return future.result(max(0.0, deadline - time.monotonic()))
+        except concurrent.futures.TimeoutError:
+            raise ChunkCacheTimeoutException(
+                f"Loading chunk {cid} of {objects_key} timed out"
+            ) from None
+
+    def _read_cached(self, value: T) -> Optional[bytes]:
+        try:
             with self.cached_chunk_to_stream(value) as stream:
-                out.append(stream.read())
-        return out
+                return stream.read()
+        except FileNotFoundError:
+            return None
 
     def _populate_window(
         self,
         objects_key: ObjectKey,
         manifest: SegmentManifestV1,
         chunk_ids: Sequence[int],
+        deadline: Optional[float],
     ) -> dict[int, "concurrent.futures.Future[T]"]:
         """Batch-fetch every not-yet-cached chunk of the window with ONE
-        delegate call (in the calling thread — never holding an executor
-        worker across the network fetch), then register per-chunk cache
-        loaders that only persist the already-fetched bytes. Single-flight per
-        chunk is preserved: if another thread registered a key first,
-        get_future returns that load and our bytes for it go unused."""
+        delegate call, then register per-chunk cache loaders that only persist
+        the already-fetched bytes (no network under an executor lock).
+        Single-flight per chunk is preserved: if another thread registered a
+        key first, get_future returns that load and our bytes go unused.
+
+        With a deadline (synchronous reads) the delegate fetch runs on the
+        pool and is awaited with the remaining budget, so `get.timeout.ms`
+        bounds a hung storage backend; without one (prefetch — already on a
+        pool worker) it runs inline."""
         missing: list[int] = []
         futures: dict[int, "concurrent.futures.Future[T]"] = {}
         for cid in chunk_ids:
-            present = self._cache.get_if_present(ChunkKey.of(objects_key, cid))
+            present = self._cache.peek(ChunkKey.of(objects_key, cid))
             if present is not None:
                 futures[cid] = present
+                self._cache.get_if_present(ChunkKey.of(objects_key, cid))  # hit + recency
             else:
                 missing.append(cid)
         if missing:
-            fetched = dict(zip(
-                missing, self._delegate.get_chunks(objects_key, manifest, missing)
-            ))
-            for cid in missing:
+            if deadline is None:
+                fetched_list = self._delegate.get_chunks(objects_key, manifest, missing)
+            else:
+                task = self._executor.submit(
+                    self._delegate.get_chunks, objects_key, manifest, missing
+                )
+                try:
+                    fetched_list = task.result(max(0.0, deadline - time.monotonic()))
+                except concurrent.futures.TimeoutError:
+                    task.cancel()
+                    raise ChunkCacheTimeoutException(
+                        f"Fetching chunks {missing} of {objects_key} timed out"
+                    ) from None
+            for cid, data in zip(missing, fetched_list):
                 key = ChunkKey.of(objects_key, cid)
-                data = fetched[cid]
                 futures[cid] = self._cache.get_future(
                     key, lambda k=key, d=data: self.cache_chunk(k, d)
                 )
@@ -181,12 +210,13 @@ class ChunkCache(ChunkManager, Generic[T], abc.ABC):
         ids = [
             cid
             for cid in range(first.id, last.id + 1)
-            if self._cache.get_if_present(ChunkKey.of(objects_key, cid)) is None
+            if self._cache.peek(ChunkKey.of(objects_key, cid)) is None
         ]
         if not ids:
             return
-        # Fire-and-forget: one batched load covers the whole prefetch window.
-        self._executor.submit(self._populate_window, objects_key, manifest, ids)
+        # Fire-and-forget: one batched load covers the whole prefetch window
+        # (deadline=None — already on a pool worker, fetch runs inline there).
+        self._executor.submit(self._populate_window, objects_key, manifest, ids, None)
 
     # ------------------------------------------------------------- subclasses
     @abc.abstractmethod

@@ -30,6 +30,8 @@ from tieredstorage_tpu.errors import RemoteResourceNotFoundException, RemoteStor
 from tieredstorage_tpu.fetch.chunk_manager import ChunkManager
 from tieredstorage_tpu.fetch.factory import ChunkManagerFactory
 from tieredstorage_tpu.fetch.enumeration import FetchChunkEnumeration
+from tieredstorage_tpu.fetch.index_cache import MemorySegmentIndexesCache
+from tieredstorage_tpu.fetch.manifest_cache import MemorySegmentManifestCache
 from tieredstorage_tpu.kafka_records import InvalidRecordBatchException, segment_looks_compressed
 from tieredstorage_tpu.manifest.encryption_metadata import SegmentEncryptionMetadataV1
 from tieredstorage_tpu.manifest.segment_indexes import IndexType, SegmentIndexesV1Builder
@@ -68,6 +70,8 @@ class RemoteStorageManager:
         self._rsa: Optional[RsaEncryptionProvider] = None
         self._rate_bucket: Optional[TokenBucket] = None
         self._chunk_manager: Optional[ChunkManager] = None
+        self._manifest_cache: Optional[MemorySegmentManifestCache] = None
+        self._indexes_cache: Optional[MemorySegmentIndexesCache] = None
         self._metrics = None
 
     # ------------------------------------------------------------------ setup
@@ -94,6 +98,11 @@ class RemoteStorageManager:
             self._rate_bucket = TokenBucket(config.upload_rate_limit)
 
         self._chunk_manager = self._build_chunk_manager(backend)
+
+        self._manifest_cache = MemorySegmentManifestCache()
+        self._manifest_cache.configure(config.fetch_manifest_cache_configs())
+        self._indexes_cache = MemorySegmentIndexesCache()
+        self._indexes_cache.configure(config.fetch_indexes_cache_configs())
 
     def _build_chunk_manager(self, backend) -> ChunkManager:
         factory = ChunkManagerFactory()
@@ -294,7 +303,7 @@ class RemoteStorageManager:
 
     def fetch_segment_manifest(self, metadata: RemoteLogSegmentMetadata) -> SegmentManifestV1:
         key = self._object_key(metadata, Suffix.MANIFEST)
-        return self._fetch_manifest_by_key(key)
+        return self._manifest_cache.get(key, lambda: self._fetch_manifest_by_key(key))
 
     def _fetch_manifest_by_key(self, key: ObjectKey) -> SegmentManifestV1:
         try:
@@ -353,7 +362,13 @@ class RemoteStorageManager:
             if segment_index.size == 0:
                 return io.BytesIO(b"")
             key = self._object_key(metadata, Suffix.INDEXES)
-            return io.BytesIO(self._fetch_index_bytes(key, segment_index.range(), manifest))
+            return io.BytesIO(
+                self._indexes_cache.get(
+                    key,
+                    index_type,
+                    lambda: self._fetch_index_bytes(key, segment_index.range(), manifest),
+                )
+            )
         except KeyNotFoundException as e:
             raise RemoteResourceNotFoundException(str(e)) from e
         except StorageBackendException as e:
@@ -391,6 +406,10 @@ class RemoteStorageManager:
     def close(self) -> None:
         if self._chunk_manager is not None and hasattr(self._chunk_manager, "close"):
             self._chunk_manager.close()
+        if self._manifest_cache is not None:
+            self._manifest_cache.close()
+        if self._indexes_cache is not None:
+            self._indexes_cache.close()
         if self._transform_backend is not None:
             self._transform_backend.close()
 

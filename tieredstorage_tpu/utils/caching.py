@@ -124,6 +124,15 @@ class LoadingCache(Generic[K, V]):
             self.stats.hits += 1
             return entry.future
 
+    def peek(self, key: K) -> Optional["Future[V]"]:
+        """Presence probe that records NO stats and does not refresh recency —
+        for internal prefetch/window planning, so exported hit rates reflect
+        only real accesses."""
+        with self._lock:
+            self._expire_stale_locked()
+            entry = self._entries.get(key)
+            return None if entry is None else entry.future
+
     # ----------------------------------------------------------------- writes
     def _load(self, key: K, loader: Callable[[], V], future: "Future[V]") -> None:
         start = time.monotonic_ns()
@@ -139,6 +148,7 @@ class LoadingCache(Generic[K, V]):
             future.set_exception(e)
             return
         evicted: list[tuple[K, V, RemovalCause]] = []
+        orphaned = False
         with self._lock:
             self.stats.load_successes += 1
             self.stats.total_load_time_ns += time.monotonic_ns() - start
@@ -147,8 +157,14 @@ class LoadingCache(Generic[K, V]):
                 entry.weight = self._weigher(value)
                 self._total_weight += entry.weight
                 evicted = self._evict_over_weight_locked(keep=key)
+            else:
+                # The entry was invalidated while loading: the value was never
+                # accounted, so clean it up (disk caches unlink the file here).
+                orphaned = True
         future.set_result(value)
         self._notify(evicted)
+        if orphaned:
+            self._notify([(key, value, RemovalCause.EXPLICIT)])
 
     def invalidate(self, key: K) -> None:
         self._remove(key, RemovalCause.EXPLICIT)
