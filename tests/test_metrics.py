@@ -1,0 +1,185 @@
+"""Metrics tests: stat math, sensor fan-out, RSM metric families and tag
+scopes, cache/disk/thread-pool exporters.
+
+Reference model: core/src/test/java/.../RemoteStorageManagerMetricsTest.java
+(every family asserted in 3 scopes) and metrics/MetricsRegistry naming.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tieredstorage_tpu.metrics.core import (
+    Avg, Count, Max, MetricConfig, MetricName, MetricsRegistry, Rate, Total,
+)
+from tieredstorage_tpu.metrics.rsm_metrics import METRIC_GROUP, Metrics
+
+from tests.test_rsm_lifecycle import make_rsm, make_segment_data
+from tests.test_fetch_caches import make_metadata
+
+
+class TestStats:
+    def setup_method(self):
+        self.now = [0.0]
+        self.registry = MetricsRegistry(
+            MetricConfig(num_samples=2, sample_window_ms=30_000),
+            time_source=lambda: self.now[0],
+        )
+
+    def test_total_and_count(self):
+        s = self.registry.sensor("s")
+        s.add(MetricName.of("v-total", "g"), Total())
+        s.add(MetricName.of("v-count", "g"), Count())
+        for v in (5.0, 7.0, 1.0):
+            s.record(v)
+        assert self.registry.value(MetricName.of("v-total", "g")) == 13.0
+        assert self.registry.value(MetricName.of("v-count", "g")) == 3.0
+
+    def test_avg_max_windowed(self):
+        s = self.registry.sensor("s")
+        s.add(MetricName.of("t-avg", "g"), Avg())
+        s.add(MetricName.of("t-max", "g"), Max())
+        s.record(10.0)
+        self.now[0] = 1.0
+        s.record(30.0)
+        assert self.registry.value(MetricName.of("t-avg", "g")) == 20.0
+        assert self.registry.value(MetricName.of("t-max", "g")) == 30.0
+        # Both samples age out after num_samples * window.
+        self.now[0] = 100.0
+        assert self.registry.value(MetricName.of("t-avg", "g")) == 0.0
+        assert self.registry.value(MetricName.of("t-max", "g")) == 0.0
+
+    def test_rate(self):
+        s = self.registry.sensor("s")
+        s.add(MetricName.of("b-rate", "g"), Rate())
+        for i in range(10):
+            self.now[0] = i * 1.0
+            s.record(300.0)
+        # 3000 units over >= (numSamples-1)*window = 30s floor.
+        assert self.registry.value(MetricName.of("b-rate", "g")) == pytest.approx(100.0)
+
+    def test_sensor_idempotent(self):
+        assert self.registry.sensor("same") is self.registry.sensor("same")
+
+    def test_custom_window_applied_on_record_path(self):
+        # 1s windows x 2 samples: events at t=0 and t=1.9 land in separate
+        # windows, so a snapshot at t=2.1 still sees the second event.
+        registry = MetricsRegistry(
+            MetricConfig(num_samples=2, sample_window_ms=1000),
+            time_source=lambda: self.now[0],
+        )
+        s = registry.sensor("s")
+        s.add(MetricName.of("x-max", "g"), Max())
+        self.now[0] = 0.0
+        s.record(5.0)
+        self.now[0] = 1.9
+        s.record(7.0)
+        self.now[0] = 2.1
+        assert registry.value(MetricName.of("x-max", "g")) == 7.0
+
+    def test_recording_level_gates_debug_sensors(self):
+        info_reg = MetricsRegistry(MetricConfig(recording_level="INFO"))
+        s = info_reg.sensor("dbg", recording_level="DEBUG")
+        s.add(MetricName.of("d-total", "g"), Total())
+        s.record(5.0)
+        assert info_reg.value(MetricName.of("d-total", "g")) == 0.0
+
+        dbg_reg = MetricsRegistry(MetricConfig(recording_level="DEBUG"))
+        s2 = dbg_reg.sensor("dbg", recording_level="DEBUG")
+        s2.add(MetricName.of("d-total", "g"), Total())
+        s2.record(5.0)
+        assert dbg_reg.value(MetricName.of("d-total", "g")) == 5.0
+
+    def test_ensure_stats_registers_once(self):
+        s = self.registry.sensor("once")
+        for _ in range(3):
+            s.ensure_stats(lambda: [(MetricName.of("o-total", "g"), Total())])
+            s.record(1.0)
+        assert self.registry.value(MetricName.of("o-total", "g")) == 3.0
+        assert len(s._stats) == 1
+
+
+class TestRsmMetrics:
+    def test_scopes_and_families(self):
+        m = Metrics()
+        m.record_segment_copy_time("t1", 3, 250.0)
+        m.record_object_upload("t1", 3, "log", 1000)
+        m.record_segment_delete("t1", 3, 4096)
+        m.record_segment_delete_error("t1", 3)
+        m.record_segment_fetch_requested_bytes("t1", 3, 512)
+        snap = m.snapshot()
+
+        def v(name, **tags):
+            [mn] = m.registry.find(name, tags)
+            return m.registry.value(mn)
+
+        # Aggregate / topic / partition scopes all record.
+        assert v("segment-copy-time-avg") == 250.0
+        assert v("segment-copy-time-avg", topic="t1") == 250.0
+        assert v("segment-copy-time-avg", topic="t1", partition="3") == 250.0
+        # Upload also by object-type.
+        assert v("object-upload-bytes-total") == 1000.0
+        assert v("object-upload-bytes-total", **{"object-type": "log"}) == 1000.0
+        assert v("object-upload-total", topic="t1", partition="3",
+                 **{"object-type": "log"}) == 1.0
+        assert v("segment-delete-bytes-total") == 4096.0
+        assert v("segment-delete-errors-total") == 1.0
+        assert v("segment-fetch-requested-bytes-total", topic="t1") == 512.0
+        # Every RSM family lives in the reference's metric group.
+        assert all(
+            mn.group == METRIC_GROUP for mn in m.registry.metric_names
+        ), snap
+
+    def test_multiple_topics_do_not_mix(self):
+        m = Metrics()
+        m.record_segment_delete("a", 0, 100)
+        m.record_segment_delete("b", 0, 900)
+
+        def v(name, **tags):
+            [mn] = m.registry.find(name, tags)
+            return m.registry.value(mn)
+
+        assert v("segment-delete-bytes-total") == 1000.0
+        assert v("segment-delete-bytes-total", topic="a") == 100.0
+        assert v("segment-delete-bytes-total", topic="b") == 900.0
+
+
+class TestRsmIntegrationMetrics:
+    def test_lifecycle_populates_metrics(self, tmp_path):
+        extra = {
+            "fetch.chunk.cache.class":
+                "tieredstorage_tpu.fetch.cache.disk.DiskChunkCache",
+            "fetch.chunk.cache.size": -1,
+            "fetch.chunk.cache.path": str(tmp_path / "cc"),
+        }
+        (tmp_path / "cc").mkdir()
+        rsm, _ = make_rsm(tmp_path, compression=False, encryption=False,
+                          extra_configs=extra)
+        metadata = make_metadata()
+        rsm.copy_log_segment_data(metadata, make_segment_data(tmp_path, with_txn=True))
+        with rsm.fetch_log_segment(metadata, 0, 99) as s:
+            s.read()
+        with rsm.fetch_log_segment(metadata, 0, 99) as s:
+            s.read()
+        rsm.delete_log_segment_data(metadata)
+
+        reg = rsm.metrics.registry
+
+        def v(name, **tags):
+            [mn] = reg.find(name, tags)
+            return reg.value(mn)
+
+        assert v("segment-copy-time-avg", topic="topic", partition="7") > 0
+        assert v("object-upload-total") == 3.0  # log + indexes + manifest
+        assert v("object-upload-bytes-total", **{"object-type": "log"}) > 0
+        assert v("segment-fetch-requested-bytes-total") == 200.0
+        assert v("segment-delete-total") == 1.0
+        assert v("segment-delete-time-avg") >= 0
+
+        # Cache exporters: manifest cache saw 1 miss + 1 hit; disk cache wrote.
+        assert v("cache-misses-total", cache="segment-manifest-cache") == 1.0
+        assert v("cache-hits-total", cache="segment-manifest-cache") == 1.0
+        assert v("write-total", cache="disk-chunk-cache") >= 1.0
+        assert v("write-bytes-total", cache="disk-chunk-cache") > 0
+        assert v("parallelism", pool="chunk-cache-pool") > 0
+        rsm.close()

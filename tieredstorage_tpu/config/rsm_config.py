@@ -219,6 +219,18 @@ class RemoteStorageManagerConfig:
     def custom_metadata_fields_include(self) -> list[str]:
         return self._values["custom.metadata.fields.include"]
 
+    @property
+    def metrics_num_samples(self) -> int:
+        return self._values["metrics.num.samples"]
+
+    @property
+    def metrics_sample_window_ms(self) -> int:
+        return self._values["metrics.sample.window.ms"]
+
+    @property
+    def metrics_recording_level(self) -> str:
+        return self._values["metrics.recording.level"]
+
     def fetch_chunk_cache_configs(self) -> dict[str, Any]:
         return subset_with_prefix(self._props, FETCH_CHUNK_CACHE_PREFIX)
 

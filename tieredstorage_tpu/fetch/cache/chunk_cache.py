@@ -85,6 +85,18 @@ class ChunkCache(ChunkManager, Generic[T], abc.ABC):
     def stats(self):
         return self._cache.stats
 
+    @property
+    def size(self) -> int:
+        return len(self._cache)
+
+    @property
+    def total_weight(self) -> int:
+        return self._cache.total_weight
+
+    @property
+    def executor(self) -> ThreadPoolExecutor:
+        return self._executor
+
     def close(self) -> None:
         # Drain in-flight loads before returning: callers close the transform
         # backend right after, and a loader thread must not reach a closed

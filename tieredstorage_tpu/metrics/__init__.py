@@ -1,0 +1,17 @@
+from tieredstorage_tpu.metrics.core import (
+    Avg,
+    Count,
+    Max,
+    MetricConfig,
+    MetricName,
+    MetricsRegistry,
+    Rate,
+    Sensor,
+    Total,
+)
+from tieredstorage_tpu.metrics.rsm_metrics import METRIC_GROUP, Metrics
+
+__all__ = [
+    "Avg", "Count", "Max", "MetricConfig", "MetricName", "MetricsRegistry",
+    "Rate", "Sensor", "Total", "Metrics", "METRIC_GROUP",
+]

@@ -1,0 +1,110 @@
+"""Cache + thread-pool metric exporters.
+
+Reference: core/.../metrics/CaffeineStatsCounter.java +
+CaffeineMetricsRegistry.java (hits/misses/load success+failure/eviction by
+cause/size under context `aiven.kafka.server.tieredstorage.cache`),
+DiskChunkCacheMetrics.java:38-68 (write/write-bytes/delete/delete-bytes
+rate+total), and ThreadPoolMonitor.java:40-66 (executor gauges under
+`...tieredstorage.thread-pool`). Our caches expose a `CacheStats` counter set
+(utils/caching.py) which these exporters publish as supplier gauges —
+point-in-time identical to Caffeine's cumulative stats.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+from tieredstorage_tpu.metrics.core import MetricName, MetricsRegistry, Rate, Total
+from tieredstorage_tpu.utils.caching import CacheStats, RemovalCause
+
+CACHE_METRIC_GROUP = "cache-metrics"
+THREAD_POOL_METRIC_GROUP = "thread-pool-metrics"
+
+
+def register_cache_metrics(
+    registry: MetricsRegistry, cache_name: str, stats: CacheStats,
+    size_supplier=None, weight_supplier=None,
+) -> None:
+    """Publish a cache's stats counters as gauges tagged cache=<name>."""
+    tags = {"cache": cache_name}
+
+    def gauge(name: str, supplier, description: str = "") -> None:
+        registry.add_gauge(
+            MetricName.of(name, CACHE_METRIC_GROUP, description, tags), supplier
+        )
+
+    gauge("cache-hits-total", lambda: stats.hits)
+    gauge("cache-misses-total", lambda: stats.misses)
+    gauge("cache-load-successes-total", lambda: stats.load_successes)
+    gauge("cache-load-failures-total", lambda: stats.load_failures)
+    gauge("cache-load-time-total-ns", lambda: stats.total_load_time_ns)
+    gauge("cache-eviction-weight-total", lambda: stats.eviction_weight)
+    gauge(
+        "cache-evictions-total",
+        lambda: sum(stats.evictions.values()),
+    )
+    for cause in RemovalCause:
+        registry.add_gauge(
+            MetricName.of(
+                "cache-evictions-total", CACHE_METRIC_GROUP,
+                tags={**tags, "cause": cause.value},
+            ),
+            lambda c=cause: stats.evictions[c],
+        )
+    if size_supplier is not None:
+        gauge("cache-size-total", size_supplier, "Number of cached entries")
+    if weight_supplier is not None:
+        gauge("cache-weight-total", weight_supplier, "Total cached weight (bytes)")
+
+
+class DiskCacheMetrics:
+    """write/write-bytes/delete/delete-bytes rate+total for the disk cache."""
+
+    def __init__(self, registry: MetricsRegistry, cache_name: str = "disk-chunk-cache"):
+        tags = {"cache": cache_name}
+        self._write = registry.sensor(f"{cache_name}.write")
+        self._write.add(MetricName.of("write-rate", CACHE_METRIC_GROUP, tags=tags), Rate())
+        self._write.add(MetricName.of("write-total", CACHE_METRIC_GROUP, tags=tags), Total())
+        self._write_bytes = registry.sensor(f"{cache_name}.write-bytes")
+        self._write_bytes.add(
+            MetricName.of("write-bytes-rate", CACHE_METRIC_GROUP, tags=tags), Rate())
+        self._write_bytes.add(
+            MetricName.of("write-bytes-total", CACHE_METRIC_GROUP, tags=tags), Total())
+        self._delete = registry.sensor(f"{cache_name}.delete")
+        self._delete.add(MetricName.of("delete-rate", CACHE_METRIC_GROUP, tags=tags), Rate())
+        self._delete.add(MetricName.of("delete-total", CACHE_METRIC_GROUP, tags=tags), Total())
+        self._delete_bytes = registry.sensor(f"{cache_name}.delete-bytes")
+        self._delete_bytes.add(
+            MetricName.of("delete-bytes-rate", CACHE_METRIC_GROUP, tags=tags), Rate())
+        self._delete_bytes.add(
+            MetricName.of("delete-bytes-total", CACHE_METRIC_GROUP, tags=tags), Total())
+
+    def record_write(self, n_bytes: int) -> None:
+        self._write.record(1.0)
+        self._write_bytes.record(float(n_bytes))
+
+    def record_delete(self, n_bytes: int) -> None:
+        self._delete.record(1.0)
+        self._delete_bytes.record(float(n_bytes))
+
+
+def register_thread_pool_metrics(
+    registry: MetricsRegistry, pool_name: str, executor: ThreadPoolExecutor
+) -> None:
+    """Executor gauges (ThreadPoolMonitor analogue for ThreadPoolExecutor)."""
+    tags = {"pool": pool_name}
+
+    def gauge(name: str, supplier) -> None:
+        registry.add_gauge(
+            MetricName.of(name, THREAD_POOL_METRIC_GROUP, tags=tags), supplier
+        )
+
+    # ThreadPoolExecutor has no public introspection; fall back to 0 if these
+    # stdlib internals ever change shape.
+    gauge("parallelism", lambda: getattr(executor, "_max_workers", 0))
+    gauge("pool-size", lambda: len(getattr(executor, "_threads", ())))
+    gauge(
+        "queued-task-count",
+        lambda: q.qsize() if (q := getattr(executor, "_work_queue", None)) else 0,
+    )

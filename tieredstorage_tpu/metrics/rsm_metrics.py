@@ -1,0 +1,107 @@
+"""RSM operation metrics: the reference's metric families and tag scopes.
+
+Reference: core/.../metrics/Metrics.java:79-270 — every operation records
+into three scopes (aggregate, by-topic, by-topic-partition), and object
+uploads additionally by object type; names per
+core/.../metrics/MetricsRegistry.java (group `remote-storage-manager-metrics`,
+sensor-name scheme :438-470). Families:
+
+- segment-copy-time-avg/-max (ms)
+- segment-delete-rate/-total, segment-delete-bytes-rate/-total,
+  segment-delete-time-avg/-max, segment-delete-errors-rate/-total
+- segment-fetch-requested-bytes-rate/-total
+- object-upload-rate/-total, object-upload-bytes-rate/-total
+  (aggregate/topic/partition × optional object-type tag)
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Optional
+
+from tieredstorage_tpu.metrics.core import (
+    Avg,
+    Count,
+    Max,
+    MetricConfig,
+    MetricName,
+    MetricsRegistry,
+    Rate,
+    Total,
+)
+
+METRIC_GROUP = "remote-storage-manager-metrics"
+
+
+class Metrics:
+    def __init__(self, config: Optional[MetricConfig] = None,
+                 registry: Optional[MetricsRegistry] = None) -> None:
+        self.registry = registry or MetricsRegistry(config)
+
+    # ----------------------------------------------------------------- scopes
+    def _scopes(self, topic: Optional[str], partition: Optional[int],
+                object_type: Optional[str] = None) -> list[dict[str, str]]:
+        scopes: list[dict[str, str]] = [{}]
+        if topic is not None:
+            scopes.append({"topic": topic})
+            if partition is not None:
+                scopes.append({"topic": topic, "partition": str(partition)})
+        if object_type is not None:
+            scopes.extend([dict(s, **{"object-type": object_type}) for s in scopes])
+        return scopes
+
+    def _sensor_name(self, base: str, tags: Mapping[str, str]) -> str:
+        qualifier = ".".join(f"{k}.{v}" for k, v in sorted(tags.items()))
+        return f"{base}.{qualifier}" if qualifier else base
+
+    def _rate_total(self, base: str, tags: dict[str, str], value: float) -> None:
+        self.registry.sensor(self._sensor_name(base, tags)).ensure_stats(lambda: [
+            (MetricName.of(base + "-rate", METRIC_GROUP, tags=tags), Rate()),
+            (MetricName.of(base + "-total", METRIC_GROUP, tags=tags), Total()),
+        ]).record(value)
+
+    def _count_rate_total(self, base: str, tags: dict[str, str]) -> None:
+        self.registry.sensor(self._sensor_name(base, tags)).ensure_stats(lambda: [
+            (MetricName.of(base + "-rate", METRIC_GROUP, tags=tags), Rate()),
+            (MetricName.of(base + "-total", METRIC_GROUP, tags=tags), Count()),
+        ]).record(1.0)
+
+    def _time(self, base: str, tags: dict[str, str], ms: float) -> None:
+        self.registry.sensor(self._sensor_name(base, tags)).ensure_stats(lambda: [
+            (MetricName.of(base + "-avg", METRIC_GROUP, tags=tags), Avg()),
+            (MetricName.of(base + "-max", METRIC_GROUP, tags=tags), Max()),
+        ]).record(ms)
+
+    # ------------------------------------------------------------- recordings
+    def record_segment_copy_time(self, topic: str, partition: int, ms: float) -> None:
+        for tags in self._scopes(topic, partition):
+            self._time("segment-copy-time", tags, ms)
+
+    def record_segment_delete(self, topic: str, partition: int, n_bytes: int) -> None:
+        for tags in self._scopes(topic, partition):
+            self._count_rate_total("segment-delete", tags)
+            self._rate_total("segment-delete-bytes", tags, float(n_bytes))
+
+    def record_segment_delete_time(self, topic: str, partition: int, ms: float) -> None:
+        for tags in self._scopes(topic, partition):
+            self._time("segment-delete-time", tags, ms)
+
+    def record_segment_delete_error(self, topic: str, partition: int) -> None:
+        for tags in self._scopes(topic, partition):
+            self._count_rate_total("segment-delete-errors", tags)
+
+    def record_segment_fetch_requested_bytes(
+        self, topic: str, partition: int, n_bytes: int
+    ) -> None:
+        for tags in self._scopes(topic, partition):
+            self._rate_total("segment-fetch-requested-bytes", tags, float(n_bytes))
+
+    def record_object_upload(
+        self, topic: str, partition: int, object_type: str, n_bytes: int
+    ) -> None:
+        for tags in self._scopes(topic, partition, object_type):
+            self._count_rate_total("object-upload", tags)
+            self._rate_total("object-upload-bytes", tags, float(n_bytes))
+
+    # -------------------------------------------------------------- snapshot
+    def snapshot(self) -> dict[str, float]:
+        return self.registry.snapshot()

@@ -41,6 +41,13 @@ from tieredstorage_tpu.manifest.segment_manifest import (
     manifest_to_json,
 )
 from tieredstorage_tpu.metadata import LogSegmentData, RemoteLogSegmentMetadata
+from tieredstorage_tpu.metrics.cache_metrics import (
+    DiskCacheMetrics,
+    register_cache_metrics,
+    register_thread_pool_metrics,
+)
+from tieredstorage_tpu.metrics.core import MetricConfig
+from tieredstorage_tpu.metrics.rsm_metrics import Metrics
 from tieredstorage_tpu.object_key import ObjectKeyFactory, Suffix
 from tieredstorage_tpu.security.aes import AesEncryptionProvider, DataKeyAndAAD
 from tieredstorage_tpu.security.rsa import RsaEncryptionProvider
@@ -79,6 +86,12 @@ class RemoteStorageManager:
         config = RemoteStorageManagerConfig(configs)
         self._config = config
 
+        self._metrics = Metrics(MetricConfig(
+            num_samples=config.metrics_num_samples,
+            sample_window_ms=config.metrics_sample_window_ms,
+            recording_level=config.metrics_recording_level,
+        ))
+
         storage = config.storage_backend_class()
         storage.configure(config.storage_configs())
         self._storage = storage
@@ -103,11 +116,44 @@ class RemoteStorageManager:
         self._manifest_cache.configure(config.fetch_manifest_cache_configs())
         self._indexes_cache = MemorySegmentIndexesCache()
         self._indexes_cache.configure(config.fetch_indexes_cache_configs())
+        self._register_cache_metrics()
+
+    def _register_cache_metrics(self) -> None:
+        registry = self._metrics.registry
+        register_cache_metrics(
+            registry, "segment-manifest-cache", self._manifest_cache.stats,
+            size_supplier=lambda: self._manifest_cache.size,
+        )
+        register_cache_metrics(
+            registry, "segment-indexes-cache", self._indexes_cache.stats,
+            size_supplier=lambda: self._indexes_cache.size,
+            weight_supplier=lambda: self._indexes_cache.total_weight,
+        )
+        chunk_cache = self._chunk_manager
+        if hasattr(chunk_cache, "stats"):
+            register_cache_metrics(
+                registry, "chunk-cache", chunk_cache.stats,
+                size_supplier=lambda: chunk_cache.size,
+                weight_supplier=lambda: chunk_cache.total_weight,
+            )
+            register_thread_pool_metrics(
+                registry, "chunk-cache-pool", chunk_cache.executor
+            )
+            from tieredstorage_tpu.fetch.cache.disk import DiskChunkCache
+
+            if isinstance(chunk_cache, DiskChunkCache):
+                disk_metrics = DiskCacheMetrics(registry)
+                chunk_cache.record_write = disk_metrics.record_write
+                chunk_cache.record_delete = disk_metrics.record_delete
 
     def _build_chunk_manager(self, backend) -> ChunkManager:
         factory = ChunkManagerFactory()
         factory.configure(self._config.raw_props())
         return factory.init_chunk_manager(self._storage, backend)
+
+    @property
+    def metrics(self) -> Metrics:
+        return self._metrics
 
     def _require_configured(self) -> RemoteStorageManagerConfig:
         if self._config is None:
@@ -161,12 +207,22 @@ class RemoteStorageManager:
                 raise
             raise RemoteStorageException(f"Failed to copy segment {metadata}") from e
 
-        log.debug(
-            "Copied %s in %.3fs", metadata, time.monotonic() - start
-        )
+        elapsed = time.monotonic() - start
+        topic, partition = self._topic_partition(metadata)
+        self._metrics.record_segment_copy_time(topic, partition, elapsed * 1000.0)
+        log.debug("Copied %s in %.3fs", metadata, elapsed)
         if not include:
             return None
         return serialize_custom_metadata(custom_builder.build())
+
+    @staticmethod
+    def _topic_partition(metadata: RemoteLogSegmentMetadata) -> tuple[str, int]:
+        tp = metadata.remote_log_segment_id.topic_id_partition.topic_partition
+        return tp.topic, tp.partition
+
+    def _record_upload(self, metadata, suffix: Suffix, n_bytes: int) -> None:
+        topic, partition = self._topic_partition(metadata)
+        self._metrics.record_object_upload(topic, partition, suffix.value, n_bytes)
 
     def _requires_compression(self, segment_data: LogSegmentData) -> bool:
         config = self._require_configured()
@@ -212,6 +268,7 @@ class RemoteStorageManager:
             uploaded_keys.append(key)
             uploaded = self._storage.upload(stream, key)
         custom_builder.add_upload_result(Suffix.LOG, uploaded)
+        self._record_upload(metadata, Suffix.LOG, uploaded)
         log.debug("Uploaded segment log for %s, size: %d", metadata, uploaded)
         return transformation.chunk_index
 
@@ -264,6 +321,7 @@ class RemoteStorageManager:
         uploaded_keys.append(key)
         uploaded = self._storage.upload(io.BytesIO(b"".join(parts)), key)
         custom_builder.add_upload_result(Suffix.INDEXES, uploaded)
+        self._record_upload(metadata, Suffix.INDEXES, uploaded)
         log.debug("Uploaded indexes file for %s, size: %d", metadata, uploaded)
         return builder.build()
 
@@ -290,6 +348,7 @@ class RemoteStorageManager:
         uploaded_keys.append(key)
         uploaded = self._storage.upload(io.BytesIO(text.encode("utf-8")), key)
         custom_builder.add_upload_result(Suffix.MANIFEST, uploaded)
+        self._record_upload(metadata, Suffix.MANIFEST, uploaded)
         log.debug("Uploaded segment manifest for %s, size: %d", metadata, uploaded)
 
     # ------------------------------------------------------------------ fetch
@@ -339,6 +398,10 @@ class RemoteStorageManager:
                 file_size - 1,
             )
             byte_range = BytesRange.of(start_position, effective_end)
+            topic, partition = self._topic_partition(metadata)
+            self._metrics.record_segment_fetch_requested_bytes(
+                topic, partition, byte_range.size
+            )
             key = self._object_key(metadata, Suffix.LOG)
             return FetchChunkEnumeration(
                 self._chunk_manager, key, manifest, byte_range
@@ -393,11 +456,20 @@ class RemoteStorageManager:
     def delete_log_segment_data(self, metadata: RemoteLogSegmentMetadata) -> None:
         self._require_configured()
         log.debug("Deleting log segment data for %s", metadata)
+        topic, partition = self._topic_partition(metadata)
+        self._metrics.record_segment_delete(
+            topic, partition, metadata.segment_size_in_bytes
+        )
+        start = time.monotonic()
         try:
             keys = [self._object_key(metadata, s) for s in Suffix]
             self._delete_keys(keys)
         except StorageBackendException as e:
+            self._metrics.record_segment_delete_error(topic, partition)
             raise RemoteStorageException(f"Failed to delete {metadata}") from e
+        self._metrics.record_segment_delete_time(
+            topic, partition, (time.monotonic() - start) * 1000.0
+        )
 
     def _delete_keys(self, keys: list[ObjectKey]) -> None:
         if self._storage is not None and keys:
