@@ -1,0 +1,8 @@
+"""In-process storage-service emulators.
+
+The reference tests its cloud backends against emulator containers
+(Testcontainers: LocalStack for S3, fake-gcs-server, Azurite — see SURVEY §4).
+This build has no container runtime, so the emulators are threaded stdlib
+HTTP servers speaking just enough of each protocol for the backends under
+test. They are test infrastructure, not fixtures copied from anywhere.
+"""

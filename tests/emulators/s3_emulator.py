@@ -1,0 +1,220 @@
+"""Threaded in-process S3-compatible server (LocalStack stand-in).
+
+Implements the object operations the S3 backend uses: PutObject, GetObject
+(with Range), DeleteObject, DeleteObjects, and the multipart upload lifecycle.
+State lives in dictionaries guarded by a lock; buckets are implicit.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import uuid
+import xml.etree.ElementTree as ET
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, unquote, urlsplit
+
+
+class S3State:
+    def __init__(self) -> None:
+        self.objects: dict[tuple[str, str], bytes] = {}
+        self.uploads: dict[str, dict[int, bytes]] = {}
+        self.upload_keys: dict[str, tuple[str, str]] = {}
+        self.lock = threading.Lock()
+        # Fault injection queue: (matcher(method, path) -> bool, status, body)
+        self.fail_next: list[tuple] = []
+
+
+def _xml(tag: str, children: dict[str, str]) -> bytes:
+    root = ET.Element(tag)
+    for k, v in children.items():
+        ET.SubElement(root, k).text = v
+    return ET.tostring(root, encoding="utf-8", xml_declaration=True)
+
+
+def _error_xml(code: str, message: str) -> bytes:
+    return _xml("Error", {"Code": code, "Message": message})
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    state: S3State
+
+    def log_message(self, fmt, *args):  # silence
+        pass
+
+    # ------------------------------------------------------------ utilities
+    def _split(self) -> tuple[str, str, dict[str, list[str]]]:
+        parts = urlsplit(self.path)
+        segs = parts.path.lstrip("/").split("/", 1)
+        bucket = segs[0] if segs else ""
+        key = unquote(segs[1]) if len(segs) > 1 else ""
+        return bucket, key, parse_qs(parts.query, keep_blank_values=True)
+
+    def _body(self) -> bytes:
+        length = int(self.headers.get("Content-Length", "0"))
+        return self.rfile.read(length) if length else b""
+
+    def _reply(self, status: int, body: bytes = b"", headers: dict | None = None) -> None:
+        self.send_response(status)
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
+
+    def _maybe_fail(self) -> bool:
+        with self.state.lock:
+            for i, (matcher, status, body) in enumerate(self.state.fail_next):
+                if matcher(self.command, self.path):
+                    self.state.fail_next.pop(i)
+                    break
+            else:
+                return False
+        self._body()  # drain the request body to keep the connection parseable
+        self._reply(status, body)
+        return True
+
+    # ------------------------------------------------------------- handlers
+    def do_PUT(self) -> None:
+        if self._maybe_fail():
+            return
+        bucket, key, query = self._split()
+        body = self._body()
+        if "partNumber" in query:
+            upload_id = query["uploadId"][0]
+            part = int(query["partNumber"][0])
+            with self.state.lock:
+                if upload_id not in self.state.uploads:
+                    self._reply(404, _error_xml("NoSuchUpload", upload_id))
+                    return
+                self.state.uploads[upload_id][part] = body
+            etag = f'"{uuid.uuid5(uuid.NAMESPACE_OID, str(hash(body)))}"'
+            self._reply(200, headers={"ETag": etag})
+            return
+        with self.state.lock:
+            self.state.objects[(bucket, key)] = body
+        self._reply(200, headers={"ETag": '"etag"'})
+
+    def do_GET(self) -> None:
+        if self._maybe_fail():
+            return
+        bucket, key, _query = self._split()
+        with self.state.lock:
+            data = self.state.objects.get((bucket, key))
+        if data is None:
+            self._reply(404, _error_xml("NoSuchKey", key))
+            return
+        range_header = self.headers.get("Range")
+        if range_header:
+            m = re.fullmatch(r"bytes=(\d+)-(\d*)", range_header.strip())
+            if not m:
+                self._reply(400, _error_xml("InvalidArgument", range_header))
+                return
+            start = int(m.group(1))
+            end = int(m.group(2)) if m.group(2) else len(data) - 1
+            if start >= len(data):
+                self._reply(416, _error_xml("InvalidRange", range_header))
+                return
+            end = min(end, len(data) - 1)
+            piece = data[start : end + 1]
+            self._reply(
+                206,
+                piece,
+                headers={"Content-Range": f"bytes {start}-{end}/{len(data)}"},
+            )
+            return
+        self._reply(200, data)
+
+    def do_DELETE(self) -> None:
+        if self._maybe_fail():
+            return
+        bucket, key, query = self._split()
+        if "uploadId" in query:
+            with self.state.lock:
+                self.state.uploads.pop(query["uploadId"][0], None)
+                self.state.upload_keys.pop(query["uploadId"][0], None)
+            self._reply(204)
+            return
+        with self.state.lock:
+            self.state.objects.pop((bucket, key), None)
+        self._reply(204)
+
+    def do_POST(self) -> None:
+        if self._maybe_fail():
+            return
+        bucket, key, query = self._split()
+        if "uploads" in query:
+            upload_id = uuid.uuid4().hex
+            with self.state.lock:
+                self.state.uploads[upload_id] = {}
+                self.state.upload_keys[upload_id] = (bucket, key)
+            self._reply(
+                200,
+                _xml(
+                    "InitiateMultipartUploadResult",
+                    {"Bucket": bucket, "Key": key, "UploadId": upload_id},
+                ),
+            )
+            return
+        if "uploadId" in query:
+            upload_id = query["uploadId"][0]
+            with self.state.lock:
+                parts = self.state.uploads.pop(upload_id, None)
+                target = self.state.upload_keys.pop(upload_id, None)
+                if parts is None or target is None:
+                    self._reply(404, _error_xml("NoSuchUpload", upload_id))
+                    return
+                blob = b"".join(parts[n] for n in sorted(parts))
+                self.state.objects[target] = blob
+            self._reply(
+                200,
+                _xml("CompleteMultipartUploadResult", {"Bucket": bucket, "Key": key}),
+            )
+            return
+        if "delete" in query:
+            body = self._body()
+            root = ET.fromstring(body)
+            deleted = []
+            with self.state.lock:
+                for obj in root.findall("Object"):
+                    k = obj.findtext("Key") or ""
+                    self.state.objects.pop((bucket, k), None)
+                    deleted.append(k)
+            self._reply(200, _xml("DeleteResult", {}))
+            return
+        self._reply(400, _error_xml("NotImplemented", self.path))
+
+
+class S3Emulator:
+    def __init__(self) -> None:
+        self.state = S3State()
+        handler = type("Handler", (_Handler,), {"state": self.state})
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        self.thread = threading.Thread(target=self.server.serve_forever, daemon=True)
+
+    @property
+    def endpoint(self) -> str:
+        host, port = self.server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "S3Emulator":
+        self.thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+
+    def inject_error(
+        self,
+        status: int,
+        code: str = "SlowDown",
+        message: str = "injected",
+        when=None,
+    ) -> None:
+        """Fail the next request (matching `when(method, path)` if given)."""
+        matcher = when if when is not None else (lambda method, path: True)
+        with self.state.lock:
+            self.state.fail_next.append((matcher, status, _error_xml(code, message)))

@@ -1,0 +1,175 @@
+"""S3 backend tests against the in-process emulator.
+
+Mirrors the reference's S3 integration suite shape (S3StorageTest against
+LocalStack, S3ErrorMetricsTest with injected error responses — SURVEY §4),
+plus SigV4 signing vectors and multipart behavior.
+"""
+
+from __future__ import annotations
+
+import datetime
+import io
+
+import pytest
+
+from tests.emulators.s3_emulator import S3Emulator
+from tests.storage_contract import StorageContract
+from tieredstorage_tpu.config.configdef import ConfigException
+from tieredstorage_tpu.metrics.core import MetricName
+from tieredstorage_tpu.storage.core import ObjectKey
+from tieredstorage_tpu.storage.s3 import S3Storage, S3StorageConfig
+from tieredstorage_tpu.storage.s3.metrics import GROUP as S3_GROUP
+from tieredstorage_tpu.storage.s3.signer import SigV4Signer
+
+
+@pytest.fixture(scope="module")
+def emulator():
+    emu = S3Emulator().start()
+    yield emu
+    emu.stop()
+
+
+def make_backend(emulator, *, part_size=5 * 1024 * 1024, **extra) -> S3Storage:
+    b = S3Storage()
+    b.configure(
+        {
+            "s3.bucket.name": "test-bucket",
+            "s3.region": "us-east-1",
+            "s3.endpoint.url": emulator.endpoint,
+            "s3.path.style.access.enabled": True,
+            "s3.multipart.upload.part.size": part_size,
+            "aws.access.key.id": "test-access",
+            "aws.secret.access.key": "test-secret",
+            **extra,
+        }
+    )
+    return b
+
+
+class TestS3Storage(StorageContract):
+    @pytest.fixture
+    def backend(self, emulator):
+        with emulator.state.lock:
+            emulator.state.objects.clear()
+        return make_backend(emulator)
+
+
+class TestS3Multipart:
+    def test_multipart_upload_splits_into_parts(self, emulator):
+        backend = make_backend(emulator)
+        # Bypass the config floor to exercise multi-part path with small data.
+        backend.part_size = 1024
+        data = bytes(range(256)) * 17  # 4352 bytes → 4 parts + remainder
+        key = ObjectKey("multi/part.log")
+        assert backend.upload(io.BytesIO(data), key) == len(data)
+        with backend.fetch(key) as s:
+            assert s.read() == data
+
+    def test_upload_failure_aborts_multipart(self, emulator):
+        backend = make_backend(emulator)
+        backend.part_size = 1024
+        key = ObjectKey("multi/aborted.log")
+        from tieredstorage_tpu.storage.core import StorageBackendException
+
+        # Create and part 1 succeed; part 2 fails → abort must run so no
+        # multipart state dangles (reference: S3MultiPartOutputStream abort).
+        emulator.inject_error(
+            500, "InternalError", when=lambda m, p: m == "PUT" and "partNumber=2" in p
+        )
+        with pytest.raises(StorageBackendException):
+            backend.upload(io.BytesIO(bytes(5000)), key)
+        with emulator.state.lock:
+            assert not emulator.state.uploads  # no dangling multipart state
+            assert not emulator.state.fail_next  # injection consumed
+
+    def test_single_buffer_upload_uses_put_object(self, emulator):
+        backend = make_backend(emulator)
+        key = ObjectKey("single/small.log")
+        backend.upload(io.BytesIO(b"tiny"), key)
+        collector = backend.metrics
+        put_total = collector.registry.value(
+            MetricName.of("put-object-requests-total", S3_GROUP)
+        )
+        assert put_total >= 1.0
+
+
+class TestS3Metrics:
+    def test_request_metrics_recorded(self, emulator):
+        backend = make_backend(emulator)
+        key = ObjectKey("metrics/obj.log")
+        backend.upload(io.BytesIO(b"x" * 100), key)
+        with backend.fetch(key) as s:
+            s.read()
+        backend.delete(key)
+        reg = backend.metrics.registry
+        assert reg.value(MetricName.of("put-object-requests-total", S3_GROUP)) == 1.0
+        assert reg.value(MetricName.of("get-object-requests-total", S3_GROUP)) == 1.0
+        assert reg.value(MetricName.of("delete-object-requests-total", S3_GROUP)) == 1.0
+        assert reg.value(MetricName.of("put-object-time-avg", S3_GROUP)) > 0.0
+
+    def test_throttling_and_server_errors_classified(self, emulator):
+        backend = make_backend(emulator)
+        reg = backend.metrics.registry
+        emulator.inject_error(503, "SlowDown")
+        with pytest.raises(Exception):
+            with backend.fetch(ObjectKey("whatever")) as s:
+                s.read()
+        # 503 is recorded against the throttling class before the status is
+        # surfaced; the fetch also raised (streamed GET has no retry).
+        assert reg.value(MetricName.of("throttling-errors-total", S3_GROUP)) == 1.0
+
+
+class TestS3Config:
+    def test_static_creds_must_be_pair(self):
+        with pytest.raises(ConfigException):
+            S3StorageConfig(
+                {"s3.bucket.name": "b", "aws.access.key.id": "only-one-half"}
+            )
+
+    def test_part_size_floor(self):
+        with pytest.raises(ConfigException):
+            S3StorageConfig(
+                {"s3.bucket.name": "b", "s3.multipart.upload.part.size": 1024}
+            )
+
+    def test_path_style_defaults(self):
+        with_endpoint = S3StorageConfig(
+            {"s3.bucket.name": "b", "s3.endpoint.url": "http://localhost:9000"}
+        )
+        assert with_endpoint.path_style_access
+        without = S3StorageConfig({"s3.bucket.name": "b"})
+        assert not without.path_style_access
+
+
+class TestSigV4:
+    def test_signature_matches_known_vector(self):
+        # AWS SigV4 test-suite style vector (GET bucket list), recomputed for
+        # service s3 with the signed-payload header this client always sends.
+        signer = SigV4Signer(
+            "AKIDEXAMPLE", "wJalrXUtnFEMI/K7MDENG+bPxRfiCYEXAMPLEKEY", "us-east-1"
+        )
+        now = datetime.datetime(2013, 5, 24, 0, 0, 0, tzinfo=datetime.timezone.utc)
+        headers = signer.sign(
+            "GET",
+            "/test.txt",
+            {},
+            {"Host": "examplebucket.s3.amazonaws.com"},
+            b"",
+            now=now,
+        )
+        assert headers["x-amz-date"] == "20130524T000000Z"
+        auth = headers["Authorization"]
+        assert auth.startswith(
+            "AWS4-HMAC-SHA256 Credential=AKIDEXAMPLE/20130524/us-east-1/s3/aws4_request"
+        )
+        assert "SignedHeaders=host;x-amz-content-sha256;x-amz-date" in auth
+        # Deterministic: same inputs → same signature.
+        again = signer.sign(
+            "GET",
+            "/test.txt",
+            {},
+            {"Host": "examplebucket.s3.amazonaws.com"},
+            b"",
+            now=now,
+        )
+        assert again["Authorization"] == auth

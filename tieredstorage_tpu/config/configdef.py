@@ -48,6 +48,17 @@ def in_range(min_value=None, max_value=None):
     return check
 
 
+def null_or(validator: Callable[[str, Any], None]):
+    """Accept None, else delegate (commons' `Null.or(v)` validator,
+    commons/.../config/validators/Null.java)."""
+
+    def check(name: str, value) -> None:
+        if value is not None:
+            validator(name, value)
+
+    return check
+
+
 def non_empty_string(name: str, value) -> None:
     if value is not None and str(value).strip() == "":
         raise ConfigException(f"Invalid value for configuration {name}: String must be non-empty")

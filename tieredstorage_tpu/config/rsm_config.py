@@ -21,6 +21,7 @@ from tieredstorage_tpu.config.configdef import (
     ConfigKey,
     in_range,
     non_empty_string,
+    null_or,
     subset_with_prefix,
 )
 
@@ -31,6 +32,13 @@ TRANSFORM_PREFIX = "transform."
 FETCH_CHUNK_CACHE_PREFIX = "fetch.chunk.cache."
 FETCH_INDEXES_CACHE_PREFIX = "fetch.indexes.cache."
 FETCH_MANIFEST_CACHE_PREFIX = "fetch.manifest.cache."
+
+
+def _valid_recording_level(name: str, value) -> None:
+    if str(value).upper() not in ("INFO", "DEBUG"):
+        raise ConfigException(
+            f"Invalid value {value!r} for configuration {name}: must be INFO or DEBUG"
+        )
 
 
 def _base_def() -> ConfigDef:
@@ -88,7 +96,7 @@ def _base_def() -> ConfigDef:
     ))
     d.define(ConfigKey(
         "upload.rate.limit.bytes.per.second", "int", default=None,
-        validator=lambda n, v: in_range(1024 * 1024, INT_MAX)(n, v) if v is not None else None,
+        validator=null_or(in_range(1024 * 1024, INT_MAX)),
         importance="medium",
         doc="Upper bound on segment upload bytes/s per manager instance.",
     ))
@@ -106,8 +114,9 @@ def _base_def() -> ConfigDef:
         importance="low", doc="Metrics sample window.",
     ))
     d.define(ConfigKey(
-        "metrics.recording.level", "string", default="INFO", importance="low",
-        doc="Metrics recording level (INFO, DEBUG).",
+        "metrics.recording.level", "string", default="INFO",
+        validator=_valid_recording_level,
+        importance="low", doc="Metrics recording level (INFO, DEBUG).",
     ))
     return d
 
@@ -229,7 +238,7 @@ class RemoteStorageManagerConfig:
 
     @property
     def metrics_recording_level(self) -> str:
-        return self._values["metrics.recording.level"]
+        return str(self._values["metrics.recording.level"]).upper()
 
     def fetch_chunk_cache_configs(self) -> dict[str, Any]:
         return subset_with_prefix(self._props, FETCH_CHUNK_CACHE_PREFIX)

@@ -62,10 +62,18 @@ class DiskChunkCache(ChunkCache[Path]):
         except OSError:
             log.warning("Failed to delete cached chunk file %s", cached, exc_info=True)
 
-    # Metric taps; the metrics layer overrides/attaches to these
+    # Metric taps; wired by set_metrics_recorder
     # (reference DiskChunkCacheMetrics.java:38-68).
     def record_write(self, n_bytes: int) -> None:
-        pass
+        if self._metrics_recorder is not None:
+            self._metrics_recorder.record_write(n_bytes)
 
     def record_delete(self, n_bytes: int) -> None:
-        pass
+        if self._metrics_recorder is not None:
+            self._metrics_recorder.record_delete(n_bytes)
+
+    _metrics_recorder = None
+
+    def set_metrics_recorder(self, recorder) -> None:
+        """Attach a write/delete byte recorder (DiskCacheMetrics)."""
+        self._metrics_recorder = recorder

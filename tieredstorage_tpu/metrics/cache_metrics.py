@@ -13,7 +13,7 @@ point-in-time identical to Caffeine's cumulative stats.
 from __future__ import annotations
 
 from concurrent.futures import ThreadPoolExecutor
-from typing import Optional
+
 
 from tieredstorage_tpu.metrics.core import MetricName, MetricsRegistry, Rate, Total
 from tieredstorage_tpu.utils.caching import CacheStats, RemovalCause

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Mapping, Optional
 
 
@@ -94,10 +94,15 @@ class SampledStat(Stat):
         self._initial = initial
         self._samples: list[_Sample] = []
         self._current = 0
+        # record() runs under the owning sensor's lock, but measure() is
+        # driven by snapshot readers on other threads; both mutate the sample
+        # ring (window advance / purge), so the stat needs its own lock.
+        self._stat_lock = threading.Lock()
 
     def record(self, value: float, now: float) -> None:
-        sample = self._current_sample(now)
-        self.update(sample, value)
+        with self._stat_lock:
+            sample = self._current_sample(now)
+            self.update(sample, value)
 
     def _current_sample(self, now: float) -> _Sample:
         if not self._samples:
@@ -134,9 +139,10 @@ class SampledStat(Stat):
         raise NotImplementedError
 
     def measure(self, config: MetricConfig, now: float) -> float:
-        self.configure(config)
-        self._purge(now)
-        return self.combine(now)
+        with self._stat_lock:
+            self.configure(config)
+            self._purge(now)
+            return self.combine(now)
 
 
 class Rate(SampledStat):

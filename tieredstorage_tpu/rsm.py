@@ -142,9 +142,7 @@ class RemoteStorageManager:
             from tieredstorage_tpu.fetch.cache.disk import DiskChunkCache
 
             if isinstance(chunk_cache, DiskChunkCache):
-                disk_metrics = DiskCacheMetrics(registry)
-                chunk_cache.record_write = disk_metrics.record_write
-                chunk_cache.record_delete = disk_metrics.record_delete
+                chunk_cache.set_metrics_recorder(DiskCacheMetrics(registry))
 
     def _build_chunk_manager(self, backend) -> ChunkManager:
         factory = ChunkManagerFactory()

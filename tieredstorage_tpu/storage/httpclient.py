@@ -1,0 +1,229 @@
+"""Minimal pooled HTTP client for the cloud storage backends.
+
+The reference's backends ride vendor SDKs (AWS SDK v2 sync HTTP client,
+google-cloud-storage's HttpTransport, azure-core's HttpPipeline — see
+storage/s3/.../S3ClientBuilder.java, storage/gcs/.../GcsStorage.java:41-88,
+storage/azure/.../AzureBlobStorage.java:48-99). This build speaks the three
+REST protocols directly over the standard library so the backends carry zero
+SDK dependencies; this module is the shared transport: per-thread connection
+reuse, timeouts, an observer hook (the analogue of the reference's
+MetricCollector pipeline taps), and a socket factory hook used for SOCKS5
+proxying (storage/core/.../proxy/).
+"""
+
+from __future__ import annotations
+
+import http.client
+import io
+import socket
+import ssl
+import threading
+from typing import BinaryIO, Callable, Mapping, Optional
+from urllib.parse import urlsplit
+
+
+class HttpError(Exception):
+    """Transport-level failure (connect/read), not an HTTP status."""
+
+
+class HttpResponse:
+    """A fully materialized or streaming HTTP response.
+
+    `stream()` hands the caller ownership of the underlying response body;
+    the connection is returned to the per-thread slot only once the body is
+    fully drained and closed.
+    """
+
+    def __init__(self, status: int, headers: Mapping[str, str], body: bytes):
+        self.status = status
+        self.headers = {k.lower(): v for k, v in headers.items()}
+        self.body = body
+
+    def header(self, name: str, default: str = "") -> str:
+        return self.headers.get(name.lower(), default)
+
+
+class _StreamedBody(io.RawIOBase):
+    """Wraps an http.client response; closing closes the dedicated connection."""
+
+    def __init__(self, resp: http.client.HTTPResponse, conn: http.client.HTTPConnection):
+        self._resp = resp
+        self._conn = conn
+
+    def readable(self) -> bool:
+        return True
+
+    def readinto(self, b) -> int:
+        data = self._resp.read(len(b))
+        n = len(data)
+        b[:n] = data
+        return n
+
+    def read(self, size: int = -1) -> bytes:
+        return self._resp.read(None if size is None or size < 0 else size)
+
+    def close(self) -> None:
+        if not self.closed:
+            try:
+                self._resp.close()
+            finally:
+                try:
+                    self._conn.close()
+                finally:
+                    super().close()
+
+
+# Observer signature: (method, url_path, status, elapsed_seconds, error) -> None
+Observer = Callable[[str, str, int, float, Optional[BaseException]], None]
+
+# Socket factory signature: (host, port, timeout) -> connected socket
+SocketFactory = Callable[[str, int, Optional[float]], socket.socket]
+
+
+class _Connection(http.client.HTTPConnection):
+    """HTTPConnection with a pluggable socket factory (SOCKS5 support)."""
+
+    def __init__(self, host: str, port: int, timeout, socket_factory: Optional[SocketFactory]):
+        super().__init__(host, port, timeout=timeout)
+        self._socket_factory = socket_factory
+
+    def connect(self) -> None:
+        if self._socket_factory is None:
+            super().connect()
+        else:
+            self.sock = self._socket_factory(self.host, self.port, self.timeout)
+
+
+class _SecureConnection(http.client.HTTPSConnection):
+    def __init__(self, host, port, timeout, socket_factory, context):
+        super().__init__(host, port, timeout=timeout, context=context)
+        self._socket_factory = socket_factory
+
+    def connect(self) -> None:
+        if self._socket_factory is None:
+            super().connect()
+        else:
+            raw = self._socket_factory(self.host, self.port, self.timeout)
+            self.sock = self._context.wrap_socket(raw, server_hostname=self.host)
+
+
+class HttpClient:
+    """Per-thread keep-alive connections to a single base URL."""
+
+    def __init__(
+        self,
+        base_url: str,
+        *,
+        timeout: Optional[float] = None,
+        verify_tls: bool = True,
+        socket_factory: Optional[SocketFactory] = None,
+        observer: Optional[Observer] = None,
+    ) -> None:
+        parts = urlsplit(base_url)
+        if parts.scheme not in ("http", "https"):
+            raise ValueError(f"Unsupported scheme in {base_url!r}")
+        self.scheme = parts.scheme
+        self.host = parts.hostname or ""
+        self.port = parts.port or (443 if self.scheme == "https" else 80)
+        self.timeout = timeout
+        self.socket_factory = socket_factory
+        self.observer = observer
+        self._local = threading.local()
+        if self.scheme == "https":
+            self._ssl_context = ssl.create_default_context()
+            if not verify_tls:
+                self._ssl_context.check_hostname = False
+                self._ssl_context.verify_mode = ssl.CERT_NONE
+        else:
+            self._ssl_context = None
+
+    # ----------------------------------------------------------- connections
+    def _new_connection(self) -> http.client.HTTPConnection:
+        if self.scheme == "https":
+            return _SecureConnection(
+                self.host, self.port, self.timeout, self.socket_factory, self._ssl_context
+            )
+        return _Connection(self.host, self.port, self.timeout, self.socket_factory)
+
+    def _pooled(self) -> http.client.HTTPConnection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = self._new_connection()
+            self._local.conn = conn
+        return conn
+
+    def _drop_pooled(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            try:
+                conn.close()
+            finally:
+                self._local.conn = None
+
+    # -------------------------------------------------------------- requests
+    def request(
+        self,
+        method: str,
+        path_and_query: str,
+        *,
+        headers: Optional[Mapping[str, str]] = None,
+        body: bytes = b"",
+    ) -> HttpResponse:
+        """Issue a request and read the full response body."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        err: Optional[BaseException] = None
+        status = 0
+        try:
+            resp = self._roundtrip(method, path_and_query, headers, body)
+            status = resp.status
+            data = resp.read()
+            return HttpResponse(status, dict(resp.getheaders()), data)
+        except (OSError, http.client.HTTPException) as e:
+            err = e
+            self._drop_pooled()
+            raise HttpError(f"{method} {path_and_query} failed: {e}") from e
+        finally:
+            if self.observer is not None:
+                self.observer(method, path_and_query, status, _time.perf_counter() - t0, err)
+
+    def request_stream(
+        self,
+        method: str,
+        path_and_query: str,
+        *,
+        headers: Optional[Mapping[str, str]] = None,
+    ) -> tuple[int, Mapping[str, str], BinaryIO]:
+        """Issue a request on a dedicated connection; the returned stream owns it."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        conn = self._new_connection()
+        try:
+            conn.request(method, path_and_query, body=None, headers=dict(headers or {}))
+            resp = conn.getresponse()
+        except (OSError, http.client.HTTPException) as e:
+            conn.close()
+            if self.observer is not None:
+                self.observer(method, path_and_query, 0, _time.perf_counter() - t0, e)
+            raise HttpError(f"{method} {path_and_query} failed: {e}") from e
+        if self.observer is not None:
+            self.observer(method, path_and_query, resp.status, _time.perf_counter() - t0, None)
+        hdrs = {k.lower(): v for k, v in resp.getheaders()}
+        return resp.status, hdrs, _StreamedBody(resp, conn)
+
+    def _roundtrip(self, method, path_and_query, headers, body) -> http.client.HTTPResponse:
+        conn = self._pooled()
+        try:
+            conn.request(method, path_and_query, body=body, headers=dict(headers or {}))
+            return conn.getresponse()
+        except (OSError, http.client.HTTPException):
+            # Stale keep-alive connection: retry once on a fresh one.
+            self._drop_pooled()
+            conn = self._pooled()
+            conn.request(method, path_and_query, body=body, headers=dict(headers or {}))
+            return conn.getresponse()
+
+    def close(self) -> None:
+        self._drop_pooled()
