@@ -1,0 +1,241 @@
+"""Threaded in-process Azurite stand-in (Blob REST subset).
+
+Implements PutBlob, PutBlock, PutBlockList, GetBlob (with x-ms-range),
+DeleteBlob. When constructed with an account key it independently recomputes
+the SharedKey signature from the Azure docs' string-to-sign layout and
+rejects mismatches, so the backend's signer is actually exercised. SAS mode
+checks the signature params are present on every request.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import threading
+import xml.etree.ElementTree as ET
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, unquote, urlsplit
+
+
+class AzureState:
+    def __init__(self) -> None:
+        self.blobs: dict[tuple[str, str], bytes] = {}
+        self.blocks: dict[tuple[str, str], dict[str, bytes]] = {}
+        self.lock = threading.Lock()
+        self.auth_failures = 0
+        self.fail_next: list[tuple] = []
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    state: AzureState
+    account: str | None
+    account_key: str | None
+    require_sas: bool
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _body(self) -> bytes:
+        length = int(self.headers.get("Content-Length", "0"))
+        return self.rfile.read(length) if length else b""
+
+    def _reply(self, status: int, body: bytes = b"", headers: dict | None = None) -> None:
+        self.send_response(status)
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
+
+    def _maybe_fail(self) -> bool:
+        with self.state.lock:
+            for i, (matcher, status, body) in enumerate(self.state.fail_next):
+                if matcher(self.command, self.path):
+                    self.state.fail_next.pop(i)
+                    break
+            else:
+                return False
+        self._body()
+        self._reply(status, body)
+        return True
+
+    # --------------------------------------------------------- auth checks
+    def _check_auth(self, body_len: int) -> bool:
+        parts = urlsplit(self.path)
+        query = {k: v[0] for k, v in parse_qs(parts.query, keep_blank_values=True).items()}
+        if self.require_sas:
+            if "sig" not in query or "sv" not in query:
+                self._reply(403, b"<Error><Code>AuthenticationFailed</Code></Error>")
+                with self.state.lock:
+                    self.state.auth_failures += 1
+                return False
+            return True
+        if self.account_key is None:
+            return True
+        auth = self.headers.get("Authorization", "")
+        expected_sig = self._signature(parts.path, query, body_len)
+        if auth != f"SharedKey {self.account}:{expected_sig}":
+            with self.state.lock:
+                self.state.auth_failures += 1
+            self._reply(403, b"<Error><Code>AuthenticationFailed</Code></Error>")
+            return False
+        return True
+
+    def _signature(self, path: str, query: dict[str, str], body_len: int) -> str:
+        h = {k.lower(): v.strip() for k, v in self.headers.items()}
+        canonical_headers = "".join(
+            f"{k}:{h[k]}\n" for k in sorted(h) if k.startswith("x-ms-")
+        )
+        canonical_resource = f"/{self.account}{unquote(path)}"
+        for k in sorted(query, key=str.lower):
+            canonical_resource += f"\n{k.lower()}:{query[k]}"
+        string_to_sign = "\n".join(
+            [
+                self.command,
+                h.get("content-encoding", ""),
+                h.get("content-language", ""),
+                str(body_len) if body_len else "",
+                h.get("content-md5", ""),
+                h.get("content-type", ""),
+                "",
+                h.get("if-modified-since", ""),
+                h.get("if-match", ""),
+                h.get("if-none-match", ""),
+                h.get("if-unmodified-since", ""),
+                h.get("range", ""),
+                canonical_headers + canonical_resource,
+            ]
+        )
+        return base64.b64encode(
+            hmac.new(
+                base64.b64decode(self.account_key),
+                string_to_sign.encode("utf-8"),
+                hashlib.sha256,
+            ).digest()
+        ).decode()
+
+    def _split(self) -> tuple[str, str, dict[str, str]]:
+        parts = urlsplit(self.path)
+        segs = parts.path.lstrip("/").split("/", 1)
+        container = segs[0] if segs else ""
+        blob = unquote(segs[1]) if len(segs) > 1 else ""
+        return container, blob, {k: v[0] for k, v in parse_qs(parts.query, keep_blank_values=True).items()}
+
+    # ------------------------------------------------------------- handlers
+    def do_PUT(self) -> None:
+        if self._maybe_fail():
+            return
+        body = self._body()
+        if not self._check_auth(len(body)):
+            return
+        container, blob, query = self._split()
+        comp = query.get("comp")
+        with self.state.lock:
+            if comp == "block":
+                self.state.blocks.setdefault((container, blob), {})[query["blockid"]] = body
+                self._reply(201)
+                return
+            if comp == "blocklist":
+                root = ET.fromstring(body)
+                staged = self.state.blocks.pop((container, blob), {})
+                pieces = []
+                for el in root:
+                    bid = el.text or ""
+                    if bid not in staged:
+                        self._reply(400, b"<Error><Code>InvalidBlockList</Code></Error>")
+                        return
+                    pieces.append(staged[bid])
+                self.state.blobs[(container, blob)] = b"".join(pieces)
+                self._reply(201)
+                return
+            if self.headers.get("x-ms-blob-type") != "BlockBlob":
+                self._reply(400, b"<Error><Code>MissingBlobType</Code></Error>")
+                return
+            self.state.blobs[(container, blob)] = body
+        self._reply(201)
+
+    def do_GET(self) -> None:
+        if self._maybe_fail():
+            return
+        if not self._check_auth(0):
+            return
+        container, blob, _query = self._split()
+        with self.state.lock:
+            data = self.state.blobs.get((container, blob))
+        if data is None:
+            self._reply(404, b"<Error><Code>BlobNotFound</Code></Error>")
+            return
+        range_header = self.headers.get("x-ms-range") or self.headers.get("Range")
+        if range_header:
+            import re
+
+            m = re.fullmatch(r"bytes=(\d+)-(\d*)", range_header.strip())
+            if not m:
+                self._reply(400, b"<Error><Code>InvalidRange</Code></Error>")
+                return
+            start = int(m.group(1))
+            if start >= len(data):
+                self._reply(416, b"<Error><Code>InvalidRange</Code></Error>")
+                return
+            end = min(int(m.group(2)) if m.group(2) else len(data) - 1, len(data) - 1)
+            piece = data[start : end + 1]
+            self._reply(
+                206,
+                piece,
+                headers={"Content-Range": f"bytes {start}-{end}/{len(data)}"},
+            )
+            return
+        self._reply(200, data)
+
+    def do_DELETE(self) -> None:
+        if self._maybe_fail():
+            return
+        if not self._check_auth(0):
+            return
+        container, blob, _query = self._split()
+        with self.state.lock:
+            existed = self.state.blobs.pop((container, blob), None) is not None
+        self._reply(202 if existed else 404, b"" if existed else b"<Error><Code>BlobNotFound</Code></Error>")
+
+
+class AzureEmulator:
+    def __init__(
+        self,
+        account: str | None = None,
+        account_key: str | None = None,
+        require_sas: bool = False,
+    ) -> None:
+        self.state = AzureState()
+        handler = type(
+            "Handler",
+            (_Handler,),
+            {
+                "state": self.state,
+                "account": account,
+                "account_key": account_key,
+                "require_sas": require_sas,
+            },
+        )
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        self.thread = threading.Thread(target=self.server.serve_forever, daemon=True)
+
+    @property
+    def endpoint(self) -> str:
+        host, port = self.server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "AzureEmulator":
+        self.thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+
+    def inject_error(self, status: int, body: bytes = b"", when=None) -> None:
+        matcher = when if when is not None else (lambda method, path: True)
+        with self.state.lock:
+            self.state.fail_next.append((matcher, status, body))

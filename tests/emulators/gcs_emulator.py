@@ -1,0 +1,216 @@
+"""Threaded in-process fake-gcs-server stand-in (JSON API subset).
+
+Implements what the GCS backend uses: resumable upload sessions
+(initiate → chunked PUTs with Content-Range → finalize), object metadata
+GET, media download with Range, and DELETE.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, unquote, urlsplit
+
+
+class GcsState:
+    def __init__(self) -> None:
+        self.objects: dict[tuple[str, str], bytes] = {}
+        self.sessions: dict[str, dict] = {}  # id -> {bucket, name, data}
+        self.lock = threading.Lock()
+        self.fail_next: list[tuple] = []
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    state: GcsState
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _body(self) -> bytes:
+        length = int(self.headers.get("Content-Length", "0"))
+        return self.rfile.read(length) if length else b""
+
+    def _reply(self, status: int, body: bytes = b"", headers: dict | None = None) -> None:
+        self.send_response(status)
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
+
+    def _json(self, status: int, obj: dict, headers: dict | None = None) -> None:
+        self._reply(status, json.dumps(obj).encode(), headers)
+
+    def _maybe_fail(self) -> bool:
+        with self.state.lock:
+            for i, (matcher, status, body) in enumerate(self.state.fail_next):
+                if matcher(self.command, self.path):
+                    self.state.fail_next.pop(i)
+                    break
+            else:
+                return False
+        self._body()
+        self._reply(status, body)
+        return True
+
+    # ------------------------------------------------------------- handlers
+    def do_POST(self) -> None:
+        if self._maybe_fail():
+            return
+        parts = urlsplit(self.path)
+        query = parse_qs(parts.query)
+        m = re.fullmatch(r"/upload/storage/v1/b/([^/]+)/o", parts.path)
+        if m and query.get("uploadType") == ["resumable"]:
+            self._body()
+            bucket = m.group(1)
+            name = unquote(query["name"][0])
+            session_id = uuid.uuid4().hex
+            with self.state.lock:
+                self.state.sessions[session_id] = {
+                    "bucket": bucket,
+                    "name": name,
+                    "data": bytearray(),
+                }
+            host = self.headers.get("Host", "localhost")
+            self._reply(
+                200,
+                b"{}",
+                headers={
+                    "Location": f"http://{host}/upload/storage/v1/b/{bucket}/o"
+                    f"?uploadType=resumable&upload_id={session_id}"
+                },
+            )
+            return
+        self._reply(400, b'{"error": "unsupported POST"}')
+
+    def do_PUT(self) -> None:
+        if self._maybe_fail():
+            return
+        parts = urlsplit(self.path)
+        query = parse_qs(parts.query)
+        if "upload_id" not in query:
+            self._reply(400, b'{"error": "no upload_id"}')
+            return
+        session_id = query["upload_id"][0]
+        body = self._body()
+        content_range = self.headers.get("Content-Range", "")
+        with self.state.lock:
+            session = self.state.sessions.get(session_id)
+            if session is None:
+                self._reply(404, b'{"error": "no such session"}')
+                return
+            m = re.fullmatch(r"bytes (\d+)-(\d+)/(\d+|\*)", content_range)
+            empty = re.fullmatch(r"bytes \*/(\d+)", content_range)
+            if m:
+                start = int(m.group(1))
+                if start > int(m.group(2)):
+                    # Real GCS rejects degenerate ranges like 'bytes N-(N-1)';
+                    # keep the emulator as strict so bugs can't hide here.
+                    self._reply(400, b'{"error": "degenerate range"}')
+                    return
+                if start != len(session["data"]):
+                    self._reply(400, b'{"error": "offset mismatch"}')
+                    return
+                session["data"].extend(body)
+                total = m.group(3)
+                if total == "*":
+                    end = int(m.group(2))
+                    self._reply(308, headers={"Range": f"bytes=0-{end}"})
+                    return
+                if len(session["data"]) != int(total):
+                    self._reply(400, b'{"error": "size mismatch"}')
+                    return
+            elif empty:
+                if int(empty.group(1)) != len(session["data"]):
+                    self._reply(400, b'{"error": "size mismatch"}')
+                    return
+            else:
+                self._reply(400, b'{"error": "bad Content-Range"}')
+                return
+            # Finalize
+            data = bytes(session["data"])
+            self.state.objects[(session["bucket"], session["name"])] = data
+            del self.state.sessions[session_id]
+        self._json(200, {"name": parts.path, "size": str(len(data))})
+
+    def do_GET(self) -> None:
+        if self._maybe_fail():
+            return
+        parts = urlsplit(self.path)
+        query = parse_qs(parts.query)
+        m = re.fullmatch(r"/storage/v1/b/([^/]+)/o/(.+)", parts.path)
+        if not m:
+            self._reply(404, b'{"error": "bad path"}')
+            return
+        bucket, name = m.group(1), unquote(m.group(2))
+        with self.state.lock:
+            data = self.state.objects.get((bucket, name))
+        if data is None:
+            self._json(404, {"error": {"code": 404, "message": "Not Found"}})
+            return
+        if query.get("alt") == ["media"]:
+            range_header = self.headers.get("Range")
+            if range_header:
+                rm = re.fullmatch(r"bytes=(\d+)-(\d*)", range_header.strip())
+                if not rm:
+                    self._reply(400, b'{"error": "bad range"}')
+                    return
+                start = int(rm.group(1))
+                if start >= len(data):
+                    self._reply(416, b"")
+                    return
+                end = min(int(rm.group(2)) if rm.group(2) else len(data) - 1, len(data) - 1)
+                piece = data[start : end + 1]
+                self._reply(
+                    206,
+                    piece,
+                    headers={"Content-Range": f"bytes {start}-{end}/{len(data)}"},
+                )
+                return
+            self._reply(200, data)
+            return
+        self._json(200, {"name": name, "bucket": bucket, "size": str(len(data))})
+
+    def do_DELETE(self) -> None:
+        if self._maybe_fail():
+            return
+        parts = urlsplit(self.path)
+        m = re.fullmatch(r"/storage/v1/b/([^/]+)/o/(.+)", parts.path)
+        if not m:
+            self._reply(404, b"")
+            return
+        bucket, name = m.group(1), unquote(m.group(2))
+        with self.state.lock:
+            existed = self.state.objects.pop((bucket, name), None) is not None
+        self._reply(204 if existed else 404)
+
+
+class GcsEmulator:
+    def __init__(self) -> None:
+        self.state = GcsState()
+        handler = type("Handler", (_Handler,), {"state": self.state})
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        self.thread = threading.Thread(target=self.server.serve_forever, daemon=True)
+
+    @property
+    def endpoint(self) -> str:
+        host, port = self.server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "GcsEmulator":
+        self.thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+
+    def inject_error(self, status: int, body: bytes = b"{}", when=None) -> None:
+        matcher = when if when is not None else (lambda method, path: True)
+        with self.state.lock:
+            self.state.fail_next.append((matcher, status, body))

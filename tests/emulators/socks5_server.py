@@ -1,0 +1,134 @@
+"""Minimal in-process SOCKS5 server (RFC 1928 + RFC 1929 user/pass auth).
+
+Stand-in for the reference's SOCKS5 proxy test container (BaseSocks5Test /
+GcsStorageSocks5Test etc. — SURVEY §4). Counts proxied connections so tests
+can assert traffic actually flowed through the proxy.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+
+
+class Socks5Server:
+    def __init__(self, username: str | None = None, password: str | None = None):
+        self.username = username
+        self.password = password
+        self.connections = 0
+        self.auth_failures = 0
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:
+                outer._handle(self.request)
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self.server = Server(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(target=self.server.serve_forever, daemon=True)
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self.server.server_address[:2]
+
+    def start(self) -> "Socks5Server":
+        self.thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+
+    # ------------------------------------------------------------- protocol
+    def _recv_exact(self, sock: socket.socket, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            part = sock.recv(n - len(buf))
+            if not part:
+                raise ConnectionError("client closed")
+            buf += part
+        return buf
+
+    def _handle(self, client: socket.socket) -> None:
+        try:
+            ver, n_methods = self._recv_exact(client, 2)
+            methods = self._recv_exact(client, n_methods)
+            if self.username is not None:
+                if 0x02 not in methods:
+                    client.sendall(b"\x05\xff")
+                    return
+                client.sendall(b"\x05\x02")
+                auth_ver, ulen = self._recv_exact(client, 2)
+                user = self._recv_exact(client, ulen).decode()
+                (plen,) = self._recv_exact(client, 1)
+                pwd = self._recv_exact(client, plen).decode()
+                if user != self.username or pwd != self.password:
+                    with self._lock:
+                        self.auth_failures += 1
+                    client.sendall(b"\x01\x01")
+                    return
+                client.sendall(b"\x01\x00")
+            else:
+                client.sendall(b"\x05\x00")
+            ver, cmd, _rsv, atyp = self._recv_exact(client, 4)
+            if cmd != 0x01:  # CONNECT only
+                client.sendall(b"\x05\x07\x00\x01" + bytes(6))
+                return
+            if atyp == 0x01:
+                host = socket.inet_ntoa(self._recv_exact(client, 4))
+            elif atyp == 0x03:
+                (ln,) = self._recv_exact(client, 1)
+                host = self._recv_exact(client, ln).decode("idna")
+            else:
+                client.sendall(b"\x05\x08\x00\x01" + bytes(6))
+                return
+            (port,) = struct.unpack(">H", self._recv_exact(client, 2))
+            try:
+                upstream = socket.create_connection((host, port), timeout=10)
+            except OSError:
+                client.sendall(b"\x05\x05\x00\x01" + bytes(6))
+                return
+            with self._lock:
+                self.connections += 1
+            client.sendall(b"\x05\x00\x00\x01" + bytes(6))
+            self._pump(client, upstream)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                client.close()
+            except OSError:
+                pass
+
+    def _pump(self, a: socket.socket, b: socket.socket) -> None:
+        """Bidirectional byte relay until either side closes."""
+
+        def one_way(src: socket.socket, dst: socket.socket) -> None:
+            try:
+                while True:
+                    data = src.recv(65536)
+                    if not data:
+                        break
+                    dst.sendall(data)
+            except OSError:
+                pass
+            finally:
+                try:
+                    dst.shutdown(socket.SHUT_WR)
+                except OSError:
+                    pass
+
+        t = threading.Thread(target=one_way, args=(b, a), daemon=True)
+        t.start()
+        one_way(a, b)
+        t.join(timeout=10)
+        try:
+            b.close()
+        except OSError:
+            pass

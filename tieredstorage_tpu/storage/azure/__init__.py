@@ -1,0 +1,10 @@
+"""Azure Blob storage backend (Blob REST API over stdlib HTTP, no SDK).
+
+Reference module: storage/azure (AzureBlobStorage.java,
+AzureBlobStorageConfig.java, MetricCollector.java).
+"""
+
+from tieredstorage_tpu.storage.azure.config import AzureBlobStorageConfig
+from tieredstorage_tpu.storage.azure.storage import AzureBlobStorage
+
+__all__ = ["AzureBlobStorage", "AzureBlobStorageConfig"]

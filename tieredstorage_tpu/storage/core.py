@@ -93,6 +93,30 @@ class BytesRange:
         return f"BytesRange{{{self.from_position}..{self.to_position}}}"
 
 
+def iter_chunks(stream: BinaryIO, chunk_size: int, *, read_size: int = 1 << 20):
+    """Yield successive `chunk_size` slices of `stream` (last may be short).
+
+    Single-sources the accumulate-and-slice EOF handling used by the block/
+    resumable upload paths of the cloud backends.
+    """
+    pending = b""
+    eof = False
+    while True:
+        while len(pending) < chunk_size and not eof:
+            block = stream.read(read_size)
+            if not block:
+                eof = True
+                break
+            pending += block
+        if eof and not pending:
+            return
+        chunk, pending = pending[:chunk_size], pending[chunk_size:]
+        if chunk:
+            yield chunk
+        if eof and not pending:
+            return
+
+
 class ObjectUploader(abc.ABC):
     """Reference: storage/core/.../ObjectUploader.java:21-27."""
 

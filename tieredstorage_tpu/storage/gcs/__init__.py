@@ -1,0 +1,10 @@
+"""GCS storage backend (JSON API over stdlib HTTP, no SDK).
+
+Reference module: storage/gcs (GcsStorage.java, GcsStorageConfig.java,
+CredentialsBuilder.java, MetricCollector.java).
+"""
+
+from tieredstorage_tpu.storage.gcs.config import GcsStorageConfig
+from tieredstorage_tpu.storage.gcs.storage import GcsStorage
+
+__all__ = ["GcsStorage", "GcsStorageConfig"]

@@ -1,0 +1,65 @@
+"""Service-account auth: self-signed RS256 JWTs as Bearer tokens.
+
+The reference builds `GoogleCredentials` from json/path/default
+(storage/gcs/.../CredentialsBuilder.java). Google Cloud Storage accepts
+self-signed service-account JWTs directly as Bearer tokens (no OAuth
+token-exchange round trip), which is what this module mints; default
+credentials (emulators, workload identity with no key material) send no
+Authorization header.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import time
+from typing import Optional
+
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import padding
+
+
+def _b64url(data: bytes) -> bytes:
+    return base64.urlsafe_b64encode(data).rstrip(b"=")
+
+
+class ServiceAccountTokenProvider:
+    """Mints and caches a self-signed JWT for the storage scope."""
+
+    LIFETIME_S = 3600
+    REFRESH_MARGIN_S = 300
+
+    def __init__(self, credentials: dict):
+        try:
+            self.client_email = credentials["client_email"]
+            key_pem = credentials["private_key"]
+        except KeyError as e:
+            raise ValueError(f"Service account JSON missing field: {e}") from e
+        self._key = serialization.load_pem_private_key(key_pem.encode(), password=None)
+        self._token: Optional[str] = None
+        self._expires_at = 0.0
+
+    def token(self) -> str:
+        now = time.time()
+        if self._token is None or now >= self._expires_at - self.REFRESH_MARGIN_S:
+            self._token = self._mint(now)
+            self._expires_at = now + self.LIFETIME_S
+        return self._token
+
+    def _mint(self, now: float) -> str:
+        header = _b64url(json.dumps({"alg": "RS256", "typ": "JWT"}).encode())
+        claims = _b64url(
+            json.dumps(
+                {
+                    "iss": self.client_email,
+                    "sub": self.client_email,
+                    "aud": "https://storage.googleapis.com/",
+                    "iat": int(now),
+                    "exp": int(now) + self.LIFETIME_S,
+                    "scope": "https://www.googleapis.com/auth/devstorage.read_write",
+                }
+            ).encode()
+        )
+        signing_input = header + b"." + claims
+        signature = self._key.sign(signing_input, padding.PKCS1v15(), hashes.SHA256())
+        return (signing_input + b"." + _b64url(signature)).decode()

@@ -1,0 +1,70 @@
+"""GCS backend request metrics.
+
+Reference: storage/gcs/.../MetricCollector.java:66-83,146-160 wraps the HTTP
+transport and classifies requests by URL regex into object-metadata /
+object-download / object-upload (+ resumable-chunk detail). Same
+classification here, applied as an HttpClient observer.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from tieredstorage_tpu.metrics.core import (
+    Avg,
+    Max,
+    MetricName,
+    MetricsRegistry,
+    Rate,
+    Total,
+)
+
+GROUP = "gcs-client-metrics"
+CONTEXT = "aiven.kafka.server.tieredstorage.gcs"
+
+
+def _classify(method: str, path_and_query: str) -> Optional[str]:
+    path = path_and_query.partition("?")[0]
+    if path.startswith("/upload/storage/"):
+        return "object-upload"
+    if "alt=media" in path_and_query or path.startswith("/download/"):
+        return "object-download"
+    if "/storage/v1/b/" in path and "/o/" in path:
+        if method == "GET":
+            return "object-metadata-get"
+        if method == "DELETE":
+            return "object-delete"
+    return None
+
+
+class GcsMetricCollector:
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self.registry = registry or MetricsRegistry()
+
+    def observe(
+        self,
+        method: str,
+        path_and_query: str,
+        status: int,
+        elapsed_s: float,
+        error: Optional[BaseException],
+    ) -> None:
+        op = _classify(method, path_and_query)
+        if op is None:
+            return
+        requests = self.registry.sensor(f"{op}-requests")
+        requests.ensure_stats(
+            lambda: [
+                (MetricName.of(f"{op}-requests-rate", GROUP), Rate()),
+                (MetricName.of(f"{op}-requests-total", GROUP), Total()),
+            ]
+        )
+        requests.record(1.0)
+        timing = self.registry.sensor(f"{op}-time")
+        timing.ensure_stats(
+            lambda: [
+                (MetricName.of(f"{op}-time-avg", GROUP), Avg()),
+                (MetricName.of(f"{op}-time-max", GROUP), Max()),
+            ]
+        )
+        timing.record(elapsed_s * 1000.0)

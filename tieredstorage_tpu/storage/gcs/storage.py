@@ -1,0 +1,198 @@
+"""GCS StorageBackend over the JSON API.
+
+Reference: storage/gcs/.../GcsStorage.java:41-160 — resumable upload with a
+configurable chunk size (`storage.createFrom(blobInfo, stream, chunkSize)`),
+fetch via blob metadata + ReadChannel seek/limit (here: a metadata GET for
+the size check, then a ranged media download), 404 → KeyNotFoundException,
+client-side range validation against the blob size.
+"""
+
+from __future__ import annotations
+
+from typing import BinaryIO, Mapping, Optional
+from urllib.parse import quote, urlsplit
+
+from tieredstorage_tpu.storage.core import (
+    BytesRange,
+    InvalidRangeException,
+    KeyNotFoundException,
+    ObjectKey,
+    StorageBackend,
+    StorageBackendException,
+    iter_chunks,
+)
+from tieredstorage_tpu.storage.gcs.auth import ServiceAccountTokenProvider
+from tieredstorage_tpu.storage.gcs.config import GcsStorageConfig
+from tieredstorage_tpu.storage.httpclient import HttpClient, HttpError
+from tieredstorage_tpu.storage.proxy import ProxyConfig, socks5_socket_factory
+
+_COPY_BUFFER = 1024 * 1024
+
+
+class GcsStorage(StorageBackend):
+    def __init__(self) -> None:
+        self.http: Optional[HttpClient] = None
+        self.bucket = ""
+        self.chunk_size = 0
+        self._token_provider: Optional[ServiceAccountTokenProvider] = None
+        self._metric_collector = None
+
+    def configure(self, configs: Mapping[str, object]) -> None:
+        config = GcsStorageConfig(configs)
+        proxy = ProxyConfig.from_configs(configs)
+        endpoint = config.endpoint_url or "https://storage.googleapis.com"
+        observer = None
+        try:
+            from tieredstorage_tpu.storage.gcs.metrics import GcsMetricCollector
+
+            self._metric_collector = GcsMetricCollector()
+            observer = self._metric_collector.observe
+        except Exception:
+            self._metric_collector = None
+        self.http = HttpClient(
+            endpoint,
+            socket_factory=socks5_socket_factory(proxy),
+            observer=observer,
+        )
+        self.bucket = config.bucket_name
+        self.chunk_size = config.resumable_upload_chunk_size
+        credentials = config.credentials_json()
+        self._token_provider = (
+            ServiceAccountTokenProvider(credentials) if credentials is not None else None
+        )
+
+    # ------------------------------------------------------------- plumbing
+    def _require_http(self) -> HttpClient:
+        if self.http is None:
+            raise StorageBackendException("GcsStorage is not configured")
+        return self.http
+
+    def _headers(self, extra: Optional[dict] = None) -> dict[str, str]:
+        headers = {"Host": f"{self.http.host}:{self.http.port}"}
+        if self._token_provider is not None:
+            headers["Authorization"] = f"Bearer {self._token_provider.token()}"
+        if extra:
+            headers.update(extra)
+        return headers
+
+    def _object_path(self, key: ObjectKey, *, media: bool = False) -> str:
+        # Object names are a single path element in the JSON API: '/' must be
+        # percent-encoded (safe="" below).
+        encoded = quote(key.value, safe="")
+        base = f"/storage/v1/b/{self.bucket}/o/{encoded}"
+        return base + "?alt=media" if media else base
+
+    # --------------------------------------------------------------- upload
+    def upload(self, input_stream: BinaryIO, key: ObjectKey) -> int:
+        http = self._require_http()
+        name = quote(key.value, safe="")
+        try:
+            resp = http.request(
+                "POST",
+                f"/upload/storage/v1/b/{self.bucket}/o?uploadType=resumable&name={name}",
+                headers=self._headers({"Content-Type": "application/json"}),
+                body=b"{}",
+            )
+            if resp.status != 200:
+                raise StorageBackendException(
+                    f"Failed to initiate resumable upload for {key}: HTTP {resp.status}"
+                )
+            location = resp.header("location")
+            if not location:
+                raise StorageBackendException(
+                    f"No resumable session URI returned for {key}"
+                )
+            session = urlsplit(location)
+            session_path = session.path + ("?" + session.query if session.query else "")
+            return self._upload_session(http, session_path, input_stream, key)
+        except HttpError as e:
+            raise StorageBackendException(f"Failed to upload {key}") from e
+
+    def _upload_session(
+        self, http: HttpClient, session_path: str, input_stream: BinaryIO, key: ObjectKey
+    ) -> int:
+        # One-chunk lookahead so the last data chunk carries the known total
+        # (a chunk sent with total '*' must NOT be the final one: an object
+        # whose size is an exact chunk multiple must finalize with its last
+        # data chunk or 'bytes */total', never an empty 'N-(N-1)' range).
+        offset = 0
+        chunks = iter_chunks(input_stream, self.chunk_size, read_size=_COPY_BUFFER)
+        current = next(chunks, None)
+        if current is None:
+            # Empty object: finalize with a zero-length total.
+            resp = http.request(
+                "PUT",
+                session_path,
+                headers=self._headers({"Content-Range": "bytes */0"}),
+            )
+            if resp.status not in (200, 201):
+                raise StorageBackendException(
+                    f"Failed to finalize empty upload for {key}: HTTP {resp.status}"
+                )
+            return 0
+        while current is not None:
+            upcoming = next(chunks, None)
+            total = "*" if upcoming is not None else str(offset + len(current))
+            content_range = f"bytes {offset}-{offset + len(current) - 1}/{total}"
+            resp = http.request(
+                "PUT",
+                session_path,
+                headers=self._headers({"Content-Range": content_range}),
+                body=current,
+            )
+            if upcoming is not None and resp.status != 308:
+                raise StorageBackendException(
+                    f"Resumable chunk for {key} not accepted: HTTP {resp.status}"
+                )
+            if upcoming is None and resp.status not in (200, 201):
+                raise StorageBackendException(
+                    f"Failed to finalize upload for {key}: HTTP {resp.status}"
+                )
+            offset += len(current)
+            current = upcoming
+        return offset
+
+    # ---------------------------------------------------------------- fetch
+    def fetch(self, key: ObjectKey, byte_range: Optional[BytesRange] = None) -> BinaryIO:
+        http = self._require_http()
+        extra: dict[str, str] = {}
+        if byte_range is not None:
+            # Out-of-range starts surface as 416 from the media GET below;
+            # no separate metadata round trip on the hot ranged-fetch path.
+            extra["Range"] = f"bytes={byte_range.from_position}-{byte_range.to_position}"
+        try:
+            status, headers, stream = http.request_stream(
+                "GET", self._object_path(key, media=True), headers=self._headers(extra)
+            )
+        except HttpError as e:
+            raise StorageBackendException(f"Failed to fetch {key}") from e
+        if status in (200, 206):
+            return stream
+        body = stream.read()
+        stream.close()
+        if status == 404:
+            raise KeyNotFoundException(self, key)
+        if status == 416:
+            raise InvalidRangeException(f"Failed to fetch {key}: Invalid range {byte_range}")
+        raise StorageBackendException(f"Failed to fetch {key}: HTTP {status}: {body[:200]!r}")
+
+    # --------------------------------------------------------------- delete
+    def delete(self, key: ObjectKey) -> None:
+        http = self._require_http()
+        try:
+            resp = http.request("DELETE", self._object_path(key), headers=self._headers())
+        except HttpError as e:
+            raise StorageBackendException(f"Failed to delete {key}") from e
+        if resp.status not in (204, 200, 404):  # missing keys are not an error
+            raise StorageBackendException(f"Failed to delete {key}: HTTP {resp.status}")
+
+    @property
+    def metrics(self):
+        return self._metric_collector
+
+    def close(self) -> None:
+        if self.http is not None:
+            self.http.close()
+
+    def __str__(self) -> str:
+        return f"GcsStorage{{bucket={self.bucket}}}"

@@ -7,7 +7,6 @@ delete, 404 → KeyNotFoundException and 416 → InvalidRangeException mapping.
 
 from __future__ import annotations
 
-import io
 from typing import BinaryIO, Iterable, Mapping, Optional
 
 from tieredstorage_tpu.storage.core import (
@@ -88,8 +87,6 @@ class S3Storage(StorageBackend):
     # ---------------------------------------------------------------- fetch
     def fetch(self, key: ObjectKey, byte_range: Optional[BytesRange] = None) -> BinaryIO:
         client = self._require_client()
-        if byte_range is not None and byte_range.size == 0:
-            return io.BytesIO(b"")
         rng = (
             (byte_range.from_position, byte_range.to_position)
             if byte_range is not None
