@@ -33,6 +33,7 @@ class _Handler(BaseHTTPRequestHandler):
     account: str | None
     account_key: str | None
     require_sas: bool
+    path_prefix: str | None
 
     def log_message(self, fmt, *args):
         pass
@@ -119,7 +120,11 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _split(self) -> tuple[str, str, dict[str, str]]:
         parts = urlsplit(self.path)
-        segs = parts.path.lstrip("/").split("/", 1)
+        path = parts.path.lstrip("/")
+        # Azurite-style account path prefix (http://host:port/account/...).
+        if self.path_prefix and path.startswith(self.path_prefix + "/"):
+            path = path[len(self.path_prefix) + 1 :]
+        segs = path.split("/", 1)
         container = segs[0] if segs else ""
         blob = unquote(segs[1]) if len(segs) > 1 else ""
         return container, blob, {k: v[0] for k, v in parse_qs(parts.query, keep_blank_values=True).items()}
@@ -207,6 +212,7 @@ class AzureEmulator:
         account: str | None = None,
         account_key: str | None = None,
         require_sas: bool = False,
+        path_prefix: str | None = None,
     ) -> None:
         self.state = AzureState()
         handler = type(
@@ -217,6 +223,7 @@ class AzureEmulator:
                 "account": account,
                 "account_key": account_key,
                 "require_sas": require_sas,
+                "path_prefix": path_prefix,
             },
         )
         self.server = ThreadingHTTPServer(("127.0.0.1", 0), handler)

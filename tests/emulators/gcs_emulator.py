@@ -21,6 +21,9 @@ class GcsState:
         self.sessions: dict[str, dict] = {}  # id -> {bucket, name, data}
         self.lock = threading.Lock()
         self.fail_next: list[tuple] = []
+        # Partial-commit injection: next non-final resumable chunk persists
+        # only this many of its bytes; the 308 reports the short Range.
+        self.partial_next: list[int] = []
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -116,11 +119,19 @@ class _Handler(BaseHTTPRequestHandler):
                 if start != len(session["data"]):
                     self._reply(400, b'{"error": "offset mismatch"}')
                     return
-                session["data"].extend(body)
                 total = m.group(3)
+                if total == "*" and self.state.partial_next:
+                    keep = self.state.partial_next.pop(0)
+                    session["data"].extend(body[:keep])
+                    self._reply(
+                        308, headers={"Range": f"bytes=0-{len(session['data']) - 1}"}
+                    )
+                    return
+                session["data"].extend(body)
                 if total == "*":
-                    end = int(m.group(2))
-                    self._reply(308, headers={"Range": f"bytes=0-{end}"})
+                    self._reply(
+                        308, headers={"Range": f"bytes=0-{len(session['data']) - 1}"}
+                    )
                     return
                 if len(session["data"]) != int(total):
                     self._reply(400, b'{"error": "size mismatch"}')

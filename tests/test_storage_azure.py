@@ -145,6 +145,33 @@ class TestAzureConnectionString:
             )
 
 
+class TestAzuritePathPrefixEndpoint:
+    def test_endpoint_with_account_path_prefix(self):
+        # Azurite connection strings carry the account as a path component
+        # (BlobEndpoint=http://host:10000/devstoreaccount1); the prefix must
+        # survive into every request path and the SharedKey canonicalization.
+        emu = AzureEmulator(
+            account=ACCOUNT, account_key=ACCOUNT_KEY, path_prefix=ACCOUNT
+        ).start()
+        try:
+            conn = (
+                f"DefaultEndpointsProtocol=http;AccountName={ACCOUNT};"
+                f"AccountKey={ACCOUNT_KEY};BlobEndpoint={emu.endpoint}/{ACCOUNT}"
+            )
+            backend = AzureBlobStorage()
+            backend.configure(
+                {"azure.connection.string": conn, "azure.container.name": "cont"}
+            )
+            key = ObjectKey("prefixed/blob.log")
+            backend.upload(io.BytesIO(b"behind a path prefix"), key)
+            with backend.fetch(key) as s:
+                assert s.read() == b"behind a path prefix"
+            backend.delete(key)
+            assert emu.state.auth_failures == 0
+        finally:
+            emu.stop()
+
+
 class TestAzureSasToken:
     def test_sas_params_attached(self):
         emu = AzureEmulator(require_sas=True).start()

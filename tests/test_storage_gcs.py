@@ -73,6 +73,19 @@ class TestGcsResumableUpload:
         with emulator.state.lock:
             assert not emulator.state.sessions
 
+    def test_partial_308_resumes_from_server_offset(self, emulator):
+        # A 308 may report fewer bytes committed than sent; the client must
+        # resend the uncommitted tail from the server-reported offset.
+        backend = make_backend(emulator)
+        backend.chunk_size = 256 * 1024
+        data = bytes((i * 13) % 256 for i in range(700 * 1024))
+        with emulator.state.lock:
+            emulator.state.partial_next.append(100 * 1024)  # first chunk: keep 100K
+        key = ObjectKey("big/partial-commit.log")
+        assert backend.upload(io.BytesIO(data), key) == len(data)
+        with backend.fetch(key) as s:
+            assert s.read() == data
+
     def test_chunk_size_must_be_quantized(self):
         with pytest.raises(ConfigException):
             GcsStorageConfig(

@@ -1,0 +1,104 @@
+"""Native (C++) transform backend: wire compatibility vs the CPU oracle.
+
+Mirrors the reference's TransformsEndToEndTest round-trip matrix (SURVEY §4)
+for the native backend, plus cross-backend wire checks: bytes produced by the
+native backend must detransform through the CPU backend and vice versa.
+Skips when the native library can't build (no g++/zstd/libcrypto).
+"""
+
+from __future__ import annotations
+
+import secrets
+
+import numpy as np
+import pytest
+
+from tieredstorage_tpu import native
+from tieredstorage_tpu.security.aes import AesEncryptionProvider
+from tieredstorage_tpu.transform.api import (
+    AuthenticationError,
+    DetransformOptions,
+    TransformOptions,
+)
+from tieredstorage_tpu.transform.cpu import CpuTransformBackend
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native transform library unavailable"
+)
+
+CHUNK = 8192
+
+
+@pytest.fixture(scope="module")
+def backend():
+    from tieredstorage_tpu.transform.native_backend import NativeTransformBackend
+
+    return NativeTransformBackend()
+
+
+@pytest.fixture(scope="module")
+def keyaad():
+    return AesEncryptionProvider().create_data_key_and_aad()
+
+
+def chunks_of(data: bytes, size: int = CHUNK) -> list[bytes]:
+    return [data[i : i + size] for i in range(0, len(data), size)]
+
+
+@pytest.mark.parametrize("compression", [False, True])
+@pytest.mark.parametrize("encryption", [False, True])
+def test_round_trip(backend, keyaad, compression, encryption):
+    rng = np.random.default_rng(7)
+    # Half compressible, half noise, chunk-unaligned tail.
+    data = (b"log-record " * 3000) + rng.integers(0, 256, 40961, np.uint8).tobytes()
+    chunks = chunks_of(data)
+    opts = TransformOptions(
+        compression=compression,
+        encryption=keyaad if encryption else None,
+    )
+    transformed = backend.transform(chunks, opts)
+    dopts = DetransformOptions(
+        compression=compression, encryption=keyaad if encryption else None
+    )
+    assert backend.detransform(transformed, dopts) == chunks
+
+
+@pytest.mark.parametrize("compression", [False, True])
+def test_wire_compatible_with_cpu_backend(backend, keyaad, compression):
+    cpu = CpuTransformBackend()
+    data = b"interchangeable bytes " * 4000
+    chunks = chunks_of(data)
+    ivs = [secrets.token_bytes(12) for _ in chunks]
+    opts = TransformOptions(compression=compression, encryption=keyaad, ivs=ivs)
+    dopts = DetransformOptions(compression=compression, encryption=keyaad)
+
+    native_out = backend.transform(chunks, opts)
+    cpu_out = cpu.transform(chunks, opts)
+    # Same IVs + same zstd level ⇒ byte-identical wire output.
+    assert native_out == cpu_out
+    # And each detransforms through the other.
+    assert cpu.detransform(native_out, dopts) == chunks
+    assert backend.detransform(cpu_out, dopts) == chunks
+
+
+def test_tamper_detection(backend, keyaad):
+    chunks = [b"a" * CHUNK, b"b" * CHUNK]
+    out = backend.transform(chunks, TransformOptions(encryption=keyaad))
+    bad = [out[0], out[1][:-1] + bytes([out[1][-1] ^ 0x80])]
+    with pytest.raises(AuthenticationError):
+        backend.detransform(bad, DetransformOptions(encryption=keyaad))
+
+
+def test_empty_and_tiny_chunks(backend, keyaad):
+    chunks = [b"", b"x", b"yz"]
+    opts = TransformOptions(compression=True, encryption=keyaad)
+    dopts = DetransformOptions(compression=True, encryption=keyaad)
+    assert backend.detransform(backend.transform(chunks, opts), dopts) == chunks
+
+
+def test_large_batch_threads(backend, keyaad):
+    rng = np.random.default_rng(11)
+    chunks = [rng.integers(0, 256, CHUNK, np.uint8).tobytes() for _ in range(64)]
+    opts = TransformOptions(compression=True, encryption=keyaad)
+    dopts = DetransformOptions(compression=True, encryption=keyaad)
+    assert backend.detransform(backend.transform(chunks, opts), dopts) == chunks

@@ -1,0 +1,234 @@
+"""ctypes bindings for the native host transform library.
+
+The reference's hot per-chunk loop bottoms out in native code it links
+against (zstd-jni, JDK AES-GCM intrinsics — SURVEY §2.2). This package is
+the TPU build's equivalent: `native/transform_host.cpp` compiled to
+libtransform_host.so (lazily, with the in-tree Makefile) and driven in
+batches — one Python↔C crossing per chunk window, C++ thread-pool
+parallelism inside.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+_NATIVE_DIR = _REPO_ROOT / "native"
+_SO_PATH = _NATIVE_DIR / "libtransform_host.so"
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_error: Optional[str] = None
+
+IV_SIZE = 12
+TAG_SIZE = 16
+
+_u64p = ctypes.POINTER(ctypes.c_uint64)
+_u8p = ctypes.POINTER(ctypes.c_uint8)
+
+
+def _build() -> None:
+    subprocess.run(
+        ["make", "-s"],
+        cwd=_NATIVE_DIR,
+        check=True,
+        capture_output=True,
+        text=True,
+    )
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.ts_crypto_available.restype = ctypes.c_int
+    lib.ts_zstd_bound.restype = ctypes.c_size_t
+    lib.ts_zstd_bound.argtypes = [ctypes.c_size_t]
+    common_zstd = [
+        _u8p, _u64p, _u64p, ctypes.c_int,
+    ]
+    lib.ts_zstd_compress_batch.restype = ctypes.c_int
+    lib.ts_zstd_compress_batch.argtypes = common_zstd + [
+        ctypes.c_int, _u8p, ctypes.c_uint64, _u64p, ctypes.c_int,
+    ]
+    lib.ts_zstd_decompress_batch.restype = ctypes.c_int
+    lib.ts_zstd_decompress_batch.argtypes = common_zstd + [
+        _u8p, ctypes.c_uint64, _u64p, ctypes.c_int,
+    ]
+    aes_common = [
+        _u8p, _u8p, ctypes.c_uint64,  # key, aad, aad_len
+    ]
+    lib.ts_aes_gcm_encrypt_batch.restype = ctypes.c_int
+    lib.ts_aes_gcm_encrypt_batch.argtypes = aes_common + [
+        _u8p,  # ivs
+        _u8p, _u64p, _u64p, ctypes.c_int,  # in, offsets, sizes, n
+        _u8p, ctypes.c_uint64, _u64p, ctypes.c_int,  # out, stride, out_sizes, threads
+    ]
+    lib.ts_aes_gcm_decrypt_batch.restype = ctypes.c_int
+    lib.ts_aes_gcm_decrypt_batch.argtypes = aes_common + [
+        _u8p, _u64p, _u64p, ctypes.c_int,
+        _u8p, ctypes.c_uint64, _u64p, ctypes.c_int,
+    ]
+    return lib
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None when unavailable."""
+    global _lib, _load_error
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _load_error is not None:
+            return None
+        try:
+            if not _SO_PATH.exists() or (
+                _SO_PATH.stat().st_mtime
+                < (_NATIVE_DIR / "transform_host.cpp").stat().st_mtime
+            ):
+                _build()
+            _lib = _bind(ctypes.CDLL(str(_SO_PATH)))
+            return _lib
+        except (OSError, subprocess.CalledProcessError, AttributeError) as e:
+            _load_error = str(e)
+            return None
+
+
+def available() -> bool:
+    lib = load()
+    return lib is not None and lib.ts_crypto_available() == 1
+
+
+def _pack(chunks: list[bytes]) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    sizes = np.array([len(c) for c in chunks], dtype=np.uint64)
+    offsets = np.zeros(len(chunks), dtype=np.uint64)
+    if len(chunks) > 1:
+        offsets[1:] = np.cumsum(sizes[:-1])
+    buf = np.frombuffer(b"".join(chunks), dtype=np.uint8) if chunks else np.zeros(0, np.uint8)
+    return buf, offsets, sizes
+
+
+def _as_u8p(arr: np.ndarray):
+    return arr.ctypes.data_as(_u8p)
+
+
+def _as_u64p(arr: np.ndarray):
+    return arr.ctypes.data_as(_u64p)
+
+
+class NativeTransformError(RuntimeError):
+    pass
+
+
+class NativeAuthenticationError(NativeTransformError):
+    """GCM tag verification failed for at least one chunk."""
+
+
+def zstd_compress_batch(chunks: list[bytes], level: int = 3, n_threads: int = 0) -> list[bytes]:
+    lib = load()
+    if lib is None:
+        raise NativeTransformError(f"native library unavailable: {_load_error}")
+    if not chunks:
+        return []
+    buf, offsets, sizes = _pack(chunks)
+    stride = int(lib.ts_zstd_bound(int(sizes.max())))
+    out = np.empty(len(chunks) * stride, dtype=np.uint8)
+    out_sizes = np.zeros(len(chunks), dtype=np.uint64)
+    rc = lib.ts_zstd_compress_batch(
+        _as_u8p(buf), _as_u64p(offsets), _as_u64p(sizes), len(chunks),
+        level, _as_u8p(out), stride, _as_u64p(out_sizes), n_threads,
+    )
+    if rc != 0:
+        raise NativeTransformError(f"zstd compress failed on chunk {rc - 1}")
+    return [
+        out[i * stride : i * stride + int(out_sizes[i])].tobytes()
+        for i in range(len(chunks))
+    ]
+
+
+def zstd_decompress_batch(
+    chunks: list[bytes], max_decompressed: int, n_threads: int = 0
+) -> list[bytes]:
+    lib = load()
+    if lib is None:
+        raise NativeTransformError(f"native library unavailable: {_load_error}")
+    if not chunks:
+        return []
+    buf, offsets, sizes = _pack(chunks)
+    stride = max_decompressed
+    out = np.empty(len(chunks) * stride, dtype=np.uint8)
+    out_sizes = np.zeros(len(chunks), dtype=np.uint64)
+    rc = lib.ts_zstd_decompress_batch(
+        _as_u8p(buf), _as_u64p(offsets), _as_u64p(sizes), len(chunks),
+        _as_u8p(out), stride, _as_u64p(out_sizes), n_threads,
+    )
+    if rc != 0:
+        raise NativeTransformError(f"zstd decompress failed on chunk {rc - 1}")
+    return [
+        out[i * stride : i * stride + int(out_sizes[i])].tobytes()
+        for i in range(len(chunks))
+    ]
+
+
+def aes_gcm_encrypt_batch(
+    key: bytes, aad: bytes, ivs: np.ndarray, chunks: list[bytes], n_threads: int = 0
+) -> list[bytes]:
+    lib = load()
+    if lib is None or lib.ts_crypto_available() != 1:
+        raise NativeTransformError("native AES unavailable")
+    if not chunks:
+        return []
+    buf, offsets, sizes = _pack(chunks)
+    ivs = np.ascontiguousarray(ivs, dtype=np.uint8)
+    if ivs.shape != (len(chunks), IV_SIZE):
+        raise ValueError(f"ivs must be ({len(chunks)}, {IV_SIZE}), got {ivs.shape}")
+    key_arr = np.frombuffer(key, dtype=np.uint8)
+    aad_arr = np.frombuffer(aad, dtype=np.uint8) if aad else np.zeros(0, np.uint8)
+    stride = int(sizes.max()) + IV_SIZE + TAG_SIZE
+    out = np.empty(len(chunks) * stride, dtype=np.uint8)
+    out_sizes = np.zeros(len(chunks), dtype=np.uint64)
+    rc = lib.ts_aes_gcm_encrypt_batch(
+        _as_u8p(key_arr), _as_u8p(aad_arr), len(aad),
+        _as_u8p(ivs), _as_u8p(buf), _as_u64p(offsets), _as_u64p(sizes), len(chunks),
+        _as_u8p(out), stride, _as_u64p(out_sizes), n_threads,
+    )
+    if rc == -1:
+        raise NativeTransformError("native AES unavailable")
+    if rc != 0:
+        raise NativeTransformError(f"AES-GCM encrypt failed on chunk {rc - 1}")
+    return [
+        out[i * stride : i * stride + int(out_sizes[i])].tobytes()
+        for i in range(len(chunks))
+    ]
+
+
+def aes_gcm_decrypt_batch(
+    key: bytes, aad: bytes, chunks: list[bytes], n_threads: int = 0
+) -> list[bytes]:
+    lib = load()
+    if lib is None or lib.ts_crypto_available() != 1:
+        raise NativeTransformError("native AES unavailable")
+    if not chunks:
+        return []
+    buf, offsets, sizes = _pack(chunks)
+    key_arr = np.frombuffer(key, dtype=np.uint8)
+    aad_arr = np.frombuffer(aad, dtype=np.uint8) if aad else np.zeros(0, np.uint8)
+    stride = max(int(sizes.max()) - IV_SIZE - TAG_SIZE, 1)
+    out = np.empty(len(chunks) * stride, dtype=np.uint8)
+    out_sizes = np.zeros(len(chunks), dtype=np.uint64)
+    rc = lib.ts_aes_gcm_decrypt_batch(
+        _as_u8p(key_arr), _as_u8p(aad_arr), len(aad),
+        _as_u8p(buf), _as_u64p(offsets), _as_u64p(sizes), len(chunks),
+        _as_u8p(out), stride, _as_u64p(out_sizes), n_threads,
+    )
+    if rc == -1:
+        raise NativeTransformError("native AES unavailable")
+    if rc != 0:
+        raise NativeAuthenticationError(f"GCM tag mismatch on chunks [{rc - 1}]")
+    return [
+        out[i * stride : i * stride + int(out_sizes[i])].tobytes()
+        for i in range(len(chunks))
+    ]

@@ -2,22 +2,15 @@
 
 Reference: storage/azure/.../MetricCollector.java + MetricRegistry.java —
 an HTTP pipeline policy classifying requests into blob-get / blob-upload /
-blob-delete / block-upload / block-list. Same classes here, fed by the
-HttpClient observer.
+blob-delete / block-upload / block-list. Same classes here, with sensor
+shapes from the shared RequestMetricCollector.
 """
 
 from __future__ import annotations
 
 from typing import Optional
 
-from tieredstorage_tpu.metrics.core import (
-    Avg,
-    Max,
-    MetricName,
-    MetricsRegistry,
-    Rate,
-    Total,
-)
+from tieredstorage_tpu.storage.request_metrics import RequestMetricCollector
 
 GROUP = "azure-blob-client-metrics"
 CONTEXT = "aiven.kafka.server.tieredstorage.azure"
@@ -25,9 +18,7 @@ CONTEXT = "aiven.kafka.server.tieredstorage.azure"
 
 def _classify(method: str, path_and_query: str) -> Optional[str]:
     query = path_and_query.partition("?")[2]
-    params = dict(
-        p.partition("=")[::2] for p in query.split("&") if p
-    )
+    params = dict(p.partition("=")[::2] for p in query.split("&") if p)
     comp = params.get("comp")
     if method == "GET":
         return "blob-get"
@@ -42,34 +33,6 @@ def _classify(method: str, path_and_query: str) -> Optional[str]:
     return None
 
 
-class AzureMetricCollector:
-    def __init__(self, registry: Optional[MetricsRegistry] = None):
-        self.registry = registry or MetricsRegistry()
-
-    def observe(
-        self,
-        method: str,
-        path_and_query: str,
-        status: int,
-        elapsed_s: float,
-        error: Optional[BaseException],
-    ) -> None:
-        op = _classify(method, path_and_query)
-        if op is None:
-            return
-        requests = self.registry.sensor(f"{op}-requests")
-        requests.ensure_stats(
-            lambda: [
-                (MetricName.of(f"{op}-requests-rate", GROUP), Rate()),
-                (MetricName.of(f"{op}-requests-total", GROUP), Total()),
-            ]
-        )
-        requests.record(1.0)
-        timing = self.registry.sensor(f"{op}-time")
-        timing.ensure_stats(
-            lambda: [
-                (MetricName.of(f"{op}-time-avg", GROUP), Avg()),
-                (MetricName.of(f"{op}-time-max", GROUP), Max()),
-            ]
-        )
-        timing.record(elapsed_s * 1000.0)
+class AzureMetricCollector(RequestMetricCollector):
+    def __init__(self, registry=None):
+        super().__init__(GROUP, _classify, registry)

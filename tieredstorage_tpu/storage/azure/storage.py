@@ -49,18 +49,13 @@ class AzureBlobStorage(StorageBackend):
         config = AzureBlobStorageConfig(configs)
         proxy = ProxyConfig.from_configs(configs)
         endpoint, account, key, sas = config.resolve()
-        observer = None
-        try:
-            from tieredstorage_tpu.storage.azure.metrics import AzureMetricCollector
+        from tieredstorage_tpu.storage.azure.metrics import AzureMetricCollector
 
-            self._metric_collector = AzureMetricCollector()
-            observer = self._metric_collector.observe
-        except Exception:
-            self._metric_collector = None
+        self._metric_collector = AzureMetricCollector()
         self.http = HttpClient(
             endpoint,
             socket_factory=socks5_socket_factory(proxy),
-            observer=observer,
+            observer=self._metric_collector.observe,
         )
         self.container = config.container_name
         self.block_size = config.upload_block_size
@@ -84,7 +79,7 @@ class AzureBlobStorage(StorageBackend):
         stream: bool = False,
     ):
         http = self._require_http()
-        path = f"/{self.container}/" + quote(key_value, safe="/-._~")
+        path = f"{http.base_path}/{self.container}/" + quote(key_value, safe="/-._~")
         headers = {
             "Host": f"{http.host}:{http.port}",
             # RFC 1123 date, locale-independent (strftime %a/%b would break

@@ -2,22 +2,15 @@
 
 Reference: storage/gcs/.../MetricCollector.java:66-83,146-160 wraps the HTTP
 transport and classifies requests by URL regex into object-metadata /
-object-download / object-upload (+ resumable-chunk detail). Same
-classification here, applied as an HttpClient observer.
+object-download / object-upload. Same classification here, with sensor
+shapes from the shared RequestMetricCollector.
 """
 
 from __future__ import annotations
 
 from typing import Optional
 
-from tieredstorage_tpu.metrics.core import (
-    Avg,
-    Max,
-    MetricName,
-    MetricsRegistry,
-    Rate,
-    Total,
-)
+from tieredstorage_tpu.storage.request_metrics import RequestMetricCollector
 
 GROUP = "gcs-client-metrics"
 CONTEXT = "aiven.kafka.server.tieredstorage.gcs"
@@ -25,9 +18,9 @@ CONTEXT = "aiven.kafka.server.tieredstorage.gcs"
 
 def _classify(method: str, path_and_query: str) -> Optional[str]:
     path = path_and_query.partition("?")[0]
-    if path.startswith("/upload/storage/"):
+    if "/upload/storage/" in path:
         return "object-upload"
-    if "alt=media" in path_and_query or path.startswith("/download/"):
+    if "alt=media" in path_and_query or "/download/" in path:
         return "object-download"
     if "/storage/v1/b/" in path and "/o/" in path:
         if method == "GET":
@@ -37,34 +30,6 @@ def _classify(method: str, path_and_query: str) -> Optional[str]:
     return None
 
 
-class GcsMetricCollector:
-    def __init__(self, registry: Optional[MetricsRegistry] = None):
-        self.registry = registry or MetricsRegistry()
-
-    def observe(
-        self,
-        method: str,
-        path_and_query: str,
-        status: int,
-        elapsed_s: float,
-        error: Optional[BaseException],
-    ) -> None:
-        op = _classify(method, path_and_query)
-        if op is None:
-            return
-        requests = self.registry.sensor(f"{op}-requests")
-        requests.ensure_stats(
-            lambda: [
-                (MetricName.of(f"{op}-requests-rate", GROUP), Rate()),
-                (MetricName.of(f"{op}-requests-total", GROUP), Total()),
-            ]
-        )
-        requests.record(1.0)
-        timing = self.registry.sensor(f"{op}-time")
-        timing.ensure_stats(
-            lambda: [
-                (MetricName.of(f"{op}-time-avg", GROUP), Avg()),
-                (MetricName.of(f"{op}-time-max", GROUP), Max()),
-            ]
-        )
-        timing.record(elapsed_s * 1000.0)
+class GcsMetricCollector(RequestMetricCollector):
+    def __init__(self, registry=None):
+        super().__init__(GROUP, _classify, registry)

@@ -29,6 +29,14 @@ from tieredstorage_tpu.storage.proxy import ProxyConfig, socks5_socket_factory
 _COPY_BUFFER = 1024 * 1024
 
 
+def _committed_bytes(range_header: str, default: int) -> int:
+    """Bytes the server has persisted, from a 308's 'Range: bytes=0-N'."""
+    import re
+
+    m = re.fullmatch(r"bytes=0-(\d+)", range_header.strip()) if range_header else None
+    return int(m.group(1)) + 1 if m else default
+
+
 class GcsStorage(StorageBackend):
     def __init__(self) -> None:
         self.http: Optional[HttpClient] = None
@@ -41,18 +49,13 @@ class GcsStorage(StorageBackend):
         config = GcsStorageConfig(configs)
         proxy = ProxyConfig.from_configs(configs)
         endpoint = config.endpoint_url or "https://storage.googleapis.com"
-        observer = None
-        try:
-            from tieredstorage_tpu.storage.gcs.metrics import GcsMetricCollector
+        from tieredstorage_tpu.storage.gcs.metrics import GcsMetricCollector
 
-            self._metric_collector = GcsMetricCollector()
-            observer = self._metric_collector.observe
-        except Exception:
-            self._metric_collector = None
+        self._metric_collector = GcsMetricCollector()
         self.http = HttpClient(
             endpoint,
             socket_factory=socks5_socket_factory(proxy),
-            observer=observer,
+            observer=self._metric_collector.observe,
         )
         self.bucket = config.bucket_name
         self.chunk_size = config.resumable_upload_chunk_size
@@ -79,7 +82,7 @@ class GcsStorage(StorageBackend):
         # Object names are a single path element in the JSON API: '/' must be
         # percent-encoded (safe="" below).
         encoded = quote(key.value, safe="")
-        base = f"/storage/v1/b/{self.bucket}/o/{encoded}"
+        base = f"{self.http.base_path}/storage/v1/b/{self.bucket}/o/{encoded}"
         return base + "?alt=media" if media else base
 
     # --------------------------------------------------------------- upload
@@ -89,7 +92,8 @@ class GcsStorage(StorageBackend):
         try:
             resp = http.request(
                 "POST",
-                f"/upload/storage/v1/b/{self.bucket}/o?uploadType=resumable&name={name}",
+                f"{http.base_path}/upload/storage/v1/b/{self.bucket}/o"
+                f"?uploadType=resumable&name={name}",
                 headers=self._headers({"Content-Type": "application/json"}),
                 body=b"{}",
             )
@@ -130,8 +134,8 @@ class GcsStorage(StorageBackend):
                     f"Failed to finalize empty upload for {key}: HTTP {resp.status}"
                 )
             return 0
+        upcoming = next(chunks, None)
         while current is not None:
-            upcoming = next(chunks, None)
             total = "*" if upcoming is not None else str(offset + len(current))
             content_range = f"bytes {offset}-{offset + len(current) - 1}/{total}"
             resp = http.request(
@@ -140,16 +144,31 @@ class GcsStorage(StorageBackend):
                 headers=self._headers({"Content-Range": content_range}),
                 body=current,
             )
-            if upcoming is not None and resp.status != 308:
-                raise StorageBackendException(
-                    f"Resumable chunk for {key} not accepted: HTTP {resp.status}"
-                )
-            if upcoming is None and resp.status not in (200, 201):
+            if upcoming is not None:
+                if resp.status != 308:
+                    raise StorageBackendException(
+                        f"Resumable chunk for {key} not accepted: HTTP {resp.status}"
+                    )
+                # A 308 may report fewer bytes committed than sent
+                # (Range: bytes=0-N); resume from the server's offset.
+                committed = _committed_bytes(resp.header("range"), offset + len(current))
+                if committed < offset + len(current):
+                    if committed <= offset:
+                        raise StorageBackendException(
+                            f"Resumable upload for {key} made no progress "
+                            f"(committed={committed}, offset={offset})"
+                        )
+                    current = current[committed - offset :]
+                    offset = committed
+                    continue
+            elif resp.status not in (200, 201):
                 raise StorageBackendException(
                     f"Failed to finalize upload for {key}: HTTP {resp.status}"
                 )
             offset += len(current)
-            current = upcoming
+            current, upcoming = upcoming, (
+                next(chunks, None) if upcoming is not None else None
+            )
         return offset
 
     # ---------------------------------------------------------------- fetch

@@ -125,6 +125,10 @@ class HttpClient:
         self.scheme = parts.scheme
         self.host = parts.hostname or ""
         self.port = parts.port or (443 if self.scheme == "https" else 80)
+        # Path prefix of the endpoint URL (e.g. Azurite's
+        # http://host:10000/devstoreaccount1) — callers prepend this to every
+        # request path.
+        self.base_path = parts.path.rstrip("/")
         self.timeout = timeout
         self.socket_factory = socket_factory
         self.observer = observer

@@ -83,8 +83,8 @@ class S3Client:
     def _path(self, key: str) -> str:
         encoded = quote(key, safe="/-._~")
         if self.path_style:
-            return f"/{self.bucket}/{encoded}"
-        return f"/{encoded}"
+            return f"{self.http.base_path}/{self.bucket}/{encoded}"
+        return f"{self.http.base_path}/{encoded}"
 
     def _host_header(self) -> str:
         default_port = 443 if self.http.scheme == "https" else 80

@@ -1,26 +1,16 @@
 """S3 backend request metrics.
 
 Reference: storage/s3/.../MetricCollector.java implements the AWS SDK
-`MetricPublisher`; metric names in storage/s3/.../MetricRegistry.java:26-70:
-{get,put,delete,delete-objects,upload-part,create-multipart-upload,
-complete-multipart-upload,abort-multipart-upload}-requests (+-rate/-total) and
--time (-avg/-max), plus error classes (throttling/server/io/configured-timeout).
-Here the collector is an HttpClient observer classifying calls by method +
-query shape instead of SDK execution interceptors.
+`MetricPublisher`; metric names in storage/s3/.../MetricRegistry.java:26-70.
+Requests are classified by method + query shape instead of SDK execution
+interceptors; sensor shapes come from the shared RequestMetricCollector.
 """
 
 from __future__ import annotations
 
 from typing import Optional
 
-from tieredstorage_tpu.metrics.core import (
-    Avg,
-    Max,
-    MetricName,
-    MetricsRegistry,
-    Rate,
-    Total,
-)
+from tieredstorage_tpu.storage.request_metrics import RequestMetricCollector
 
 GROUP = "s3-client-metrics"
 CONTEXT = "aiven.kafka.server.tieredstorage.s3"
@@ -47,58 +37,6 @@ def _classify(method: str, path_and_query: str) -> Optional[str]:
     return None
 
 
-class S3MetricCollector:
-    def __init__(self, registry: Optional[MetricsRegistry] = None):
-        self.registry = registry or MetricsRegistry()
-
-    def _sensor(self, op: str):
-        sensor = self.registry.sensor(f"{op}-requests")
-        sensor.ensure_stats(
-            lambda: [
-                (MetricName.of(f"{op}-requests-rate", GROUP), Rate()),
-                (MetricName.of(f"{op}-requests-total", GROUP), Total()),
-            ]
-        )
-        return sensor
-
-    def _time_sensor(self, op: str):
-        sensor = self.registry.sensor(f"{op}-time")
-        sensor.ensure_stats(
-            lambda: [
-                (MetricName.of(f"{op}-time-avg", GROUP), Avg()),
-                (MetricName.of(f"{op}-time-max", GROUP), Max()),
-            ]
-        )
-        return sensor
-
-    def _error_sensor(self, kind: str):
-        sensor = self.registry.sensor(f"{kind}-errors")
-        sensor.ensure_stats(
-            lambda: [
-                (MetricName.of(f"{kind}-errors-rate", GROUP), Rate()),
-                (MetricName.of(f"{kind}-errors-total", GROUP), Total()),
-            ]
-        )
-        return sensor
-
-    def observe(
-        self,
-        method: str,
-        path_and_query: str,
-        status: int,
-        elapsed_s: float,
-        error: Optional[BaseException],
-    ) -> None:
-        op = _classify(method, path_and_query)
-        if op is None:
-            return
-        self._sensor(op).record(1.0)
-        self._time_sensor(op).record(elapsed_s * 1000.0)
-        # Error classes mirror MetricRegistry.java: throttling (503/SlowDown),
-        # server errors (5xx), io errors (transport failures).
-        if error is not None:
-            self._error_sensor("io").record(1.0)
-        elif status == 503:
-            self._error_sensor("throttling").record(1.0)
-        elif status >= 500:
-            self._error_sensor("server").record(1.0)
+class S3MetricCollector(RequestMetricCollector):
+    def __init__(self, registry=None):
+        super().__init__(GROUP, _classify, registry)

@@ -35,14 +35,9 @@ class S3Storage(StorageBackend):
     def configure(self, configs: Mapping[str, object]) -> None:
         config = S3StorageConfig(configs)
         proxy = ProxyConfig.from_configs(configs)
-        observer = None
-        try:
-            from tieredstorage_tpu.storage.s3.metrics import S3MetricCollector
+        from tieredstorage_tpu.storage.s3.metrics import S3MetricCollector
 
-            self._metric_collector = S3MetricCollector()
-            observer = self._metric_collector.observe
-        except Exception:
-            self._metric_collector = None
+        self._metric_collector = S3MetricCollector()
         timeout = (
             config.api_call_timeout_ms / 1000.0
             if config.api_call_timeout_ms is not None
@@ -60,7 +55,7 @@ class S3Storage(StorageBackend):
             verify_tls=config.certificate_check_enabled,
             checksum_check=config.checksum_check_enabled,
             socket_factory=socks5_socket_factory(proxy),
-            observer=observer,
+            observer=self._metric_collector.observe,
         )
 
     def _require_client(self) -> S3Client:

@@ -20,6 +20,7 @@ import numpy as np
 
 import zstandard
 
+from tieredstorage_tpu import native
 from tieredstorage_tpu.ops.gcm import (
     gcm_decrypt_chunks,
     gcm_decrypt_varlen,
@@ -72,17 +73,26 @@ class TpuTransformBackend(TransformBackend):
             if opts.compression_codec != ZSTD:
                 raise ValueError(f"Codec {opts.compression_codec!r} not yet implemented")
             level = opts.compression_level
-            out = list(
-                self._zstd_pool().map(
-                    lambda c: zstandard.ZstdCompressor(
-                        level=level, write_content_size=True
-                    ).compress(c),
-                    out,
+            if self._use_native():
+                out = native.zstd_compress_batch(out, level=level)
+            else:
+                out = list(
+                    self._zstd_pool().map(
+                        lambda c: zstandard.ZstdCompressor(
+                            level=level, write_content_size=True
+                        ).compress(c),
+                        out,
+                    )
                 )
-            )
         if opts.encryption is not None:
             out = self._encrypt_batch(out, opts)
         return out
+
+    @staticmethod
+    def _use_native() -> bool:
+        """Host zstd stays on the CPU (SURVEY §7 hard part 1); prefer the C++
+        batch library over the Python thread pool when it's buildable."""
+        return native.available()
 
     def _make_ivs(self, n: int, opts: TransformOptions) -> np.ndarray:
         if opts.ivs is not None:
@@ -142,13 +152,22 @@ class TpuTransformBackend(TransformBackend):
         if opts.compression:
             if opts.compression_codec != ZSTD:
                 raise ValueError(f"Codec {opts.compression_codec!r} not yet implemented")
-            # One DCtx per chunk: zstandard (de)compressor objects are not
-            # thread-safe across the pool's workers.
-            out = list(
-                self._zstd_pool().map(
-                    lambda c: zstandard.ZstdDecompressor().decompress(c), out
+            if self._use_native():
+                bound = 1
+                for c in out:
+                    size = zstandard.frame_content_size(c)
+                    if size is None or size < 0:
+                        raise ValueError("zstd frame missing content size")
+                    bound = max(bound, size)
+                out = native.zstd_decompress_batch(out, max_decompressed=bound)
+            else:
+                # One DCtx per chunk: zstandard (de)compressor objects are not
+                # thread-safe across the pool's workers.
+                out = list(
+                    self._zstd_pool().map(
+                        lambda c: zstandard.ZstdDecompressor().decompress(c), out
+                    )
                 )
-            )
         return out
 
     def _decrypt_batch(self, chunks: list[bytes], opts: DetransformOptions) -> list[bytes]:
