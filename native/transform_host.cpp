@@ -124,6 +124,8 @@ void parallel_for(int n, int n_threads, Fn fn) {
 
 constexpr size_t kIvSize = 12;
 constexpr size_t kTagSize = 16;
+// EVP_*Update takes int lengths; larger chunks must be rejected, not wrapped.
+constexpr uint64_t kMaxAesChunk = 0x7FFFFFFF;
 
 }  // namespace
 
@@ -201,6 +203,11 @@ int ts_aes_gcm_encrypt_batch(const uint8_t *key, const uint8_t *aad, uint64_t aa
   std::atomic<int> err{0};
   parallel_for(n, n_threads, [&](int i) {
     if (err.load(std::memory_order_relaxed) != 0) return;
+    if (in_sizes[i] > kMaxAesChunk || aad_len > kMaxAesChunk) {
+      int expected = 0;
+      err.compare_exchange_strong(expected, 1 + i);
+      return;
+    }
     uint8_t *dst = out + static_cast<size_t>(i) * out_stride;
     const uint8_t *iv = ivs + static_cast<size_t>(i) * kIvSize;
     EVP_CIPHER_CTX *ctx = api.ctx_new();
@@ -244,7 +251,8 @@ int ts_aes_gcm_decrypt_batch(const uint8_t *key, const uint8_t *aad, uint64_t aa
   parallel_for(n, n_threads, [&](int i) {
     if (err.load(std::memory_order_relaxed) != 0) return;
     const uint8_t *src = in + in_offsets[i];
-    if (in_sizes[i] < kIvSize + kTagSize) {
+    if (in_sizes[i] < kIvSize + kTagSize || in_sizes[i] > kMaxAesChunk ||
+        aad_len > kMaxAesChunk) {
       int expected = 0;
       err.compare_exchange_strong(expected, 1 + i);
       return;

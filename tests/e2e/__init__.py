@@ -1,0 +1,11 @@
+"""End-to-end harness: an in-process Kafka-broker simulator.
+
+The reference's e2e tier runs a real containerized broker plus storage
+emulators (e2e/src/test/java/.../SingleBrokerTest.java — SURVEY §4). No
+container runtime exists here, so the broker side is simulated in-process:
+real Kafka v2 record batches in real rolled segment files, a
+RemoteLogManager-style tiering loop driving the actual RemoteStorageManager,
+and a __remote_log_metadata state tracker — everything below the broker
+(RSM, transform backends, caches, storage backends, emulator HTTP servers)
+is the production code under test.
+"""

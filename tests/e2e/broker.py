@@ -1,0 +1,326 @@
+"""In-process broker simulator: partitions, rolled segments, RLM tiering.
+
+Plays the roles the reference's e2e tier gets from a real broker container
+(SingleBrokerTest.java): producing records into real v2-format segment files,
+rolling segments at `segment_bytes`, tiering rolled segments through the
+actual RemoteStorageManager, tracking __remote_log_metadata-style state
+(RemoteLogMetadataTracker.java:45-239 semantics: COPY_SEGMENT_STARTED →
+FINISHED, DELETE_SEGMENT_STARTED → FINISHED), enforcing local retention so
+reads must hit remote storage, and serving consumer fetches that stitch
+local + remote data.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from pathlib import Path
+from typing import Optional
+
+from tests.e2e.records import Record, decode_batches, encode_batch
+from tieredstorage_tpu.metadata import (
+    KafkaUuid,
+    LogSegmentData,
+    RemoteLogSegmentId,
+    RemoteLogSegmentMetadata,
+    TopicIdPartition,
+    TopicPartition,
+)
+
+
+class SegmentState(enum.Enum):
+    COPY_SEGMENT_STARTED = "COPY_SEGMENT_STARTED"
+    COPY_SEGMENT_FINISHED = "COPY_SEGMENT_FINISHED"
+    DELETE_SEGMENT_STARTED = "DELETE_SEGMENT_STARTED"
+    DELETE_SEGMENT_FINISHED = "DELETE_SEGMENT_FINISHED"
+
+
+@dataclasses.dataclass
+class MetadataEvent:
+    segment_id: RemoteLogSegmentId
+    state: SegmentState
+    metadata: RemoteLogSegmentMetadata
+
+
+class RemoteLogMetadataTracker:
+    """State machine over metadata events (the consumer of
+    __remote_log_metadata in the reference's e2e harness)."""
+
+    _VALID = {
+        None: {SegmentState.COPY_SEGMENT_STARTED},
+        SegmentState.COPY_SEGMENT_STARTED: {SegmentState.COPY_SEGMENT_FINISHED},
+        SegmentState.COPY_SEGMENT_FINISHED: {SegmentState.DELETE_SEGMENT_STARTED},
+        SegmentState.DELETE_SEGMENT_STARTED: {SegmentState.DELETE_SEGMENT_FINISHED},
+        SegmentState.DELETE_SEGMENT_FINISHED: set(),
+    }
+
+    def __init__(self) -> None:
+        self.events: list[MetadataEvent] = []
+        self._states: dict[KafkaUuid, SegmentState] = {}
+        self._metadata: dict[KafkaUuid, RemoteLogSegmentMetadata] = {}
+
+    def publish(self, event: MetadataEvent) -> None:
+        uuid = event.segment_id.id
+        prev = self._states.get(uuid)
+        if event.state not in self._VALID[prev]:
+            raise AssertionError(
+                f"Invalid segment state transition {prev} -> {event.state}"
+            )
+        self._states[uuid] = event.state
+        self._metadata[uuid] = event.metadata
+        self.events.append(event)
+
+    def remote_segments(self) -> list[RemoteLogSegmentMetadata]:
+        """Segments currently live in remote storage (copy finished, not
+        deleted), ordered by start offset."""
+        live = [
+            self._metadata[u]
+            for u, s in self._states.items()
+            if s == SegmentState.COPY_SEGMENT_FINISHED
+        ]
+        return sorted(live, key=lambda m: m.start_offset)
+
+    def state_of(self, segment_id: RemoteLogSegmentId) -> Optional[SegmentState]:
+        return self._states.get(segment_id.id)
+
+
+@dataclasses.dataclass
+class LocalSegment:
+    base_offset: int
+    path: Path
+    end_offset: int = -1
+    record_count: int = 0
+
+    @property
+    def size(self) -> int:
+        return self.path.stat().st_size
+
+
+class PartitionSim:
+    def __init__(self, root: Path, tip: TopicIdPartition, segment_bytes: int):
+        self.root = root
+        self.tip = tip
+        self.segment_bytes = segment_bytes
+        self.next_offset = 0
+        self.segments: list[LocalSegment] = []
+        self.local_log_start = 0  # offsets below this exist only remotely
+        root.mkdir(parents=True, exist_ok=True)
+        self._open_segment()
+
+    def _segment_path(self, base_offset: int) -> Path:
+        return self.root / f"{base_offset:020d}.log"
+
+    def _open_segment(self) -> None:
+        seg = LocalSegment(self.next_offset, self._segment_path(self.next_offset))
+        seg.path.touch()
+        self.segments.append(seg)
+
+    @property
+    def active(self) -> LocalSegment:
+        return self.segments[-1]
+
+    def append(self, records: list[tuple[int, bytes | None, bytes]]) -> None:
+        batch = encode_batch(self.next_offset, records)
+        with open(self.active.path, "ab") as f:
+            f.write(batch)
+        self.active.end_offset = self.next_offset + len(records) - 1
+        self.active.record_count += len(records)
+        self.next_offset += len(records)
+        if self.active.size >= self.segment_bytes:
+            self._open_segment()
+
+    def rolled_segments(self) -> list[LocalSegment]:
+        return [s for s in self.segments[:-1] if s.record_count > 0]
+
+
+class BrokerSim:
+    """Single-broker simulator wired to a real RemoteStorageManager."""
+
+    def __init__(self, log_dir: Path, rsm, segment_bytes: int = 100 * 1024 + 513):
+        # Deliberately chunk-unaligned segment size, like the reference's e2e
+        # workload (SingleBrokerTest.java:114-126).
+        self.log_dir = log_dir
+        self.rsm = rsm
+        self.segment_bytes = segment_bytes
+        self.partitions: dict[tuple[str, int], PartitionSim] = {}
+        self.topic_ids: dict[str, KafkaUuid] = {}
+        self.tracker = RemoteLogMetadataTracker()
+        self.custom_metadata: dict[KafkaUuid, bytes] = {}
+        self._uuid_counter = 0
+
+    # -------------------------------------------------------------- produce
+    def create_topic(self, topic: str, partitions: int) -> None:
+        self.topic_ids[topic] = self._new_uuid()
+        for p in range(partitions):
+            tip = TopicIdPartition(self.topic_ids[topic], TopicPartition(topic, p))
+            self.partitions[(topic, p)] = PartitionSim(
+                self.log_dir / f"{topic}-{p}", tip, self.segment_bytes
+            )
+
+    def _new_uuid(self) -> KafkaUuid:
+        self._uuid_counter += 1
+        return KafkaUuid(self._uuid_counter.to_bytes(16, "big"))
+
+    def produce(
+        self, topic: str, partition: int, records: list[tuple[int, bytes | None, bytes]]
+    ) -> None:
+        self.partitions[(topic, partition)].append(records)
+
+    # --------------------------------------------------------------- tiering
+    def run_tiering(self) -> int:
+        """One RemoteLogManager pass: tier every rolled, not-yet-tiered
+        segment; then apply local retention (drop tiered local segments)."""
+        tiered = 0
+        for part in self.partitions.values():
+            for seg in part.rolled_segments():
+                metadata = self._tier_segment(part, seg)
+                if metadata is not None:
+                    tiered += 1
+            # Local retention: everything tiered is dropped locally, so
+            # subsequent reads of those offsets must go remote.
+            remote_ends = [
+                m.end_offset
+                for m in self.tracker.remote_segments()
+                if m.remote_log_segment_id.topic_id_partition == part.tip
+            ]
+            if remote_ends:
+                covered = max(remote_ends)
+                kept = []
+                for seg in part.segments:
+                    if seg is not part.active and seg.end_offset <= covered:
+                        seg.path.unlink(missing_ok=True)
+                        part.local_log_start = max(
+                            part.local_log_start, seg.end_offset + 1
+                        )
+                    else:
+                        kept.append(seg)
+                part.segments = kept
+        return tiered
+
+    def _tier_segment(self, part: PartitionSim, seg: LocalSegment):
+        segment_id = RemoteLogSegmentId(part.tip, self._new_uuid())
+        already = {
+            (m.remote_log_segment_id.topic_id_partition, m.start_offset)
+            for m in self.tracker.remote_segments()
+        }
+        if (part.tip, seg.base_offset) in already:
+            return None
+        metadata = RemoteLogSegmentMetadata(
+            remote_log_segment_id=segment_id,
+            start_offset=seg.base_offset,
+            end_offset=seg.end_offset,
+            segment_size_in_bytes=seg.size,
+        )
+        self.tracker.publish(
+            MetadataEvent(segment_id, SegmentState.COPY_SEGMENT_STARTED, metadata)
+        )
+        indexes_dir = seg.path.parent
+        offset_index = indexes_dir / f"{seg.base_offset:020d}.index"
+        time_index = indexes_dir / f"{seg.base_offset:020d}.timeindex"
+        snapshot = indexes_dir / f"{seg.base_offset:020d}.snapshot"
+        offset_index.write_bytes(b"")  # broker-internal; content opaque to RSM
+        time_index.write_bytes(b"")
+        snapshot.write_bytes(b"")
+        segment_data = LogSegmentData(
+            log_segment=seg.path,
+            offset_index=offset_index,
+            time_index=time_index,
+            producer_snapshot_index=snapshot,
+            transaction_index=None,
+            leader_epoch_index=b"0 0\n",
+        )
+        custom = self.rsm.copy_log_segment_data(metadata, segment_data)
+        if custom is not None:
+            self.custom_metadata[segment_id.id] = (
+                custom.value if hasattr(custom, "value") else bytes(custom)
+            )
+        self.tracker.publish(
+            MetadataEvent(segment_id, SegmentState.COPY_SEGMENT_FINISHED, metadata)
+        )
+        return metadata
+
+    # --------------------------------------------------------------- consume
+    def consume(
+        self, topic: str, partition: int, from_offset: int, max_records: int
+    ) -> list[Record]:
+        part = self.partitions[(topic, partition)]
+        out: list[Record] = []
+        offset = from_offset
+        while len(out) < max_records and offset < part.next_offset:
+            records = self._fetch_from(part, offset)
+            if not records:
+                break
+            for r in records:
+                if r.offset >= offset and len(out) < max_records:
+                    out.append(r)
+            offset = records[-1].offset + 1
+        return out
+
+    def _fetch_from(self, part: PartitionSim, offset: int) -> list[Record]:
+        if offset >= part.local_log_start:
+            for seg in part.segments:
+                if seg.record_count and seg.base_offset <= offset <= seg.end_offset:
+                    return decode_batches(seg.path.read_bytes())
+            return []
+        # Remote read via the RSM (the broker's RemoteLogReader path).
+        for metadata in self.tracker.remote_segments():
+            mid = metadata.remote_log_segment_id
+            if mid.topic_id_partition != part.tip:
+                continue
+            if metadata.start_offset <= offset <= metadata.end_offset:
+                with self.rsm.fetch_log_segment(metadata, 0) as stream:
+                    return decode_batches(stream.read())
+        return []
+
+    # --------------------------------------------------------------- deletes
+    def delete_records(self, topic: str, partition: int, before_offset: int) -> int:
+        """Kafka delete-records API: remote segments wholly below the new log
+        start offset are deleted."""
+        part = self.partitions[(topic, partition)]
+        deleted = 0
+        for metadata in self.tracker.remote_segments():
+            mid = metadata.remote_log_segment_id
+            if mid.topic_id_partition != part.tip:
+                continue
+            if metadata.end_offset < before_offset:
+                self._delete_remote(metadata)
+                deleted += 1
+        part.local_log_start = max(part.local_log_start, before_offset)
+        return deleted
+
+    def retention_cleanup(self, max_remote_segments_per_partition: int) -> int:
+        """Size-style retention: keep only the newest N remote segments."""
+        deleted = 0
+        for part in self.partitions.values():
+            mine = [
+                m
+                for m in self.tracker.remote_segments()
+                if m.remote_log_segment_id.topic_id_partition == part.tip
+            ]
+            for metadata in mine[: max(0, len(mine) - max_remote_segments_per_partition)]:
+                self._delete_remote(metadata)
+                deleted += 1
+        return deleted
+
+    def delete_topic(self, topic: str) -> int:
+        deleted = 0
+        for (t, _p), part in self.partitions.items():
+            if t != topic:
+                continue
+            for metadata in self.tracker.remote_segments():
+                if metadata.remote_log_segment_id.topic_id_partition == part.tip:
+                    self._delete_remote(metadata)
+                    deleted += 1
+        for key in [k for k in self.partitions if k[0] == topic]:
+            del self.partitions[key]
+        return deleted
+
+    def _delete_remote(self, metadata: RemoteLogSegmentMetadata) -> None:
+        segment_id = metadata.remote_log_segment_id
+        self.tracker.publish(
+            MetadataEvent(segment_id, SegmentState.DELETE_SEGMENT_STARTED, metadata)
+        )
+        self.rsm.delete_log_segment_data(metadata)
+        self.tracker.publish(
+            MetadataEvent(segment_id, SegmentState.DELETE_SEGMENT_FINISHED, metadata)
+        )

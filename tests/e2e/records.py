@@ -1,0 +1,130 @@
+"""Minimal Kafka v2 record-batch codec for the broker simulator.
+
+Byte-compatible with the v2 on-disk format the reference's e2e workload
+produces (magic=2 batches; the compression-heuristic module
+tieredstorage_tpu/kafka_records.py reads the same headers): batch header of
+baseOffset(8) batchLength(4) partitionLeaderEpoch(4) magic(1) crc(4)
+attributes(2) lastOffsetDelta(4) baseTimestamp(8) maxTimestamp(8)
+producerId(8) producerEpoch(2) baseSequence(4) recordCount(4), followed by
+records encoded with zigzag varints.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+import zlib
+
+from tieredstorage_tpu.utils.varint import (
+    read_unsigned_varint,
+    read_varlong,
+    write_unsigned_varint,
+    write_varlong,
+)
+
+_HEADER = struct.Struct(">qiibIhiqqqhii")
+HEADER_SIZE = _HEADER.size  # 61
+
+
+@dataclasses.dataclass(frozen=True)
+class Record:
+    offset: int
+    timestamp: int
+    key: bytes | None
+    value: bytes
+
+
+def encode_batch(base_offset: int, records: list[tuple[int, bytes | None, bytes]]) -> bytes:
+    """records: (timestamp, key, value) triples; offsets are sequential."""
+    if not records:
+        raise ValueError("empty batch")
+    base_ts = records[0][0]
+    max_ts = max(ts for ts, _, _ in records)
+    body = bytearray()
+    for delta, (ts, key, value) in enumerate(records):
+        rec = bytearray()
+        rec.append(0)  # attributes
+        write_varlong(ts - base_ts, rec)
+        write_varlong(delta, rec)
+        if key is None:
+            write_varlong(-1, rec)
+        else:
+            write_varlong(len(key), rec)
+            rec += key
+        write_varlong(len(value), rec)
+        rec += value
+        write_unsigned_varint(0, rec)  # headers count
+        write_varlong(len(rec), body)
+        body += rec
+
+    # CRC (Kafka uses CRC32C over attributes..end; zlib.crc32 suffices for the
+    # simulator — the plugin under test never validates batch CRCs).
+    attrs_on = struct.pack(
+        ">hiqqqhii",
+        0,                       # attributes: no compression
+        len(records) - 1,        # lastOffsetDelta
+        base_ts,
+        max_ts,
+        -1, -1, -1,              # producerId/epoch/baseSequence
+        len(records),
+    )
+    crc = zlib.crc32(attrs_on + bytes(body)) & 0xFFFFFFFF
+    batch_length = 4 + 1 + 4 + len(attrs_on) + len(body)  # epoch..end
+    return (
+        struct.pack(">qi", base_offset, batch_length)
+        + struct.pack(">ibI", 0, 2, crc)
+        + attrs_on
+        + bytes(body)
+    )
+
+
+def decode_batches(data: bytes) -> list[Record]:
+    """Decode all complete record batches in `data` (trailing partial batch
+    bytes are ignored — ranged fetches may cut mid-batch)."""
+    out: list[Record] = []
+    pos = 0
+    while pos + 12 <= len(data):
+        base_offset, batch_length = struct.unpack_from(">qi", data, pos)
+        end = pos + 12 + batch_length
+        if end > len(data):
+            break
+        fields = _HEADER.unpack_from(data, pos)
+        magic = fields[3]
+        if magic != 2:
+            raise ValueError(f"Unsupported batch magic {magic}")
+        base_ts = fields[7]
+        count = fields[12]
+        rpos = pos + HEADER_SIZE
+        for _ in range(count):
+            rec_len, rpos = read_varlong(data, rpos)
+            rend = rpos + rec_len
+            rpos += 1  # attributes
+            ts_delta, rpos = read_varlong(data, rpos)
+            off_delta, rpos = read_varlong(data, rpos)
+            key_len, rpos = read_varlong(data, rpos)
+            if key_len >= 0:
+                key = data[rpos : rpos + key_len]
+                rpos += key_len
+            else:
+                key = None
+            val_len, rpos = read_varlong(data, rpos)
+            value = data[rpos : rpos + val_len]
+            rpos += val_len
+            n_headers, rpos = read_unsigned_varint(data, rpos)
+            for _ in range(n_headers):
+                klen, rpos = read_unsigned_varint(data, rpos)
+                rpos += klen
+                vlen, rpos = read_unsigned_varint(data, rpos)
+                rpos += vlen
+            if rpos != rend:
+                raise ValueError("record length mismatch")
+            out.append(
+                Record(
+                    offset=base_offset + off_delta,
+                    timestamp=base_ts + ts_delta,
+                    key=key,
+                    value=value,
+                )
+            )
+        pos = end
+    return out

@@ -85,10 +85,12 @@ def load() -> Optional[ctypes.CDLL]:
         if _load_error is not None:
             return None
         try:
-            if not _SO_PATH.exists() or (
-                _SO_PATH.stat().st_mtime
-                < (_NATIVE_DIR / "transform_host.cpp").stat().st_mtime
-            ):
+            source = _NATIVE_DIR / "transform_host.cpp"
+            if not _SO_PATH.exists():
+                _build()
+            elif source.exists() and _SO_PATH.stat().st_mtime < source.stat().st_mtime:
+                # Source newer than the .so → rebuild; a prebuilt .so with no
+                # source alongside (installed tree) is used as-is.
                 _build()
             _lib = _bind(ctypes.CDLL(str(_SO_PATH)))
             return _lib
@@ -150,13 +152,24 @@ def zstd_compress_batch(chunks: list[bytes], level: int = 3, n_threads: int = 0)
 
 
 def zstd_decompress_batch(
-    chunks: list[bytes], max_decompressed: int, n_threads: int = 0
+    chunks: list[bytes], max_decompressed: Optional[int] = None, n_threads: int = 0
 ) -> list[bytes]:
     lib = load()
     if lib is None:
         raise NativeTransformError(f"native library unavailable: {_load_error}")
     if not chunks:
         return []
+    if max_decompressed is None:
+        # Frames carry their content size (pledged at compression); size the
+        # output stride from the largest frame.
+        import zstandard
+
+        max_decompressed = 1
+        for c in chunks:
+            size = zstandard.frame_content_size(c)
+            if size is None or size < 0:
+                raise NativeTransformError("zstd frame missing content size")
+            max_decompressed = max(max_decompressed, size)
     buf, offsets, sizes = _pack(chunks)
     stride = max_decompressed
     out = np.empty(len(chunks) * stride, dtype=np.uint8)

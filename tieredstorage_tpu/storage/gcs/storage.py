@@ -29,12 +29,14 @@ from tieredstorage_tpu.storage.proxy import ProxyConfig, socks5_socket_factory
 _COPY_BUFFER = 1024 * 1024
 
 
-def _committed_bytes(range_header: str, default: int) -> int:
-    """Bytes the server has persisted, from a 308's 'Range: bytes=0-N'."""
+def _committed_bytes(range_header: str) -> int:
+    """Bytes the server has persisted, from a 308's 'Range: bytes=0-N'.
+    Per the resumable protocol, a 308 with no Range header means the server
+    has persisted nothing."""
     import re
 
     m = re.fullmatch(r"bytes=0-(\d+)", range_header.strip()) if range_header else None
-    return int(m.group(1)) + 1 if m else default
+    return int(m.group(1)) + 1 if m else 0
 
 
 class GcsStorage(StorageBackend):
@@ -135,8 +137,10 @@ class GcsStorage(StorageBackend):
                 )
             return 0
         upcoming = next(chunks, None)
+        stalls = 0
         while current is not None:
-            total = "*" if upcoming is not None else str(offset + len(current))
+            final = upcoming is None
+            total = str(offset + len(current)) if final else "*"
             content_range = f"bytes {offset}-{offset + len(current) - 1}/{total}"
             resp = http.request(
                 "PUT",
@@ -144,31 +148,37 @@ class GcsStorage(StorageBackend):
                 headers=self._headers({"Content-Range": content_range}),
                 body=current,
             )
-            if upcoming is not None:
-                if resp.status != 308:
-                    raise StorageBackendException(
-                        f"Resumable chunk for {key} not accepted: HTTP {resp.status}"
-                    )
-                # A 308 may report fewer bytes committed than sent
-                # (Range: bytes=0-N); resume from the server's offset.
-                committed = _committed_bytes(resp.header("range"), offset + len(current))
-                if committed < offset + len(current):
-                    if committed <= offset:
+            if final and resp.status in (200, 201):
+                return offset + len(current)
+            if resp.status != 308:
+                raise StorageBackendException(
+                    f"Resumable {'finalize' if final else 'chunk'} for {key} "
+                    f"not accepted: HTTP {resp.status}"
+                )
+            # A 308 (on any chunk, final included) may report fewer bytes
+            # committed than sent; resume from the server's offset.
+            committed = _committed_bytes(resp.header("range"))
+            if committed < offset + len(current):
+                if committed <= offset:
+                    stalls += 1
+                    if stalls > 2:
                         raise StorageBackendException(
                             f"Resumable upload for {key} made no progress "
                             f"(committed={committed}, offset={offset})"
                         )
+                else:
+                    stalls = 0
                     current = current[committed - offset :]
                     offset = committed
-                    continue
-            elif resp.status not in (200, 201):
+                continue
+            if final:
                 raise StorageBackendException(
-                    f"Failed to finalize upload for {key}: HTTP {resp.status}"
+                    f"Upload for {key} fully committed but not finalized "
+                    f"(HTTP 308 at committed={committed})"
                 )
+            stalls = 0
             offset += len(current)
-            current, upcoming = upcoming, (
-                next(chunks, None) if upcoming is not None else None
-            )
+            current, upcoming = upcoming, next(chunks, None)
         return offset
 
     # ---------------------------------------------------------------- fetch

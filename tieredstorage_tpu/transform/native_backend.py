@@ -15,7 +15,6 @@ import os
 from typing import Sequence
 
 import numpy as np
-import zstandard
 
 from tieredstorage_tpu import native
 from tieredstorage_tpu.security.aes import IV_SIZE
@@ -87,13 +86,5 @@ class NativeTransformBackend(TransformBackend):
                 raise AuthenticationError(str(e)) from None
         if opts.compression:
             self._check_codec(opts.compression_codec)
-            bound = 0
-            for c in out:
-                size = zstandard.frame_content_size(c)
-                if size is None or size < 0:
-                    raise ValueError("zstd frame missing content size")
-                bound = max(bound, size)
-            out = native.zstd_decompress_batch(
-                out, max_decompressed=max(bound, 1), n_threads=self.n_threads
-            )
+            out = native.zstd_decompress_batch(out, n_threads=self.n_threads)
         return out
