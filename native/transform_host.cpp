@@ -205,7 +205,7 @@ int ts_aes_gcm_encrypt_batch(const uint8_t *key, const uint8_t *aad, uint64_t aa
     if (err.load(std::memory_order_relaxed) != 0) return;
     if (in_sizes[i] > kMaxAesChunk || aad_len > kMaxAesChunk) {
       int expected = 0;
-      err.compare_exchange_strong(expected, 1 + i);
+      err.compare_exchange_strong(expected, -(2 + i));
       return;
     }
     uint8_t *dst = out + static_cast<size_t>(i) * out_stride;
@@ -251,8 +251,13 @@ int ts_aes_gcm_decrypt_batch(const uint8_t *key, const uint8_t *aad, uint64_t aa
   parallel_for(n, n_threads, [&](int i) {
     if (err.load(std::memory_order_relaxed) != 0) return;
     const uint8_t *src = in + in_offsets[i];
-    if (in_sizes[i] < kIvSize + kTagSize || in_sizes[i] > kMaxAesChunk ||
-        aad_len > kMaxAesChunk) {
+    if (in_sizes[i] > kMaxAesChunk || aad_len > kMaxAesChunk) {
+      // Size-limit rejection, NOT an auth failure: distinct code -(2+i).
+      int expected = 0;
+      err.compare_exchange_strong(expected, -(2 + i));
+      return;
+    }
+    if (in_sizes[i] < kIvSize + kTagSize) {
       int expected = 0;
       err.compare_exchange_strong(expected, 1 + i);
       return;

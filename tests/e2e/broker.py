@@ -240,12 +240,26 @@ class BrokerSim:
         return metadata
 
     # --------------------------------------------------------------- consume
+    def log_start_offset(self, topic: str, partition: int) -> int:
+        """Earliest readable offset (remote log start, else local log start) —
+        consumers fetching below it are snapped forward, like Kafka's
+        OFFSET_OUT_OF_RANGE → earliest reset."""
+        part = self.partitions[(topic, partition)]
+        remote_starts = [
+            m.start_offset
+            for m in self.tracker.remote_segments()
+            if m.remote_log_segment_id.topic_id_partition == part.tip
+        ]
+        if remote_starts:
+            return min(min(remote_starts), part.local_log_start)
+        return part.local_log_start
+
     def consume(
         self, topic: str, partition: int, from_offset: int, max_records: int
     ) -> list[Record]:
         part = self.partitions[(topic, partition)]
         out: list[Record] = []
-        offset = from_offset
+        offset = max(from_offset, self.log_start_offset(topic, partition))
         while len(out) < max_records and offset < part.next_offset:
             records = self._fetch_from(part, offset)
             if not records:

@@ -1,0 +1,9 @@
+"""Documentation generators.
+
+Reference: docs/src/main/java/.../misc/{ConfigsDocs,MetricsDocs}.java — the
+reference prints RST from the live ConfigDefs and metric registries so docs
+can never drift from the code (SURVEY §2.10). Same approach here:
+
+    python -m tieredstorage_tpu.docs.configs_docs > docs/configs.rst
+    python -m tieredstorage_tpu.docs.metrics_docs > docs/metrics.rst
+"""

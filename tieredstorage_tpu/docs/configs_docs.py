@@ -1,0 +1,98 @@
+"""Generate configs.rst from the live ConfigDefs.
+
+Reference: docs/.../ConfigsDocs.java (gradle task genConfigsDocs,
+Makefile:47-50) — section per config class, keys rendered Kafka-toRst-style:
+name, doc, type/default/valid-values/importance bullets, sorted by importance
+then name.
+"""
+
+from __future__ import annotations
+
+from tieredstorage_tpu.config.configdef import NO_DEFAULT, ConfigDef, ConfigKey
+
+_IMPORTANCE_ORDER = {"high": 0, "medium": 1, "low": 2}
+
+
+def _default_repr(key: ConfigKey) -> str:
+    if key.default is NO_DEFAULT:
+        return ""
+    if key.default is None:
+        return "null"
+    if isinstance(key.default, bool):
+        return "true" if key.default else "false"
+    if isinstance(key.default, list):
+        return ",".join(map(str, key.default)) if key.default else '""'
+    return str(key.default)
+
+
+def render_config_def(definition: ConfigDef, *, prefix: str = "") -> str:
+    lines: list[str] = []
+    keys = sorted(
+        definition.keys.values(),
+        key=lambda k: (_IMPORTANCE_ORDER.get(k.importance, 3), k.name),
+    )
+    for key in keys:
+        lines.append(f"``{prefix}{key.name}``")
+        doc = key.doc or ""
+        for doc_line in doc.split("\n"):
+            lines.append(f"  {doc_line}".rstrip())
+        lines.append("")
+        lines.append(f"  * Type: {key.type}")
+        if key.required:
+            lines.append("  * Valid Values: required")
+        else:
+            lines.append(f"  * Default: {_default_repr(key)}")
+        lines.append(f"  * Importance: {key.importance}")
+        lines.append("")
+    return "\n".join(lines)
+
+
+def _section(title: str, underline: str = "-") -> list[str]:
+    return [title, underline * len(title), ""]
+
+
+def generate() -> str:
+    # Imports inside the generator keep module import light.
+    from tieredstorage_tpu.config import cache_config, rsm_config
+    from tieredstorage_tpu.storage.azure.config import AzureBlobStorageConfig
+    from tieredstorage_tpu.storage.gcs.config import GcsStorageConfig
+    from tieredstorage_tpu.storage.proxy import ProxyConfig
+    from tieredstorage_tpu.storage.s3.config import S3StorageConfig
+
+    out: list[str] = []
+    out += _section("Tiered Storage TPU configs", "=")
+    out += _section("RemoteStorageManagerConfig")
+    out.append(render_config_def(rsm_config._base_def()))
+    out += _section("ChunkCacheConfig (prefix: fetch.chunk.cache.)")
+    out.append(
+        render_config_def(cache_config._cache_def())
+        + render_config_def(cache_config._chunk_cache_extra())
+    )
+    out += _section("DiskChunkCacheConfig (additional keys)")
+    out.append(render_config_def(cache_config._disk_cache_extra()))
+    out += _section("SegmentManifestCacheConfig (prefix: fetch.manifest.cache.)")
+    out.append(
+        render_config_def(cache_config._cache_def(size_default=1000,
+                                                  retention_ms_default=3_600_000))
+    )
+    out += _section("SegmentIndexesCacheConfig (prefix: fetch.indexes.cache.)")
+    out.append(
+        render_config_def(cache_config._cache_def(size_default=10 * 1024 * 1024))
+    )
+    out += _section("S3StorageConfig (prefix: storage.)")
+    out.append(render_config_def(S3StorageConfig.DEFINITION))
+    out += _section("GcsStorageConfig (prefix: storage.)")
+    out.append(render_config_def(GcsStorageConfig.DEFINITION))
+    out += _section("AzureBlobStorageConfig (prefix: storage.)")
+    out.append(render_config_def(AzureBlobStorageConfig.DEFINITION))
+    out += _section("ProxyConfig (prefix: storage.proxy.)")
+    out.append(render_config_def(ProxyConfig.DEFINITION))
+    return "\n".join(out).rstrip() + "\n"
+
+
+def main() -> None:
+    print(generate(), end="")
+
+
+if __name__ == "__main__":
+    main()

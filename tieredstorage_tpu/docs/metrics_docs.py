@@ -1,0 +1,127 @@
+"""Generate metrics.rst from the live metric registries.
+
+Reference: docs/.../MetricsDocs.java (gradle task genMetricsDocs) prints the
+metric templates straight from the registries. Sensors here are created
+lazily, so the generator exercises every recording path of each subsystem
+against throwaway registries and lists the metric names that materialize —
+the document can't drift from what the code actually emits.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+
+def _collect_rsm() -> dict[str, list[str]]:
+    from tieredstorage_tpu.metrics.rsm_metrics import Metrics
+
+    m = Metrics()
+    m.record_segment_copy_time("topic", 0, 1.0)
+    m.record_segment_delete("topic", 0, 1)
+    m.record_segment_delete_time("topic", 0, 1.0)
+    m.record_segment_delete_error("topic", 0)
+    m.record_segment_fetch_requested_bytes("topic", 0, 1)
+    m.record_object_upload("topic", 0, "log", 1)
+    return _group_names(m.registry)
+
+
+def _collect_caches() -> dict[str, list[str]]:
+    from tieredstorage_tpu.metrics.cache_metrics import (
+        DiskCacheMetrics,
+        register_cache_metrics,
+        register_thread_pool_metrics,
+    )
+    from tieredstorage_tpu.metrics.core import MetricsRegistry
+    from tieredstorage_tpu.utils.caching import CacheStats
+
+    registry = MetricsRegistry()
+    register_cache_metrics(registry, "chunk-cache", CacheStats(), lambda: 0)
+    disk = DiskCacheMetrics(registry)
+    disk.record_write(1)
+    disk.record_delete(1)
+
+    from concurrent.futures import ThreadPoolExecutor
+
+    pool = ThreadPoolExecutor(max_workers=1)
+    register_thread_pool_metrics(registry, "chunk-cache-pool", pool)
+    pool.shutdown(wait=False)
+    return _group_names(registry)
+
+
+def _collect_backends() -> dict[str, list[str]]:
+    from tieredstorage_tpu.storage.azure.metrics import AzureMetricCollector
+    from tieredstorage_tpu.storage.gcs.metrics import GcsMetricCollector
+    from tieredstorage_tpu.storage.s3.metrics import S3MetricCollector
+
+    out: dict[str, list[str]] = {}
+    requests = {
+        S3MetricCollector: [
+            ("GET", "/b/k"),
+            ("PUT", "/b/k"),
+            ("PUT", "/b/k?partNumber=1&uploadId=u"),
+            ("DELETE", "/b/k"),
+            ("DELETE", "/b/k?uploadId=u"),
+            ("POST", "/b?delete="),
+            ("POST", "/b/k?uploads="),
+            ("POST", "/b/k?uploadId=u"),
+        ],
+        GcsMetricCollector: [
+            ("POST", "/upload/storage/v1/b/b/o?uploadType=resumable"),
+            ("GET", "/storage/v1/b/b/o/k?alt=media"),
+            ("GET", "/storage/v1/b/b/o/k"),
+            ("DELETE", "/storage/v1/b/b/o/k"),
+        ],
+        AzureMetricCollector: [
+            ("GET", "/c/k"),
+            ("PUT", "/c/k"),
+            ("PUT", "/c/k?comp=block&blockid=x"),
+            ("PUT", "/c/k?comp=blocklist"),
+            ("DELETE", "/c/k"),
+        ],
+    }
+    for cls, calls in requests.items():
+        collector = cls()
+        for method, path in calls:
+            collector.observe(method, path, 200, 0.001, None)
+        # Error classes (throttling / server / io).
+        collector.observe(*calls[0][:2], 503, 0.001, None)
+        collector.observe(*calls[0][:2], 500, 0.001, None)
+        collector.observe(*calls[0][:2], 0, 0.001, OSError("io"))
+        out.update(_group_names(collector.registry))
+    return out
+
+
+def _group_names(registry) -> dict[str, list[str]]:
+    groups: dict[str, set[str]] = defaultdict(set)
+    for metric_name in registry.metric_names:
+        groups[metric_name.group].add(metric_name.name)
+    return {g: sorted(names) for g, names in groups.items()}
+
+
+def generate() -> str:
+    out: list[str] = []
+
+    def section(title: str, underline: str = "-") -> None:
+        out.extend([title, underline * len(title), ""])
+
+    section("Tiered Storage TPU metrics", "=")
+    for heading, collected in [
+        ("RemoteStorageManager metrics", _collect_rsm()),
+        ("Cache and thread-pool metrics", _collect_caches()),
+        ("Storage backend client metrics", _collect_backends()),
+    ]:
+        section(heading)
+        for group in sorted(collected):
+            section(f"Group ``{group}``", "~")
+            for name in collected[group]:
+                out.append(f"* ``{name}``")
+            out.append("")
+    return "\n".join(out).rstrip() + "\n"
+
+
+def main() -> None:
+    print(generate(), end="")
+
+
+if __name__ == "__main__":
+    main()

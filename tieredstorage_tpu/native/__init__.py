@@ -210,6 +210,8 @@ def aes_gcm_encrypt_batch(
     )
     if rc == -1:
         raise NativeTransformError("native AES unavailable")
+    if rc < -1:
+        raise NativeTransformError(f"chunk {-rc - 2} exceeds the 2 GiB AES limit")
     if rc != 0:
         raise NativeTransformError(f"AES-GCM encrypt failed on chunk {rc - 1}")
     return [
@@ -239,6 +241,8 @@ def aes_gcm_decrypt_batch(
     )
     if rc == -1:
         raise NativeTransformError("native AES unavailable")
+    if rc < -1:
+        raise NativeTransformError(f"chunk {-rc - 2} exceeds the 2 GiB AES limit")
     if rc != 0:
         raise NativeAuthenticationError(f"GCM tag mismatch on chunks [{rc - 1}]")
     return [

@@ -179,7 +179,7 @@ class GcsStorage(StorageBackend):
             stalls = 0
             offset += len(current)
             current, upcoming = upcoming, next(chunks, None)
-        return offset
+        raise AssertionError("unreachable: final chunk returns inside the loop")
 
     # ---------------------------------------------------------------- fetch
     def fetch(self, key: ObjectKey, byte_range: Optional[BytesRange] = None) -> BinaryIO:
