@@ -193,6 +193,9 @@ int ts_zstd_decompress_batch(const uint8_t *in, const uint64_t *in_offsets,
 // AES-256-GCM encrypt n chunks: out[i] = IV || ciphertext || tag at
 // out + i*out_stride (out_stride >= in_sizes[i] + 28). IVs are caller-supplied
 // (n * 12 bytes) so the Python layer controls IV uniqueness policy.
+// Returns 0 on success, 1+i for a cipher failure on chunk i, -(2+i) when
+// chunk i (or the AAD) exceeds the int length limit, -1 if libcrypto is
+// unavailable.
 int ts_aes_gcm_encrypt_batch(const uint8_t *key, const uint8_t *aad, uint64_t aad_len,
                              const uint8_t *ivs, const uint8_t *in,
                              const uint64_t *in_offsets, const uint64_t *in_sizes,
@@ -239,8 +242,9 @@ int ts_aes_gcm_encrypt_batch(const uint8_t *key, const uint8_t *aad, uint64_t aa
 }
 
 // AES-256-GCM decrypt n chunks of IV || ciphertext || tag. Returns 0 on
-// success, 1+index of the first failing chunk (bad tag included), -1 when
-// libcrypto is unavailable.
+// success, 1+index of the first failing chunk (bad tag included), -(2+i)
+// when chunk i (or the AAD) exceeds the int length limit, -1 when libcrypto
+// is unavailable.
 int ts_aes_gcm_decrypt_batch(const uint8_t *key, const uint8_t *aad, uint64_t aad_len,
                              const uint8_t *in, const uint64_t *in_offsets,
                              const uint64_t *in_sizes, int n, uint8_t *out,

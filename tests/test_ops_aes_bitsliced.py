@@ -1,0 +1,68 @@
+"""Bitsliced AES circuit: derived tower-field S-box and CTR keystream.
+
+The circuit constants are machine-derived from the field definitions
+(aes_bitsliced._tower); these tests pin them against the independently
+generated S-box table and the cryptography library's AES-256-CTR.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+from tieredstorage_tpu.ops.aes import SBOX, key_expansion
+from tieredstorage_tpu.ops.aes_bitsliced import (
+    _sbox_planes,
+    _tower,
+    ctr_keystream_batch,
+    ctr_keystream_bitsliced,
+    make_rk_planes,
+)
+
+KEY = bytes(range(32))
+
+
+def test_sbox_circuit_matches_table_for_all_inputs():
+    tw = _tower()
+    xs = np.arange(256, dtype=np.uint8)
+    planes = []
+    for b in range(8):
+        bits = ((xs >> b) & 1).astype(np.uint32).reshape(8, 32)
+        words = (bits << np.arange(32, dtype=np.uint32)[None, :]).sum(
+            axis=1, dtype=np.uint32
+        )
+        planes.append(jnp.asarray(words))
+    out = np.stack([np.asarray(o) for o in _sbox_planes(tw, planes)])
+    res = np.zeros(256, dtype=np.uint8)
+    for b in range(8):
+        bits = (out[b][:, None] >> np.arange(32, dtype=np.uint32)[None, :]) & 1
+        res |= (bits.astype(np.uint8) << b).reshape(256)
+    assert np.array_equal(res, SBOX)
+
+
+@pytest.mark.parametrize("n_blocks", [1, 31, 32, 33, 100])
+def test_keystream_matches_cryptography_ctr(n_blocks):
+    iv = bytes(range(12))
+    rkp = jnp.asarray(make_rk_planes(KEY))
+    ks = np.asarray(
+        ctr_keystream_bitsliced(rkp, jnp.asarray(np.frombuffer(iv, np.uint8)), 2, n_blocks)
+    )
+    enc = Cipher(
+        algorithms.AES(KEY), modes.CTR(iv + (2).to_bytes(4, "big"))
+    ).encryptor()
+    assert enc.update(bytes(16 * n_blocks)) == ks.tobytes()
+
+
+def test_batch_keystream_matches_per_chunk():
+    rng = np.random.default_rng(3)
+    ivs = rng.integers(0, 256, (5, 12), np.uint8)
+    rkp = jnp.asarray(make_rk_planes(KEY))
+    rks = jnp.asarray(key_expansion(KEY))
+    batch = np.asarray(ctr_keystream_batch(rks, jnp.asarray(ivs), 1, 40))
+    for i in range(5):
+        single = np.asarray(
+            ctr_keystream_bitsliced(rkp, jnp.asarray(ivs[i]), 1, 40)
+        )
+        assert np.array_equal(batch[i], single)

@@ -63,21 +63,33 @@ def generate() -> str:
     out += _section("Tiered Storage TPU configs", "=")
     out += _section("RemoteStorageManagerConfig")
     out.append(render_config_def(rsm_config._base_def()))
+    from tieredstorage_tpu.fetch.index_cache import MemorySegmentIndexesCache
+    from tieredstorage_tpu.fetch.manifest_cache import MemorySegmentManifestCache
+
     out += _section("ChunkCacheConfig (prefix: fetch.chunk.cache.)")
     out.append(
         render_config_def(cache_config._cache_def())
+        + "\n"
         + render_config_def(cache_config._chunk_cache_extra())
     )
     out += _section("DiskChunkCacheConfig (additional keys)")
     out.append(render_config_def(cache_config._disk_cache_extra()))
     out += _section("SegmentManifestCacheConfig (prefix: fetch.manifest.cache.)")
     out.append(
-        render_config_def(cache_config._cache_def(size_default=1000,
-                                                  retention_ms_default=3_600_000))
+        render_config_def(
+            cache_config._cache_def(
+                size_default=MemorySegmentManifestCache.DEFAULT_MAX_SIZE,
+                retention_ms_default=MemorySegmentManifestCache.DEFAULT_RETENTION_MS,
+            )
+        )
     )
     out += _section("SegmentIndexesCacheConfig (prefix: fetch.indexes.cache.)")
     out.append(
-        render_config_def(cache_config._cache_def(size_default=10 * 1024 * 1024))
+        render_config_def(
+            cache_config._cache_def(
+                size_default=MemorySegmentIndexesCache.DEFAULT_MAX_SIZE_BYTES
+            )
+        )
     )
     out += _section("S3StorageConfig (prefix: storage.)")
     out.append(render_config_def(S3StorageConfig.DEFINITION))

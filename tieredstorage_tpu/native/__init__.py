@@ -186,12 +186,21 @@ def zstd_decompress_batch(
     ]
 
 
+_AES_MAX = 0x7FFFFFFF  # EVP int length limit (2 GiB - 1)
+
+
+def _check_aad(aad: bytes) -> None:
+    if len(aad) > _AES_MAX:
+        raise NativeTransformError("AAD exceeds the AES length limit")
+
+
 def aes_gcm_encrypt_batch(
     key: bytes, aad: bytes, ivs: np.ndarray, chunks: list[bytes], n_threads: int = 0
 ) -> list[bytes]:
     lib = load()
     if lib is None or lib.ts_crypto_available() != 1:
         raise NativeTransformError("native AES unavailable")
+    _check_aad(aad)
     if not chunks:
         return []
     buf, offsets, sizes = _pack(chunks)
@@ -211,7 +220,7 @@ def aes_gcm_encrypt_batch(
     if rc == -1:
         raise NativeTransformError("native AES unavailable")
     if rc < -1:
-        raise NativeTransformError(f"chunk {-rc - 2} exceeds the 2 GiB AES limit")
+        raise NativeTransformError(f"chunk {-rc - 2} exceeds the AES length limit")
     if rc != 0:
         raise NativeTransformError(f"AES-GCM encrypt failed on chunk {rc - 1}")
     return [
@@ -226,6 +235,7 @@ def aes_gcm_decrypt_batch(
     lib = load()
     if lib is None or lib.ts_crypto_available() != 1:
         raise NativeTransformError("native AES unavailable")
+    _check_aad(aad)
     if not chunks:
         return []
     buf, offsets, sizes = _pack(chunks)
@@ -242,7 +252,7 @@ def aes_gcm_decrypt_batch(
     if rc == -1:
         raise NativeTransformError("native AES unavailable")
     if rc < -1:
-        raise NativeTransformError(f"chunk {-rc - 2} exceeds the 2 GiB AES limit")
+        raise NativeTransformError(f"chunk {-rc - 2} exceeds the AES length limit")
     if rc != 0:
         raise NativeAuthenticationError(f"GCM tag mismatch on chunks [{rc - 1}]")
     return [

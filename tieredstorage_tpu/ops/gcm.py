@@ -26,7 +26,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from tieredstorage_tpu.ops import gf128
-from tieredstorage_tpu.ops.aes import aes_encrypt_blocks, ctr_keystream, key_expansion
+from tieredstorage_tpu.ops.aes import aes_encrypt_blocks, key_expansion
+from tieredstorage_tpu.ops.aes_bitsliced import ctr_keystream_batch
 
 TAG_SIZE = 16
 
@@ -187,9 +188,7 @@ def _gcm_process_batch(
     batch = data.shape[0]
     padded_len = n_blocks * 16
 
-    ks = jax.vmap(
-        lambda iv: ctr_keystream(round_keys, iv, 1, n_blocks + 1)
-    )(ivs)  # [B, n_blocks+1, 16]
+    ks = ctr_keystream_batch(round_keys, ivs, 1, n_blocks + 1)  # [B, n_blocks+1, 16]
     tag_mask = ks[:, 0, :]
     keystream = ks[:, 1:, :].reshape(batch, padded_len)[:, :chunk_bytes]
 
@@ -292,7 +291,7 @@ def _gcm_varlen_batch(
     Returns (output uint8[B, max_bytes], tags uint8[B, 16])."""
     batch = data.shape[0]
 
-    ks = jax.vmap(lambda iv: ctr_keystream(round_keys, iv, 1, m_max + 1))(ivs)
+    ks = ctr_keystream_batch(round_keys, ivs, 1, m_max + 1)
     tag_mask = ks[:, 0, :]
     keystream = ks[:, 1:, :].reshape(batch, m_max * 16)[:, :max_bytes]
 
