@@ -1,0 +1,108 @@
+"""Operation tracing: spans around RSM operations and kernel launches.
+
+The reference has no tracing (SURVEY §5 — only SLF4J boundary logs,
+RemoteStorageManager.java:218,549,598); this build adds a real span system:
+lightweight nested spans with wall-time accounting, optional forwarding into
+jax.profiler traces (so spans show up in XProf/TensorBoard timelines next to
+the device kernels they launched), and an in-memory recorder for tests and
+the demo.
+
+Usage:
+    tracer = Tracer(enabled=True)
+    with tracer.span("copy_log_segment_data", topic="t", partition=3):
+        with tracer.span("transform"):
+            ...
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+import time
+from typing import Iterator, Optional
+
+
+@dataclasses.dataclass
+class Span:
+    name: str
+    start_s: float
+    end_s: float = 0.0
+    depth: int = 0
+    attributes: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def duration_s(self) -> float:
+        return max(0.0, self.end_s - self.start_s)
+
+
+class Tracer:
+    """Nested span recorder; thread-safe, cheap when disabled."""
+
+    def __init__(self, enabled: bool = False, *, use_jax_profiler: bool = False,
+                 max_spans: int = 10_000):
+        self.enabled = enabled
+        self.use_jax_profiler = use_jax_profiler
+        self.max_spans = max_spans
+        self._spans: list[Span] = []
+        self._lock = threading.Lock()
+        self._local = threading.local()
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attributes) -> Iterator[Optional[Span]]:
+        if not self.enabled:
+            yield None
+            return
+        depth = getattr(self._local, "depth", 0)
+        self._local.depth = depth + 1
+        s = Span(name=name, start_s=time.perf_counter(), depth=depth,
+                 attributes=attributes)
+        ctx = None
+        if self.use_jax_profiler:
+            try:
+                import jax.profiler
+
+                ctx = jax.profiler.TraceAnnotation(name)
+                ctx.__enter__()
+            except Exception:
+                ctx = None
+        try:
+            yield s
+        finally:
+            if ctx is not None:
+                ctx.__exit__(None, None, None)
+            s.end_s = time.perf_counter()
+            self._local.depth = depth
+            with self._lock:
+                if len(self._spans) < self.max_spans:
+                    self._spans.append(s)
+
+    def spans(self, name: Optional[str] = None) -> list[Span]:
+        with self._lock:
+            out = list(self._spans)
+        if name is not None:
+            out = [s for s in out if s.name == name]
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    def summary(self) -> dict[str, dict[str, float]]:
+        """Per-name count/total/avg/max durations (seconds)."""
+        agg: dict[str, list[float]] = {}
+        for s in self.spans():
+            agg.setdefault(s.name, []).append(s.duration_s)
+        return {
+            name: {
+                "count": len(ds),
+                "total_s": sum(ds),
+                "avg_s": sum(ds) / len(ds),
+                "max_s": max(ds),
+            }
+            for name, ds in agg.items()
+        }
+
+
+#: Process-wide default tracer; RSM wires it from `tracing.enabled` config.
+NOOP_TRACER = Tracer(enabled=False)
