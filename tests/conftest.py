@@ -5,18 +5,9 @@ Multi-chip sharding paths are validated on a virtual CPU mesh
 bench.py, not in the test suite.
 """
 
-import os
+from tieredstorage_tpu.utils.platforms import pin_virtual_cpu
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "--xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-
-# The axon site hook (PYTHONPATH sitecustomize) pins jax_platforms to the real
-# TPU regardless of env vars; force the virtual CPU mesh explicitly.
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+pin_virtual_cpu(8)
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,44 @@
+"""Virtual-CPU-mesh platform pinning, shared by tests, bench, and the driver
+entry points.
+
+Multi-chip sharding paths are validated on a virtual CPU mesh
+(``--xla_force_host_platform_device_count``); the axon site hook pins
+``jax_platforms`` to the real single TPU, which can neither provide N devices
+nor (in sandboxes) finish backend acquisition at all — so every caller that
+wants the virtual mesh must force the platform explicitly *before* the first
+JAX backend initialization.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def pin_virtual_cpu(min_devices: int = 8) -> None:
+    """Pin JAX to the host platform with at least ``min_devices`` virtual CPU
+    devices. Safe to call multiple times; raises if JAX initialized a backend
+    with fewer devices before the flag could take effect."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    match = re.search(rf"{_COUNT_FLAG}=(\d+)", flags)
+    count = max(8, min_devices)
+    if match is None:
+        os.environ["XLA_FLAGS"] = f"{flags} {_COUNT_FLAG}={count}".strip()
+    elif int(match.group(1)) < count:
+        os.environ["XLA_FLAGS"] = flags.replace(
+            match.group(0), f"{_COUNT_FLAG}={count}"
+        )
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    cpus = jax.devices("cpu")
+    if len(cpus) < min_devices:
+        raise RuntimeError(
+            f"virtual CPU mesh has {len(cpus)} devices, need {min_devices}; "
+            f"a JAX backend was initialized before {_COUNT_FLAG} could be "
+            "raised — call pin_virtual_cpu() before any jax.devices()/jit use"
+        )
