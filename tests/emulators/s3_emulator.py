@@ -7,12 +7,14 @@ State lives in dictionaries guarded by a lock; buckets are implicit.
 
 from __future__ import annotations
 
+import hashlib
+import hmac
 import re
 import threading
 import uuid
 import xml.etree.ElementTree as ET
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from urllib.parse import parse_qs, unquote, urlsplit
+from urllib.parse import parse_qs, quote, unquote, urlsplit
 
 
 class S3State:
@@ -23,6 +25,11 @@ class S3State:
         self.lock = threading.Lock()
         # Fault injection queue: (matcher(method, path) -> bool, status, body)
         self.fail_next: list[tuple] = []
+        # (access_key, secret_key) — when set, every request's SigV4
+        # signature is verified against an independent reconstruction from
+        # the raw wire request (the way real S3 does; LocalStack-style
+        # emulators that skip this let signer bugs through undetected).
+        self.credentials: tuple[str, str] | None = None
 
 
 def _xml(tag: str, children: dict[str, str]) -> bytes:
@@ -76,9 +83,72 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply(status, body)
         return True
 
+    _AUTH_RE = re.compile(
+        r"AWS4-HMAC-SHA256 Credential=([^/]+)/(\d{8})/([^/]+)/([^/]+)/aws4_request,\s*"
+        r"SignedHeaders=([^,]+),\s*Signature=([0-9a-f]{64})"
+    )
+
+    def _verify_sigv4(self) -> bool:
+        """Recompute the SigV4 signature from the raw wire request.
+
+        Canonical URI is the request path exactly as received (S3 semantics:
+        single-encoded, never re-encoded) — so a client that double-encodes
+        its canonical path fails here the same way it fails on real S3."""
+        creds = self.state.credentials
+        if creds is None:
+            return True
+        m = self._AUTH_RE.fullmatch(self.headers.get("Authorization", "").strip())
+        if not m:
+            self._body()
+            self._reply(403, _error_xml("AccessDenied", "missing or malformed Authorization"))
+            return False
+        access_key, datestamp, region, service, signed_headers, signature = m.groups()
+        if access_key != creds[0]:
+            self._body()
+            self._reply(403, _error_xml("InvalidAccessKeyId", access_key))
+            return False
+        raw_path, _, raw_query = self.path.partition("?")
+        pairs = []
+        for item in raw_query.split("&") if raw_query else []:
+            k, _, v = item.partition("=")
+            pairs.append((unquote(k), unquote(v)))
+        enc = lambda s: quote(s, safe="-._~")  # noqa: E731
+        canonical_query = "&".join(f"{enc(k)}={enc(v)}" for k, v in sorted(pairs))
+        names = signed_headers.split(";")
+        canonical_headers = "".join(
+            f"{n}:{(self.headers.get(n) or '').strip()}\n" for n in names
+        )
+        payload_hash = self.headers.get("x-amz-content-sha256", "")
+        canonical_request = "\n".join(
+            [self.command, raw_path or "/", canonical_query,
+             canonical_headers, signed_headers, payload_hash]
+        )
+        scope = f"{datestamp}/{region}/{service}/aws4_request"
+        string_to_sign = "\n".join(
+            ["AWS4-HMAC-SHA256", self.headers.get("x-amz-date", ""), scope,
+             hashlib.sha256(canonical_request.encode("utf-8")).hexdigest()]
+        )
+        key = b"AWS4" + creds[1].encode("utf-8")
+        for part in (datestamp, region, service, "aws4_request"):
+            key = hmac.new(key, part.encode("utf-8"), hashlib.sha256).digest()
+        expected = hmac.new(key, string_to_sign.encode("utf-8"), hashlib.sha256).hexdigest()
+        if not hmac.compare_digest(expected, signature):
+            self._body()
+            self._reply(
+                403,
+                _error_xml(
+                    "SignatureDoesNotMatch",
+                    f"canonical request was:\n{canonical_request}",
+                ),
+            )
+            return False
+        return True
+
     # ------------------------------------------------------------- handlers
     def do_PUT(self) -> None:
         if self._maybe_fail():
+            return
+        if not self._verify_sigv4():
             return
         bucket, key, query = self._split()
         body = self._body()
@@ -99,6 +169,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self) -> None:
         if self._maybe_fail():
+            return
+        if not self._verify_sigv4():
             return
         bucket, key, _query = self._split()
         with self.state.lock:
@@ -130,6 +202,8 @@ class _Handler(BaseHTTPRequestHandler):
     def do_DELETE(self) -> None:
         if self._maybe_fail():
             return
+        if not self._verify_sigv4():
+            return
         bucket, key, query = self._split()
         if "uploadId" in query:
             with self.state.lock:
@@ -144,7 +218,12 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self) -> None:
         if self._maybe_fail():
             return
+        if not self._verify_sigv4():
+            return
         bucket, key, query = self._split()
+        # Always drain the body: an undrained body gets parsed as the next
+        # request line on the keep-alive connection, corrupting it.
+        body = self._body()
         if "uploads" in query:
             upload_id = uuid.uuid4().hex
             with self.state.lock:
@@ -174,7 +253,6 @@ class _Handler(BaseHTTPRequestHandler):
             )
             return
         if "delete" in query:
-            body = self._body()
             root = ET.fromstring(body)
             deleted = []
             with self.state.lock:
@@ -188,8 +266,9 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class S3Emulator:
-    def __init__(self) -> None:
+    def __init__(self, credentials: tuple[str, str] | None = None) -> None:
         self.state = S3State()
+        self.state.credentials = credentials
         handler = type("Handler", (_Handler,), {"state": self.state})
         self.server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
         self.thread = threading.Thread(target=self.server.serve_forever, daemon=True)

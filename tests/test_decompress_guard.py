@@ -1,0 +1,54 @@
+"""Decompress-bomb guard: frame content sizes are validated before any
+allocation is sized from them (ADVICE r1: a corrupt/malicious remote frame
+claiming a huge content size must not force an n_chunks * stride allocation).
+"""
+
+from __future__ import annotations
+
+import pytest
+import zstandard
+
+from tieredstorage_tpu.native import (
+    MAX_FRAME_CONTENT_SIZE,
+    NativeTransformError,
+    checked_frame_content_sizes,
+)
+from tieredstorage_tpu.transform.api import DetransformOptions
+from tieredstorage_tpu.transform.cpu import CpuTransformBackend
+
+
+def _frame(n: int) -> bytes:
+    return zstandard.ZstdCompressor(write_content_size=True).compress(bytes(n))
+
+
+def test_sizes_within_cap_pass():
+    assert checked_frame_content_sizes([_frame(100), _frame(5000)], 5000) == 5000
+
+
+def test_claim_over_cap_rejected():
+    with pytest.raises(NativeTransformError, match="over the limit"):
+        checked_frame_content_sizes([_frame(100), _frame(5001)], 5000)
+
+
+def test_absolute_ceiling_without_cap():
+    # Hand-built frame header claiming ~2 GiB: magic, FHD (single-segment,
+    # 8-byte FCS field), frame content size, no blocks needed for the check.
+    huge = (1 << 31).to_bytes(8, "little")
+    frame = b"\x28\xb5\x2f\xfd" + b"\xe0" + huge
+    assert zstandard.frame_content_size(frame) == 1 << 31
+    assert 1 << 31 > MAX_FRAME_CONTENT_SIZE
+    with pytest.raises(NativeTransformError, match="over the limit"):
+        checked_frame_content_sizes([frame], None)
+
+
+def test_missing_content_size_rejected():
+    frame = zstandard.ZstdCompressor(write_content_size=False).compress(b"x" * 100)
+    with pytest.raises(NativeTransformError, match="missing content size"):
+        checked_frame_content_sizes([frame], None)
+
+
+def test_cpu_backend_enforces_manifest_chunk_bound():
+    backend = CpuTransformBackend()
+    opts = DetransformOptions(compression=True, max_original_chunk_size=1024)
+    with pytest.raises(NativeTransformError):
+        backend.detransform([_frame(4096)], opts)

@@ -1,0 +1,107 @@
+"""HTTP transport semantics: retry only where replay is safe.
+
+ADVICE r1: a failure on a brand-new connection may mean the server already
+executed the request — replaying a non-idempotent call (DeleteObjects,
+CompleteMultipartUpload, PutBlockList) could run it twice. Retrying is only
+safe on a reused keep-alive connection, where the failure almost certainly
+means the server closed the idle connection before the request arrived.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tieredstorage_tpu.storage.httpclient import HttpClient, HttpError
+
+
+class _Resp:
+    status = 200
+
+    def read(self):
+        return b"ok"
+
+    def getheaders(self):
+        return []
+
+
+def test_no_retry_on_fresh_connection(monkeypatch):
+    client = HttpClient("http://test.invalid")
+    attempts = []
+
+    class FailConn:
+        def request(self, *a, **k):
+            attempts.append("req")
+            raise OSError("connection reset")
+
+        def close(self):
+            pass
+
+    monkeypatch.setattr(client, "_new_connection", FailConn)
+    with pytest.raises(HttpError):
+        client.request("POST", "/non-idempotent", body=b"x")
+    assert len(attempts) == 1  # no blind replay on a first-use connection
+
+
+def test_retry_once_on_stale_keepalive_connection(monkeypatch):
+    client = HttpClient("http://test.invalid")
+    calls = {"n": 0}
+
+    class Conn:
+        def __init__(self, stale_on_second):
+            self.stale_on_second = stale_on_second
+
+        def request(self, *a, **k):
+            calls["n"] += 1
+            if self.stale_on_second and calls["n"] == 2:
+                raise OSError("stale keep-alive")
+
+        def getresponse(self):
+            return _Resp()
+
+        def close(self):
+            pass
+
+    conns = iter([Conn(True), Conn(False)])
+    monkeypatch.setattr(client, "_new_connection", lambda: next(conns))
+    assert client.request("GET", "/a").status == 200  # marks the conn as used
+    assert client.request("GET", "/b").status == 200  # stale -> one retry, fresh conn
+    assert calls["n"] == 3
+
+
+def test_no_replay_of_sent_post_on_reused_connection(monkeypatch):
+    # Once a POST has been fully sent, the server may have executed it even
+    # if the response never arrives — replaying could run a non-idempotent
+    # operation (DeleteObjects, CompleteMultipartUpload) twice.
+    client = HttpClient("http://test.invalid")
+    sends = {"n": 0}
+
+    class Conn:
+        def __init__(self, die_on_response):
+            self.die_on_response = die_on_response
+
+        def request(self, *a, **k):
+            sends["n"] += 1
+
+        def getresponse(self):
+            if self.die_on_response and sends["n"] == 2:
+                raise OSError("server died after receiving the request")
+            return _Resp()
+
+        def close(self):
+            pass
+
+    conns = iter([Conn(True), Conn(False)])
+    monkeypatch.setattr(client, "_new_connection", lambda: next(conns))
+    assert client.request("GET", "/warmup").status == 200
+    with pytest.raises(HttpError):
+        client.request("POST", "/?delete", body=b"<Delete/>")
+    assert sends["n"] == 2  # no replay
+
+    # The same post-send failure on a GET is replayed (idempotent).
+    client2 = HttpClient("http://test.invalid")
+    sends["n"] = 0
+    conns2 = iter([Conn(True), Conn(False)])
+    monkeypatch.setattr(client2, "_new_connection", lambda: next(conns2))
+    assert client2.request("GET", "/warmup").status == 200
+    assert client2.request("GET", "/again").status == 200
+    assert sends["n"] == 3

@@ -151,6 +151,35 @@ def zstd_compress_batch(chunks: list[bytes], level: int = 3, n_threads: int = 0)
     ]
 
 
+#: Absolute sanity ceiling on a single frame's declared content size, used
+#: when the caller can't supply the configured chunk-size bound. chunk.size
+#: is capped at INT_MAX/2 (config guard mirroring the reference's
+#: RemoteStorageManagerConfig.java:126-127), so nothing legitimate exceeds it.
+MAX_FRAME_CONTENT_SIZE = (1 << 31) // 2
+
+
+def checked_frame_content_sizes(chunks, max_decompressed: Optional[int]) -> int:
+    """Validate each zstd frame's self-declared content size BEFORE any
+    allocation sized from it: a corrupted or malicious remote frame claiming
+    a huge size would otherwise force an n_chunks * stride allocation.
+    Returns the largest declared size (>= 1)."""
+    import zstandard
+
+    cap = max_decompressed if max_decompressed is not None else MAX_FRAME_CONTENT_SIZE
+    largest = 1
+    for i, c in enumerate(chunks):
+        size = zstandard.frame_content_size(c)
+        if size is None or size < 0:
+            raise NativeTransformError(f"zstd frame {i} missing content size")
+        if size > cap:
+            raise NativeTransformError(
+                f"zstd frame {i} claims {size} decompressed bytes, "
+                f"over the limit of {cap}"
+            )
+        largest = max(largest, size)
+    return largest
+
+
 def zstd_decompress_batch(
     chunks: list[bytes], max_decompressed: Optional[int] = None, n_threads: int = 0
 ) -> list[bytes]:
@@ -159,17 +188,9 @@ def zstd_decompress_batch(
         raise NativeTransformError(f"native library unavailable: {_load_error}")
     if not chunks:
         return []
-    if max_decompressed is None:
-        # Frames carry their content size (pledged at compression); size the
-        # output stride from the largest frame.
-        import zstandard
-
-        max_decompressed = 1
-        for c in chunks:
-            size = zstandard.frame_content_size(c)
-            if size is None or size < 0:
-                raise NativeTransformError("zstd frame missing content size")
-            max_decompressed = max(max_decompressed, size)
+    # Size the output stride from the largest declared frame size, bounded
+    # by the caller's chunk-size cap (or the absolute ceiling).
+    max_decompressed = checked_frame_content_sizes(chunks, max_decompressed)
     buf, offsets, sizes = _pack(chunks)
     stride = max_decompressed
     out = np.empty(len(chunks) * stride, dtype=np.uint8)

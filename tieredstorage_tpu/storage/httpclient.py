@@ -154,6 +154,7 @@ class HttpClient:
         if conn is None:
             conn = self._new_connection()
             self._local.conn = conn
+            self._local.conn_used = False
         return conn
 
     def _drop_pooled(self) -> None:
@@ -217,17 +218,32 @@ class HttpClient:
         hdrs = {k.lower(): v for k, v in resp.getheaders()}
         return resp.status, hdrs, _StreamedBody(resp, conn)
 
+    _IDEMPOTENT = frozenset({"GET", "HEAD", "PUT", "DELETE"})
+
     def _roundtrip(self, method, path_and_query, headers, body) -> http.client.HTTPResponse:
         conn = self._pooled()
+        reused = getattr(self._local, "conn_used", False)
+        sent = False
         try:
             conn.request(method, path_and_query, body=body, headers=dict(headers or {}))
-            return conn.getresponse()
+            sent = True
+            resp = conn.getresponse()
         except (OSError, http.client.HTTPException):
-            # Stale keep-alive connection: retry once on a fresh one.
             self._drop_pooled()
+            # Retry once ONLY when replay is safe: the first attempt must
+            # have been on a reused keep-alive connection (a fresh-connection
+            # failure isn't a stale-socket artifact), and for non-idempotent
+            # methods (DeleteObjects/CompleteMultipartUpload/PutBlockList
+            # POSTs) only when the failure happened while SENDING — once the
+            # full request went out, the server may have executed it, and a
+            # replay could run it twice.
+            if not reused or (sent and method not in self._IDEMPOTENT):
+                raise
             conn = self._pooled()
             conn.request(method, path_and_query, body=body, headers=dict(headers or {}))
-            return conn.getresponse()
+            resp = conn.getresponse()
+        self._local.conn_used = True
+        return resp
 
     def close(self) -> None:
         self._drop_pooled()

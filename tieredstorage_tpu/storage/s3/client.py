@@ -206,7 +206,15 @@ class S3Client:
             body=data,
             extra_headers=extra,
         )
-        return resp.header("etag", "")
+        etag = resp.header("etag", "")
+        if not etag:
+            # Fail here, not at CompleteMultipartUpload, where a blank ETag
+            # surfaces as a confusing MalformedXML-style error far from the
+            # cause (some proxies/S3-compatible stores omit the header).
+            raise S3ApiError(
+                resp.status, "MissingETag", f"no ETag returned for part {part_number}"
+            )
+        return etag
 
     def complete_multipart_upload(
         self, key: str, upload_id: str, etags: list[tuple[int, str]]

@@ -47,7 +47,12 @@ class SigV4Signer:
         now: Optional[datetime.datetime] = None,
     ) -> dict[str, str]:
         """Returns `headers` extended with x-amz-date, x-amz-content-sha256
-        and Authorization. `headers` must already contain Host."""
+        and Authorization. `headers` must already contain Host.
+
+        `path` must be the path exactly as it will be sent on the wire,
+        percent-encoded once by the caller: for S3 the canonical URI is that
+        wire path verbatim (re-encoding here would turn '%' into '%25' and
+        break signatures for keys with spaces/'+'/'=' etc.)."""
         t = now or datetime.datetime.now(datetime.timezone.utc)
         amz_date = t.strftime("%Y%m%dT%H%M%SZ")
         datestamp = t.strftime("%Y%m%d")
@@ -67,7 +72,7 @@ class SigV4Signer:
         canonical_request = "\n".join(
             [
                 method,
-                uri_encode(path, encode_slash=False) or "/",
+                path or "/",
                 canonical_query,
                 canonical_headers,
                 signed_headers,

@@ -68,6 +68,11 @@ class DetransformOptions:
     compression: bool = False
     compression_codec: str = ZSTD
     encryption: Optional[DataKeyAndAAD] = None
+    # Upper bound on any chunk's decompressed size (the segment's configured
+    # chunk.size, known from the manifest). Backends use it to reject
+    # corrupt/malicious frames that declare huge content sizes before
+    # allocating output buffers from them.
+    max_original_chunk_size: Optional[int] = None
 
     @staticmethod
     def from_manifest(manifest, aes_key: Optional[DataKeyAndAAD] = None) -> "DetransformOptions":
@@ -80,6 +85,7 @@ class DetransformOptions:
             compression=manifest.compression,
             compression_codec=manifest.compression_codec or ZSTD,
             encryption=enc,
+            max_original_chunk_size=manifest.chunk_index.original_chunk_size,
         )
 
 

@@ -74,6 +74,9 @@ class CpuTransformBackend(TransformBackend):
                     f"CPU backend supports only the {ZSTD!r} codec, "
                     f"got {opts.compression_codec!r}"
                 )
+            from tieredstorage_tpu.native import checked_frame_content_sizes
+
+            checked_frame_content_sizes(out, opts.max_original_chunk_size)
             dctx = zstandard.ZstdDecompressor()
             out = [dctx.decompress(c) for c in out]
         return out

@@ -86,5 +86,9 @@ class NativeTransformBackend(TransformBackend):
                 raise AuthenticationError(str(e)) from None
         if opts.compression:
             self._check_codec(opts.compression_codec)
-            out = native.zstd_decompress_batch(out, n_threads=self.n_threads)
+            out = native.zstd_decompress_batch(
+                out,
+                max_decompressed=opts.max_original_chunk_size,
+                n_threads=self.n_threads,
+            )
         return out

@@ -155,8 +155,11 @@ class TpuTransformBackend(TransformBackend):
             if opts.compression_codec != ZSTD:
                 raise ValueError(f"Codec {opts.compression_codec!r} not yet implemented")
             if self._use_native():
-                out = native.zstd_decompress_batch(out)
+                out = native.zstd_decompress_batch(
+                    out, max_decompressed=opts.max_original_chunk_size
+                )
             else:
+                native.checked_frame_content_sizes(out, opts.max_original_chunk_size)
                 # One DCtx per chunk: zstandard (de)compressor objects are not
                 # thread-safe across the pool's workers.
                 out = list(
