@@ -59,5 +59,22 @@ def test_varlen_context_shared_across_nearby_sizes():
     key = secrets.token_bytes(32)
     c1 = make_varlen_context(key, b"a", 1000)
     c2 = make_varlen_context(key, b"a", 1008)
-    assert c1 is c2  # both round up to 1008
+    assert c1 is c2  # both land in the same ladder bucket
     assert c1.max_bytes % 16 == 0
+
+
+def test_bucket_ladder_bounds_compile_cache_and_overhead():
+    from tieredstorage_tpu.ops.gcm import bucket_max_bytes
+
+    # Sweep a realistic compressed-size regime: the ladder must keep the
+    # number of distinct jit shapes tiny and the padding overhead <= 25%
+    # (the round-1 recompile storm had one shape per distinct window max).
+    sizes = range(1 << 20, 4 << 20, 4096)  # 1..4 MiB in 4 KiB steps
+    buckets = {bucket_max_bytes(n) for n in sizes}
+    assert len(buckets) <= 16
+    for n in list(sizes)[:: 64]:
+        b = bucket_max_bytes(n)
+        assert n <= b <= n * 1.25
+        assert b % 16 == 0
+    # Monotonic: a bigger batch max never maps to a smaller shape.
+    assert bucket_max_bytes(1000) <= bucket_max_bytes(1001)

@@ -187,3 +187,27 @@ def test_index_not_available_before_drain(segment_bytes, key_pair):
         _ = tr.chunk_index
     tr.stream().read()
     assert tr.chunk_index is not None
+
+
+def test_base_transform_windows_slices_deterministic_ivs():
+    """Nonce-reuse guard: the default windowed path must give each window its
+    own slice of the flat IV sequence, matching the monolithic transform."""
+    from tieredstorage_tpu.security.aes import IV_SIZE, AesEncryptionProvider
+    from tieredstorage_tpu.transform.api import TransformOptions
+    from tieredstorage_tpu.transform.cpu import CpuTransformBackend
+
+    key_pair = AesEncryptionProvider.create_data_key_and_aad()
+    chunks = [bytes([i]) * 256 for i in range(6)]
+    ivs = [bytes([0x40 + i]) * IV_SIZE for i in range(6)]
+    opts = TransformOptions(encryption=key_pair, ivs=ivs)
+    backend = CpuTransformBackend()
+    monolithic = backend.transform(chunks, opts)
+    windowed = [
+        c
+        for out in backend.transform_windows(
+            iter([chunks[0:2], chunks[2:5], chunks[5:6]]), opts
+        )
+        for c in out
+    ]
+    assert windowed == monolithic
+    assert len({c[:IV_SIZE] for c in windowed}) == len(chunks)  # all IVs distinct

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import abc
 import dataclasses
-from typing import Optional, Sequence
+from typing import Iterable, Iterator, Optional, Sequence
 
 from tieredstorage_tpu.security.aes import DataKeyAndAAD
 
@@ -96,8 +96,36 @@ class TransformBackend(abc.ABC):
     #: windows of roughly this size. TPU backends set this to fill the chip.
     preferred_batch_chunks: int = 64
 
+    #: Byte cap per window (None = chunk count only). Device backends bound
+    #: this so a window's staged arrays fit HBM and consecutive windows can
+    #: overlap host and device work.
+    preferred_batch_bytes: Optional[int] = None
+
     def configure(self, configs: dict) -> None:  # noqa: B027
         """Configure from the `transform.`-prefixed config subset."""
+
+    def transform_windows(
+        self, windows: Iterable[Sequence[bytes]], opts: TransformOptions
+    ) -> Iterator[list[bytes]]:
+        """Upload direction over a stream of chunk windows, 1:1 per window.
+
+        Default: synchronous, one window at a time. Device backends override
+        this to pipeline — host compression of window N+1 overlapping device
+        encryption of window N (SURVEY §7 step 5's double-buffered staging).
+
+        When `opts.ivs` is set (deterministic IVs, a flat per-chunk
+        sequence), each window receives its own slice — reusing the list
+        per window would repeat GCM nonces under one key.
+        """
+        iv_offset = 0
+        for window in windows:
+            w_opts = opts
+            if opts.ivs is not None:
+                w_opts = dataclasses.replace(
+                    opts, ivs=opts.ivs[iv_offset : iv_offset + len(window)]
+                )
+                iv_offset += len(window)
+            yield self.transform(window, w_opts)
 
     @abc.abstractmethod
     def transform(self, chunks: Sequence[bytes], opts: TransformOptions) -> list[bytes]:

@@ -103,34 +103,42 @@ class SegmentTransformation:
                 self.original_chunk_size, self.original_file_size
             )
 
-        window: list[bytes] = []
         window_chunks = max(1, self._backend.preferred_batch_chunks)
+        window_bytes = self._backend.preferred_batch_bytes
         pending: Optional[bytes] = None  # last transformed chunk, deferred for finish()
+        submitted: list[int] = []  # window lengths, for 1:1 validation
 
-        def flush(window: list[bytes]) -> Iterator[bytes]:
-            nonlocal pending
-            transformed = self._backend.transform(window, self._opts)
-            if len(transformed) != len(window):
+        def windows() -> Iterator[list[bytes]]:
+            window: list[bytes] = []
+            size = 0
+            for chunk in read_chunks(self._source, self.original_chunk_size):
+                window.append(chunk)
+                size += len(chunk)
+                if len(window) >= window_chunks or (
+                    window_bytes is not None and size >= window_bytes
+                ):
+                    submitted.append(len(window))
+                    yield window
+                    window, size = [], 0
+            if window:
+                submitted.append(len(window))
+                yield window
+
+        got_any = False
+        # transform_windows lets device backends overlap host work on window
+        # N+1 with device work on window N (double-buffered staging).
+        for transformed in self._backend.transform_windows(windows(), self._opts):
+            got_any = got_any or bool(transformed)
+            expected = submitted.pop(0)
+            if len(transformed) != expected:
                 raise RuntimeError(
-                    f"Backend returned {len(transformed)} chunks for a window of {len(window)}"
+                    f"Backend returned {len(transformed)} chunks for a window of {expected}"
                 )
             for t in transformed:
                 if pending is not None:
                     builder.add_chunk(len(pending))
-                    yield pending
+                    yield io.BytesIO(pending)
                 pending = t
-
-        got_any = False
-        for chunk in read_chunks(self._source, self.original_chunk_size):
-            got_any = True
-            window.append(chunk)
-            if len(window) >= window_chunks:
-                for t in flush(window):
-                    yield io.BytesIO(t)
-                window = []
-        if window:
-            for t in flush(window):
-                yield io.BytesIO(t)
 
         if not got_any:
             # Empty source: empty-file index (final transformed size of the

@@ -42,6 +42,10 @@ from tieredstorage_tpu.transform.api import (
 
 class TpuTransformBackend(TransformBackend):
     preferred_batch_chunks = 256
+    # Window byte cap: keeps one staged window (padded input + output +
+    # keystream intermediates) well inside a v5e's 16 GiB HBM while leaving
+    # room for the double-buffered window in flight behind it.
+    preferred_batch_bytes = 256 << 20
 
     def __init__(self, mesh=None):
         self._mesh = mesh
@@ -50,6 +54,8 @@ class TpuTransformBackend(TransformBackend):
     def configure(self, configs: dict) -> None:
         if "batch.chunks" in configs:
             self.preferred_batch_chunks = int(configs["batch.chunks"])
+        if "batch.bytes" in configs:
+            self.preferred_batch_bytes = int(configs["batch.bytes"])
         n = configs.get("mesh.devices")
         if n:
             self._mesh = data_mesh(int(n))
@@ -70,23 +76,60 @@ class TpuTransformBackend(TransformBackend):
         if not out:
             return []
         if opts.compression:
-            if opts.compression_codec != ZSTD:
-                raise ValueError(f"Codec {opts.compression_codec!r} not yet implemented")
-            level = opts.compression_level
-            if self._use_native():
-                out = native.zstd_compress_batch(out, level=level)
-            else:
-                out = list(
-                    self._zstd_pool().map(
-                        lambda c: zstandard.ZstdCompressor(
-                            level=level, write_content_size=True
-                        ).compress(c),
-                        out,
-                    )
-                )
+            out = self._compress_batch(out, opts)
         if opts.encryption is not None:
-            out = self._encrypt_batch(out, opts)
+            out = self._encrypt_finish(self._encrypt_dispatch(out, opts))
         return out
+
+    def transform_windows(self, windows, opts: TransformOptions):
+        """Double-buffered staging (SURVEY §7 step 5): the device encrypts
+        window N while the host compresses window N+1. JAX dispatch is
+        async — `_encrypt_dispatch` returns un-materialized device arrays,
+        and only `_encrypt_finish` (one window later) blocks on them."""
+        if opts.encryption is None:
+            # Compression-only is host-bound: nothing to overlap against.
+            for window in windows:
+                yield self.transform(window, opts)
+            return
+        import dataclasses
+
+        pending = None
+        iv_offset = 0
+        for window in windows:
+            chunks = list(window)
+            # Deterministic IVs (tests) are a flat per-chunk sequence: slice
+            # the window's share so windowed == monolithic byte-for-byte.
+            w_opts = opts
+            if opts.ivs is not None:
+                w_opts = dataclasses.replace(
+                    opts, ivs=opts.ivs[iv_offset : iv_offset + len(chunks)]
+                )
+                iv_offset += len(chunks)
+            if opts.compression:
+                chunks = self._compress_batch(chunks, w_opts)
+            staged = self._encrypt_dispatch(chunks, w_opts) if chunks else None
+            if pending is not None:
+                yield self._encrypt_finish(pending)
+            pending = staged
+            if staged is None:
+                yield []
+        if pending is not None:
+            yield self._encrypt_finish(pending)
+
+    def _compress_batch(self, chunks: list[bytes], opts: TransformOptions) -> list[bytes]:
+        if opts.compression_codec != ZSTD:
+            raise ValueError(f"Codec {opts.compression_codec!r} not yet implemented")
+        level = opts.compression_level
+        if self._use_native():
+            return native.zstd_compress_batch(chunks, level=level)
+        return list(
+            self._zstd_pool().map(
+                lambda c: zstandard.ZstdCompressor(
+                    level=level, write_content_size=True
+                ).compress(c),
+                chunks,
+            )
+        )
 
     @staticmethod
     def _use_native() -> bool:
@@ -105,7 +148,9 @@ class TpuTransformBackend(TransformBackend):
             )
         return np.frombuffer(os.urandom(IV_SIZE * n), dtype=np.uint8).reshape(n, IV_SIZE)
 
-    def _encrypt_batch(self, chunks: list[bytes], opts: TransformOptions) -> list[bytes]:
+    def _encrypt_dispatch(self, chunks: list[bytes], opts: TransformOptions):
+        """Stage a window: build host arrays, dispatch the GCM kernel
+        asynchronously, return (ivs, sizes, device ct, device tags)."""
         enc = opts.encryption
         sizes = [len(c) for c in chunks]
         ivs = self._make_ivs(len(chunks), opts)
@@ -115,7 +160,6 @@ class TpuTransformBackend(TransformBackend):
             data = np.stack([np.frombuffer(c, dtype=np.uint8) for c in chunks])
             data, ivs_padded, pad = self._maybe_shard(data, ivs)
             ct, tags = gcm_encrypt_chunks(ctx, ivs_padded, data)
-            ct, tags = np.asarray(ct), np.asarray(tags)
         else:
             max_bytes = max(sizes)
             ctx = make_varlen_context(enc.data_key, enc.aad, max_bytes)
@@ -127,11 +171,16 @@ class TpuTransformBackend(TransformBackend):
             if pad:
                 lengths = np.concatenate([lengths, np.full(pad, 16, np.int32)])
             ct, tags = gcm_encrypt_varlen(ctx, ivs_padded, data, lengths)
-            ct, tags = np.asarray(ct), np.asarray(tags)
+        return ivs, sizes, ct, tags
 
+    def _encrypt_finish(self, staged) -> list[bytes]:
+        """Block on a staged window's device arrays and materialize the wire
+        format (IV || ct || tag per chunk)."""
+        ivs, sizes, ct, tags = staged
+        ct, tags = np.asarray(ct), np.asarray(tags)
         return [
             ivs[i].tobytes() + ct[i, : sizes[i]].tobytes() + tags[i].tobytes()
-            for i in range(len(chunks))
+            for i in range(len(sizes))
         ]
 
     def _maybe_shard(self, data: np.ndarray, ivs: np.ndarray):

@@ -24,9 +24,10 @@ def pin_virtual_cpu(min_devices: int = 8) -> None:
     os.environ["JAX_PLATFORMS"] = "cpu"
     flags = os.environ.get("XLA_FLAGS", "")
     match = re.search(rf"{_COUNT_FLAG}=(\d+)", flags)
-    count = max(8, min_devices)
+    count = max(1, min_devices)
     if match is None:
-        os.environ["XLA_FLAGS"] = f"{flags} {_COUNT_FLAG}={count}".strip()
+        if count > 1:
+            os.environ["XLA_FLAGS"] = f"{flags} {_COUNT_FLAG}={count}".strip()
     elif int(match.group(1)) < count:
         os.environ["XLA_FLAGS"] = flags.replace(
             match.group(0), f"{_COUNT_FLAG}={count}"
