@@ -1,0 +1,144 @@
+"""Profile the device GCM hot path component by component (VERDICT r2 task 1).
+
+Attributes wall time of `_gcm_process_batch` on the real chip to its stages:
+host->device transfer, CTR keystream (bitsliced AES), keystream unpack,
+GHASH bit expansion, GHASH tree matmuls, tag pack/xor. Run on the TPU:
+
+    python tools/profile_gcm.py [total_mib] [chunk_mib]
+
+Prints a table to stderr and a JSON summary to stdout.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from tieredstorage_tpu.ops import gcm
+from tieredstorage_tpu.ops.aes_bitsliced import (
+    aes_encrypt_planes,
+    ctr_keystream_batch,
+    rk_planes_from_round_keys,
+)
+
+
+def timeit(fn, *args, iters=3, warmup=1):
+    for _ in range(warmup):
+        r = fn(*args)
+        jax.block_until_ready(r)
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        r = fn(*args)
+        jax.block_until_ready(r)
+        best = min(best, time.perf_counter() - t0)
+    return best, r
+
+
+def main():
+    total_mib = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    chunk_mib = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+    chunk_bytes = chunk_mib << 20
+    batch = max(1, (total_mib << 20) // chunk_bytes)
+    total = batch * chunk_bytes
+    gib = total / (1 << 30)
+    err = lambda *a: print(*a, file=sys.stderr, flush=True)
+    err(f"devices={jax.devices()} batch={batch} chunk={chunk_mib}MiB total={total_mib}MiB")
+
+    key = bytes(range(32))
+    aad = b"profiling-aad"
+    ctx = gcm.make_context(key, aad, chunk_bytes)
+    rng = np.random.default_rng(0)
+    data_host = rng.integers(0, 256, (batch, chunk_bytes), dtype=np.uint8)
+    ivs_host = rng.integers(0, 256, (batch, 12), dtype=np.uint8)
+
+    results = {}
+
+    # 0. host->device transfer
+    t, data_dev = timeit(lambda: jax.device_put(data_host))
+    results["h2d_transfer"] = t
+    err(f"h2d transfer:        {t*1e3:9.1f} ms  {gib/t:8.2f} GiB/s")
+
+    ivs_dev = jax.device_put(ivs_host)
+    rk, lm, fm, cb = gcm._device_consts(ctx)
+    n_blocks = ctx.n_blocks
+
+    # 1. full kernel
+    full = jax.jit(
+        lambda rks, iv, d: gcm._gcm_process_batch(
+            rks, iv, d, lm, fm, cb,
+            chunk_bytes=chunk_bytes, n_blocks=n_blocks, levels=ctx.levels,
+            decrypt=False,
+        )
+    )
+    t, _ = timeit(full, rk, ivs_dev, data_dev)
+    results["full_gcm"] = t
+    err(f"full GCM:            {t*1e3:9.1f} ms  {gib/t:8.2f} GiB/s")
+
+    # 2. CTR keystream alone (bitsliced AES incl unpack-to-bytes)
+    ks_fn = jax.jit(
+        lambda rks, iv: ctr_keystream_batch(rks, iv, 1, n_blocks + 1)
+    )
+    t, _ = timeit(ks_fn, rk, ivs_dev)
+    results["ctr_keystream"] = t
+    err(f"ctr keystream:       {t*1e3:9.1f} ms  {gib/t:8.2f} GiB/s")
+
+    # 2a. the AES boolean circuit alone, on pre-packed planes (no pack/unpack)
+    w = (batch * (n_blocks + 1) + 31) // 32
+    planes = jnp.asarray(
+        rng.integers(0, 2**32, (16, 8, w), dtype=np.uint32)
+    )
+    rkp = rk_planes_from_round_keys(rk)
+    circ = jax.jit(aes_encrypt_planes)
+    t, _ = timeit(circ, rkp, planes)
+    results["aes_circuit_only"] = t
+    err(f"aes circuit only:    {t*1e3:9.1f} ms  {gib/t:8.2f} GiB/s")
+
+    # 3. GHASH alone (bit expansion + tree + final)
+    ghash_fn = jax.jit(
+        lambda ct: gcm._ghash_of_ct(ct, ctx.levels, n_blocks, lm, fm, cb)
+    )
+    t, _ = timeit(ghash_fn, data_dev)
+    results["ghash"] = t
+    err(f"ghash (expand+tree): {t*1e3:9.1f} ms  {gib/t:8.2f} GiB/s")
+
+    # 3a. bit expansion alone
+    exp_fn = jax.jit(
+        lambda d: gcm._bytes_to_bits(d.reshape(batch, n_blocks, 16))
+    )
+    t, _ = timeit(exp_fn, data_dev)
+    results["bit_expand"] = t
+    err(f"bit expand alone:    {t*1e3:9.1f} ms  {gib/t:8.2f} GiB/s")
+
+    # 3b. tree alone on pre-expanded bits
+    bits = exp_fn(data_dev)
+    m_pow2 = 1 << ctx.levels
+    if m_pow2 > n_blocks:
+        pad = jnp.zeros((batch, m_pow2 - n_blocks, 128), jnp.uint8)
+        bits = jnp.concatenate([pad, bits], axis=1)
+    bits = jax.block_until_ready(bits)
+    tree_fn = jax.jit(lambda b: gcm._ghash_tree(b, lm, ctx.levels))
+    t, _ = timeit(tree_fn, bits)
+    results["ghash_tree_only"] = t
+    err(f"ghash tree only:     {t*1e3:9.1f} ms  {gib/t:8.2f} GiB/s")
+
+    # 4. xor with precomputed keystream (pure elementwise baseline)
+    ks = jax.block_until_ready(ks_fn(rk, ivs_dev))
+    xor_fn = jax.jit(
+        lambda d, k: d ^ k[:, 1:, :].reshape(batch, n_blocks * 16)[:, :chunk_bytes]
+    )
+    t, _ = timeit(xor_fn, data_dev, ks)
+    results["xor_only"] = t
+    err(f"xor only:            {t*1e3:9.1f} ms  {gib/t:8.2f} GiB/s")
+
+    print(json.dumps({"total_mib": total_mib, "chunk_mib": chunk_mib, **{k: round(v, 4) for k, v in results.items()}}))
+
+
+if __name__ == "__main__":
+    main()

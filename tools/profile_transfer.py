@@ -1,0 +1,60 @@
+"""Characterize the axon tunnel: dispatch overhead, h2d/d2h bandwidth vs size."""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+err = lambda *a: print(*a, file=sys.stderr, flush=True)
+
+
+def t(fn, iters=3, warmup=1):
+    for _ in range(warmup):
+        jax.block_until_ready(fn())
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    err(f"devices={jax.devices()}")
+    tiny = jnp.zeros((8, 128), jnp.uint8)
+    inc = jax.jit(lambda x: x ^ 1)
+    err(f"dispatch overhead (tiny xor): {t(inc.lower(tiny).compile(), 10, 2)*1e3:.2f} ms"
+        if False else f"dispatch overhead (tiny xor): {t(lambda: inc(tiny), iters=10, warmup=2)*1e3:.2f} ms")
+
+    rng = np.random.default_rng(0)
+    for mib in (1, 4, 16, 64):
+        a = rng.integers(0, 256, mib << 20, dtype=np.uint8)
+        dt = t(lambda: jax.device_put(a))
+        err(f"h2d {mib:3d} MiB: {dt*1e3:8.1f} ms  {mib/1024/dt:7.3f} GiB/s")
+        d = jax.device_put(a)
+        dt = t(lambda: np.asarray(d))
+        err(f"d2h {mib:3d} MiB: {dt*1e3:8.1f} ms  {mib/1024/dt:7.3f} GiB/s")
+        big_xor = jax.jit(lambda x: x ^ np.uint8(255))
+        dt = t(lambda: big_xor(d))
+        err(f"dev xor {mib:3d} MiB (no transfer): {dt*1e3:8.1f} ms  {mib/1024/dt:7.3f} GiB/s")
+
+    # parallel h2d: do 8 x 8MiB puts at once, then block
+    a = [rng.integers(0, 256, 8 << 20, dtype=np.uint8) for _ in range(8)]
+    def par_put():
+        ds = [jax.device_put(x) for x in a]
+        return ds
+    dt = t(par_put)
+    err(f"h2d 8x8 MiB overlapped: {dt*1e3:8.1f} ms  {64/1024/dt:7.3f} GiB/s")
+
+    # pinned layout? try jnp.asarray on 2D
+    b = rng.integers(0, 256, (16, 4 << 20), dtype=np.uint8)
+    dt = t(lambda: jax.device_put(b))
+    err(f"h2d 64 MiB 2D: {dt*1e3:8.1f} ms  {64/1024/dt:7.3f} GiB/s")
+
+
+if __name__ == "__main__":
+    main()

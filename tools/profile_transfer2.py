@@ -1,0 +1,87 @@
+"""Find the h2d size cliff, real d2h cost, and the per-launch floor."""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+err = lambda *a: print(*a, file=sys.stderr, flush=True)
+
+
+def t(fn, iters=3, warmup=1):
+    for _ in range(warmup):
+        jax.block_until_ready(fn())
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    rng = np.random.default_rng(0)
+    err("--- h2d size sweep ---")
+    for kib in (256, 512, 1024, 1536, 2048, 2560, 3072, 4096, 8192):
+        a = rng.integers(0, 256, kib << 10, dtype=np.uint8)
+        dt = t(lambda: jax.device_put(a))
+        err(f"h2d {kib:6d} KiB: {dt*1e3:9.2f} ms  {kib/1024/1024/dt:8.3f} GiB/s")
+
+    err("--- h2d chunked: 64 MiB as N puts of S, then concat on device ---")
+    total = 64 << 20
+    for s_kib in (1024, 2048):
+        s = s_kib << 10
+        n = total // s
+        parts = [rng.integers(0, 256, s, dtype=np.uint8) for _ in range(n)]
+        cat = jax.jit(lambda *xs: jnp.concatenate(xs))
+        def chunked():
+            ds = [jax.device_put(p) for p in parts]
+            return cat(*ds)
+        dt = t(chunked, iters=2, warmup=1)
+        err(f"chunked {s_kib} KiB x{n}: {dt*1e3:9.1f} ms  {total/(1<<30)/dt:8.3f} GiB/s")
+        def chunked_nocat():
+            ds = [jax.device_put(p) for p in parts]
+            for d in ds:
+                d.block_until_ready()
+            return ds[0]
+        dt = t(chunked_nocat, iters=2, warmup=1)
+        err(f"chunked {s_kib} KiB x{n} (no concat): {dt*1e3:9.1f} ms  {total/(1<<30)/dt:8.3f} GiB/s")
+
+    err("--- real d2h: fresh output each call ---")
+    f = jax.jit(lambda x, s: x ^ s)
+    for mib in (1, 16, 64):
+        a = jax.device_put(rng.integers(0, 256, mib << 20, dtype=np.uint8))
+        seed = jax.device_put(np.uint8(7))
+        def fresh_fetch():
+            out = f(a, seed)  # fresh array, never fetched
+            return np.asarray(out)
+        dt = t(fresh_fetch, iters=3, warmup=1)
+        # subtract the compute+launch by timing without fetch
+        dt_nofetch = t(lambda: f(a, seed), iters=3, warmup=1)
+        err(f"d2h {mib:3d} MiB: total {dt*1e3:8.1f} ms, launch-only {dt_nofetch*1e3:8.1f} ms, fetch {max(dt-dt_nofetch,1e-9)*1e3:8.1f} ms  {mib/1024/max(dt-dt_nofetch,1e-9):8.3f} GiB/s")
+
+    err("--- launch floor vs output size (input 64 MiB resident) ---")
+    a = jax.device_put(rng.integers(0, 256, 64 << 20, dtype=np.uint8))
+    for out_mib, slc in ((64, 64 << 20), (16, 16 << 20), (1, 1 << 20)):
+        g = jax.jit(lambda x: x[:slc] ^ np.uint8(3))
+        dt = t(lambda: g(a), iters=5, warmup=2)
+        err(f"xor out={out_mib:3d} MiB: {dt*1e3:8.2f} ms")
+    h = jax.jit(lambda x: jnp.sum(x, dtype=jnp.int32))
+    dt = t(lambda: h(a), iters=5, warmup=2)
+    err(f"sum out=4B: {dt*1e3:8.2f} ms")
+    err("--- back-to-back async launches (8 xors then block) ---")
+    g = jax.jit(lambda x: x ^ np.uint8(3))
+    def burst():
+        outs = [g(a) for _ in range(8)]
+        for o in outs:
+            o.block_until_ready()
+    dt = t(burst, iters=3, warmup=1)
+    err(f"8 async xors (64 MiB): {dt*1e3:8.2f} ms total, {dt/8*1e3:8.2f} ms/launch")
+
+
+if __name__ == "__main__":
+    main()

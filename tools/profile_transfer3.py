@@ -1,0 +1,83 @@
+"""Does d2h parallelize? What does upload-only (compute-consumed) cost?"""
+
+from __future__ import annotations
+
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+err = lambda *a: print(*a, file=sys.stderr, flush=True)
+
+
+def t(fn, iters=3, warmup=1):
+    for _ in range(warmup):
+        fn()
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    rng = np.random.default_rng(0)
+    f = jax.jit(lambda x, s: x ^ s)
+
+    err("--- upload-only: device_put 64MiB + xor + fetch 4-byte sum ---")
+    a_host = rng.integers(0, 256, 64 << 20, dtype=np.uint8)
+    g = jax.jit(lambda x, s: jnp.sum(x ^ s, dtype=jnp.uint32))
+    seed = np.uint8(7)
+    def up_only():
+        d = jax.device_put(a_host)
+        return int(g(d, seed))
+    dt = t(up_only, iters=3, warmup=1)
+    err(f"upload+compute+tiny-fetch 64 MiB: {dt*1e3:8.1f} ms  {64/1024/dt:7.3f} GiB/s")
+
+    err("--- d2h parallel: 8 disjoint 8MiB outputs, N threads ---")
+    parts = [jax.device_put(rng.integers(0, 256, 8 << 20, dtype=np.uint8)) for _ in range(8)]
+    for p in parts:
+        p.block_until_ready()
+    counter = [0]
+    def fetch_all(nthreads):
+        counter[0] += 1
+        s = np.uint8(counter[0] & 0xFF)  # fresh outputs each call (defeat _value cache)
+        outs = [f(p, s) for p in parts]
+        if nthreads == 1:
+            for o in outs:
+                np.asarray(o)
+        else:
+            with ThreadPoolExecutor(nthreads) as ex:
+                list(ex.map(np.asarray, outs))
+    for n in (1, 2, 4, 8):
+        dt = t(lambda: fetch_all(n), iters=2, warmup=1)
+        err(f"fetch 64 MiB via 8x8MiB, {n} threads: {dt*1e3:8.1f} ms  {64/1024/dt:7.3f} GiB/s")
+
+    err("--- d2h small sizes (fresh each) ---")
+    base = jax.device_put(rng.integers(0, 256, 4 << 20, dtype=np.uint8))
+    for kib in (64, 256, 1024, 4096):
+        sl = jax.jit(lambda x, s: (x[: kib << 10] ^ s))
+        def fetch_one():
+            counter[0] += 1
+            return np.asarray(sl(base, np.uint8(counter[0] & 0xFF)))
+        dt = t(fetch_one, iters=3, warmup=1)
+        err(f"d2h {kib:5d} KiB: {dt*1e3:8.2f} ms  {kib/1024/1024/dt:7.3f} GiB/s")
+
+    err("--- jax.copy_to_host_async then asarray ---")
+    def fetch_async():
+        counter[0] += 1
+        s = np.uint8(counter[0] & 0xFF)
+        outs = [f(p, s) for p in parts]
+        for o in outs:
+            o.copy_to_host_async()
+        return [np.asarray(o) for o in outs]
+    dt = t(fetch_async, iters=2, warmup=1)
+    err(f"fetch 64 MiB copy_to_host_async: {dt*1e3:8.1f} ms  {64/1024/dt:7.3f} GiB/s")
+
+
+if __name__ == "__main__":
+    main()
