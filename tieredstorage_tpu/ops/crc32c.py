@@ -114,7 +114,9 @@ def _length_offset(length: int) -> int:
     return _vec32_to_int(state) ^ 0xFFFFFFFF
 
 
-_BIT_SHIFTS = jnp.arange(7, -1, -1, dtype=jnp.uint8)
+# numpy, not jnp: a module-level device array would initialize the JAX
+# backend (and dial the axon relay) at import time.
+_BIT_SHIFTS = np.arange(7, -1, -1, dtype=np.uint8)
 
 
 @functools.partial(jax.jit, static_argnames=("chunk_bytes", "levels"))

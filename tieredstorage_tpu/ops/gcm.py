@@ -7,10 +7,12 @@ same bytes as the host AES-GCM oracle:
 - CTR keystream: the block cipher (ops/aes.py) runs over all counter blocks
   of the whole batch at once; counter 1 yields the tag mask E(J0), counters
   2.. encrypt the data (NIST SP 800-38D).
-- GHASH: a log-tree reduction where level j multiplies by H^(2^j) via a
-  128x128 GF(2) bit matrix (ops/gf128.py), i.e. int8 matmuls mod 2 on the
-  MXU. Per-segment constants (AAD contribution, length block) fold into one
-  host-computed 128-bit vector.
+- GHASH: a grouped-power reduction where each level contracts 128 blocks at
+  once via one [B*G, 128*128] x [128*128, 128] GF(2) bit-matrix matmul on the
+  MXU (slot j carries H^(127-j); ops/gf128.py builds the stacked operands) —
+  log128(m) big matmuls instead of log2(m) pairwise tree levels. Per-segment
+  constants (AAD contribution, length block) fold into one host-computed
+  128-bit vector.
 
 Shapes are static per (chunk_bytes, batch); the TPU transform backend keys
 its jit cache on them.
@@ -42,12 +44,11 @@ class GcmContext:
     """Host-precomputed per-(key, aad, chunk_size) constants for the kernel."""
 
     round_keys: np.ndarray       # uint8[15,16]
-    level_mats: np.ndarray       # int8[levels,128,128] transposed mult matrices
+    agg_mats: tuple              # per-level int8[k*128,128] grouped operands
     final_mat: np.ndarray        # int8[128,128] transposed mult-by-H^2 matrix
     const_bits: np.ndarray       # uint8[128] = bits(T(A)*H^(mC+2) ^ L*H)
     chunk_bytes: int
     n_blocks: int                # ceil(chunk_bytes/16)
-    levels: int                  # log2 of padded block count
 
 
 @functools.lru_cache(maxsize=16)
@@ -65,9 +66,7 @@ def _context_cached(key: bytes, aad: bytes, chunk_bytes: int) -> GcmContext:
     round_keys, h = _derive_h(key)
 
     m_c = _ceil_div(chunk_bytes, 16)
-    levels = max(1, (m_c - 1).bit_length())  # tree over next pow2 >= m_c
-
-    level_mats = gf128.ghash_level_matrices(h, levels)
+    agg_mats = gf128.ghash_agg_matrices(h, m_c)
 
     # T(A) = sum_i A_i H^(mA-i) over the AAD blocks (zero-padded).
     aad_blocks = [aad[i : i + 16] for i in range(0, len(aad), 16)]
@@ -88,14 +87,11 @@ def _context_cached(key: bytes, aad: bytes, chunk_bytes: int) -> GcmContext:
 
     return GcmContext(
         round_keys=round_keys,
-        level_mats=np.ascontiguousarray(
-            level_mats.transpose(0, 2, 1).astype(np.int8)
-        ),
+        agg_mats=agg_mats,
         final_mat=np.ascontiguousarray(final_mat.T.astype(np.int8)),
         const_bits=gf128.int_to_bitvec(const),
         chunk_bytes=chunk_bytes,
         n_blocks=m_c,
-        levels=levels,
     )
 
 
@@ -109,13 +105,10 @@ def make_context(key: bytes, aad: bytes, chunk_bytes: int) -> GcmContext:
 
 # --- device-side helpers ---
 
-_BIT_SHIFTS = jnp.arange(7, -1, -1, dtype=jnp.uint8)
+# numpy, not jnp: a module-level device array would initialize the JAX
+# backend (and dial the axon relay) at import time.
+_BIT_SHIFTS = np.arange(7, -1, -1, dtype=np.uint8)
 
-
-def _bytes_to_bits(x: jnp.ndarray) -> jnp.ndarray:
-    """uint8[..., n] -> uint8[..., n*8], MSB-first per byte (GCM bit order)."""
-    bits = (x[..., None] >> _BIT_SHIFTS) & 1
-    return bits.reshape(x.shape[:-1] + (x.shape[-1] * 8,))
 
 def _bits_to_bytes(bits: jnp.ndarray) -> jnp.ndarray:
     b = bits.reshape(bits.shape[:-1] + (bits.shape[-1] // 8, 8)).astype(jnp.uint8)
@@ -123,37 +116,62 @@ def _bits_to_bytes(bits: jnp.ndarray) -> jnp.ndarray:
     return (b * weights).sum(axis=-1, dtype=jnp.uint32).astype(jnp.uint8)
 
 
-def _ghash_tree(bits: jnp.ndarray, level_mats: jnp.ndarray, levels: int) -> jnp.ndarray:
-    """bits uint8[B, m, 128] (m = 2^levels) -> T(C) bits uint8[B, 128]."""
-    for j in range(levels):
-        pairs = bits.reshape(bits.shape[0], -1, 2, 128)
-        left, right = pairs[:, :, 0, :], pairs[:, :, 1, :]
-        prod = (
+def _ghash_grouped(data_flat: jnp.ndarray, agg_mats: tuple) -> jnp.ndarray:
+    """data_flat uint8[B, m*16] -> T(C) = sum_i C_i H^(m-1-i), uint8[B, 128].
+
+    Level 1 contracts the 8 byte-bit planes of the raw bytes (minor dim stays
+    the full byte length — no tile-padded [.., 16, 8] bit tensor in HBM)
+    against the int8[8, k*16, 128] operand; levels >= 2 contract k 128-bit
+    node vectors at a time via [B*G, k*128] x [k*128, 128]. Each level
+    left-pads to a multiple of its group width (leading zero blocks are the
+    polynomial's identity). Same function the former pairwise tree computed,
+    in log128(m) MXU matmuls instead of log2(m) sequential levels
+    (gf128.ghash_agg_matrices)."""
+    batch = data_flat.shape[0]
+    w1 = agg_mats[0]
+    k1 = w1.shape[1] // 16
+    m = data_flat.shape[1] // 16
+    g = _ceil_div(m, k1)
+    pad_bytes = (g * k1 - m) * 16
+    if pad_bytes:
+        data_flat = jnp.concatenate(
+            [jnp.zeros((batch, pad_bytes), jnp.uint8), data_flat], axis=1
+        )
+    planes = jnp.stack(
+        [(data_flat >> np.uint8(kbit)) & np.uint8(1) for kbit in range(8)]
+    ).astype(jnp.int8)
+    x = (
+        jax.lax.dot_general(
+            planes.reshape(8, batch * g, k1 * 16),
+            w1,
+            (((0, 2), (0, 1)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+        & 1
+    ).astype(jnp.int8).reshape(batch, g, 128)
+    for w in agg_mats[1:]:
+        k = w.shape[0] // 128
+        m = x.shape[1]
+        g = _ceil_div(m, k)
+        pad = g * k - m
+        if pad:
+            x = jnp.concatenate([jnp.zeros((batch, pad, 128), jnp.int8), x], axis=1)
+        x = (
             jax.lax.dot_general(
-                left.astype(jnp.int8),
-                level_mats[j],
-                (((2,), (0,)), ((), ())),
+                x.reshape(batch * g, k * 128), w, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.int32,
             )
             & 1
-        ).astype(jnp.uint8)
-        bits = prod ^ right
-    return bits[:, 0, :]
+        ).astype(jnp.int8).reshape(batch, g, 128)
+    return x[:, 0, :].astype(jnp.uint8)
 
 
 def _ghash_of_ct(
-    ct_padded: jnp.ndarray, ctx_levels: int, n_blocks: int,
-    level_mats: jnp.ndarray, final_mat: jnp.ndarray, const_bits: jnp.ndarray,
+    ct_padded: jnp.ndarray,
+    agg_mats: tuple, final_mat: jnp.ndarray, const_bits: jnp.ndarray,
 ) -> jnp.ndarray:
-    """ct_padded uint8[B, n_blocks*16] (tail already zeroed) -> GHASH bits [B,128]."""
-    batch = ct_padded.shape[0]
-    blocks_bits = _bytes_to_bits(ct_padded.reshape(batch, n_blocks, 16))
-    m_pow2 = 1 << ctx_levels
-    if m_pow2 > n_blocks:
-        # Left-pad with zero blocks: leading zeros don't change the polynomial.
-        pad = jnp.zeros((batch, m_pow2 - n_blocks, 128), jnp.uint8)
-        blocks_bits = jnp.concatenate([pad, blocks_bits], axis=1)
-    t_c = _ghash_tree(blocks_bits, level_mats, ctx_levels)
+    """ct_padded uint8[B, m*16] (tail already zeroed) -> GHASH bits [B,128]."""
+    t_c = _ghash_grouped(ct_padded, agg_mats)
     ghash = (
         jax.lax.dot_general(
             t_c.astype(jnp.int8), final_mat, (((1,), (0,)), ((), ())),
@@ -165,19 +183,18 @@ def _ghash_of_ct(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("chunk_bytes", "n_blocks", "levels", "decrypt")
+    jax.jit, static_argnames=("chunk_bytes", "n_blocks", "decrypt")
 )
 def _gcm_process_batch(
     round_keys: jnp.ndarray,
     ivs: jnp.ndarray,
     data: jnp.ndarray,
-    level_mats: jnp.ndarray,
+    agg_mats: tuple,
     final_mat: jnp.ndarray,
     const_bits: jnp.ndarray,
     *,
     chunk_bytes: int,
     n_blocks: int,
-    levels: int,
     decrypt: bool,
 ):
     """Shared encrypt/decrypt core. data uint8[B, chunk_bytes].
@@ -200,7 +217,7 @@ def _gcm_process_batch(
         ct_padded = jnp.zeros((batch, padded_len), jnp.uint8).at[:, :chunk_bytes].set(ct)
     else:
         ct_padded = ct
-    ghash = _ghash_of_ct(ct_padded, levels, n_blocks, level_mats, final_mat, const_bits)
+    ghash = _ghash_of_ct(ct_padded, agg_mats, final_mat, const_bits)
     tags = _bits_to_bytes(ghash) ^ tag_mask
     return output, tags
 
@@ -220,7 +237,7 @@ def _device_consts(ctx) -> tuple:
     if isinstance(ctx, GcmContext):
         consts = (
             jnp.asarray(ctx.round_keys),
-            jnp.asarray(ctx.level_mats),
+            tuple(jnp.asarray(m) for m in ctx.agg_mats),
             jnp.asarray(ctx.final_mat),
             jnp.asarray(ctx.const_bits),
         )
@@ -228,7 +245,7 @@ def _device_consts(ctx) -> tuple:
         consts = (
             jnp.asarray(ctx.round_keys),
             jnp.asarray(ctx.aad_blocks),
-            jnp.asarray(ctx.level_mats),
+            tuple(jnp.asarray(m) for m in ctx.agg_mats),
             jnp.asarray(ctx.h_mat),
         )
     _DEVICE_CONSTS[ctx] = consts
@@ -238,17 +255,16 @@ def _device_consts(ctx) -> tuple:
 def gcm_encrypt_chunks(ctx: GcmContext, ivs: np.ndarray, plaintext: np.ndarray):
     """plaintext uint8[B, ctx.chunk_bytes], ivs uint8[B,12] ->
     (ciphertext uint8[B, chunk_bytes], tags uint8[B,16])."""
-    round_keys, level_mats, final_mat, const_bits = _device_consts(ctx)
+    round_keys, agg_mats, final_mat, const_bits = _device_consts(ctx)
     ct, tags = _gcm_process_batch(
         round_keys,
         jnp.asarray(ivs, dtype=jnp.uint8),
         jnp.asarray(plaintext, dtype=jnp.uint8),
-        level_mats,
+        agg_mats,
         final_mat,
         const_bits,
         chunk_bytes=ctx.chunk_bytes,
         n_blocks=ctx.n_blocks,
-        levels=ctx.levels,
         decrypt=False,
     )
     return ct, tags
@@ -268,13 +284,12 @@ def gcm_encrypt_chunks(ctx: GcmContext, ivs: np.ndarray, plaintext: np.ndarray):
 class GcmVarlenContext:
     round_keys: np.ndarray   # uint8[15,16]
     aad_blocks: np.ndarray   # uint8[m_A,16] zero-padded AAD blocks
-    level_mats: np.ndarray   # int8[levels,128,128] (transposed)
+    agg_mats: tuple          # per-level int8[k*128,128] grouped operands
     h_mat: np.ndarray        # int8[128,128] transposed mult-by-H matrix
     aad_bit_len: int
     max_bytes: int
     m_max: int               # max data blocks
-    m_cap: int               # padded sequence slots (power of two)
-    levels: int
+    m_cap: int               # sequence slots (AAD + data + length block)
 
 
 @functools.lru_cache(maxsize=64)
@@ -283,22 +298,18 @@ def _varlen_context_cached(key: bytes, aad: bytes, max_bytes: int) -> GcmVarlenC
     m_max = _ceil_div(max_bytes, 16)
     m_a = _ceil_div(len(aad), 16)
     seq_len = m_a + m_max + 1
-    levels = max(1, (seq_len - 1).bit_length())
     aad_padded = np.frombuffer(
         aad + b"\x00" * (m_a * 16 - len(aad)), dtype=np.uint8
     ).reshape(m_a, 16) if m_a else np.zeros((0, 16), np.uint8)
     return GcmVarlenContext(
         round_keys=round_keys,
         aad_blocks=aad_padded,
-        level_mats=np.ascontiguousarray(
-            gf128.ghash_level_matrices(h, levels).transpose(0, 2, 1).astype(np.int8)
-        ),
+        agg_mats=gf128.ghash_agg_matrices(h, seq_len),
         h_mat=np.ascontiguousarray(gf128.mult_matrix(h).T.astype(np.int8)),
         aad_bit_len=len(aad) * 8,
         max_bytes=max_bytes,
         m_max=m_max,
-        m_cap=1 << levels,
-        levels=levels,
+        m_cap=seq_len,
     )
 
 
@@ -325,11 +336,11 @@ def make_varlen_context(key: bytes, aad: bytes, max_bytes: int) -> GcmVarlenCont
 
 
 @functools.partial(
-    jax.jit, static_argnames=("max_bytes", "m_max", "m_a", "m_cap", "levels", "decrypt")
+    jax.jit, static_argnames=("max_bytes", "m_max", "m_a", "m_cap", "decrypt")
 )
 def _gcm_varlen_batch(
-    round_keys, ivs, data, lengths, len_blocks, aad_blocks, level_mats, h_mat,
-    *, max_bytes: int, m_max: int, m_a: int, m_cap: int, levels: int, decrypt: bool,
+    round_keys, ivs, data, lengths, len_blocks, aad_blocks, agg_mats, h_mat,
+    *, max_bytes: int, m_max: int, m_a: int, m_cap: int, decrypt: bool,
 ):
     """data uint8[B, max_bytes] left-aligned (zero tail), lengths int32[B],
     len_blocks uint8[B,16] (host-built GCM length blocks).
@@ -368,8 +379,7 @@ def _gcm_varlen_batch(
     idx = (jnp.arange(m_cap, dtype=jnp.int32)[None, :] - shift[:, None]) % m_cap
     seq = jnp.take_along_axis(seq, idx[:, :, None], axis=1)
 
-    bits = _bytes_to_bits(seq)
-    t = _ghash_tree(bits, level_mats, levels)
+    t = _ghash_grouped(seq.reshape(batch, -1), agg_mats)
     ghash = (
         jax.lax.dot_general(
             t.astype(jnp.int8), h_mat, (((1,), (0,)), ((), ())),
@@ -397,7 +407,7 @@ def _host_len_blocks(ctx: GcmVarlenContext, lengths: np.ndarray) -> np.ndarray:
 
 def _run_varlen(ctx: GcmVarlenContext, ivs, data, lengths, decrypt: bool):
     lengths = np.asarray(lengths, dtype=np.int32)
-    round_keys, aad_blocks, level_mats, h_mat = _device_consts(ctx)
+    round_keys, aad_blocks, agg_mats, h_mat = _device_consts(ctx)
     return _gcm_varlen_batch(
         round_keys,
         jnp.asarray(ivs, dtype=jnp.uint8),
@@ -405,13 +415,12 @@ def _run_varlen(ctx: GcmVarlenContext, ivs, data, lengths, decrypt: bool):
         jnp.asarray(lengths),
         jnp.asarray(_host_len_blocks(ctx, lengths)),
         aad_blocks,
-        level_mats,
+        agg_mats,
         h_mat,
         max_bytes=ctx.max_bytes,
         m_max=ctx.m_max,
         m_a=ctx.aad_blocks.shape[0],
         m_cap=ctx.m_cap,
-        levels=ctx.levels,
         decrypt=decrypt,
     )
 
@@ -432,17 +441,16 @@ def gcm_decrypt_chunks(ctx: GcmContext, ivs: np.ndarray, ciphertext: np.ndarray)
     The caller compares expected_tags against the received tags (constant-time
     comparison is not required server-side here, but verification is
     mandatory — the TPU transform backend raises on mismatch)."""
-    round_keys, level_mats, final_mat, const_bits = _device_consts(ctx)
+    round_keys, agg_mats, final_mat, const_bits = _device_consts(ctx)
     return _gcm_process_batch(
         round_keys,
         jnp.asarray(ivs, dtype=jnp.uint8),
         jnp.asarray(ciphertext, dtype=jnp.uint8),
-        level_mats,
+        agg_mats,
         final_mat,
         const_bits,
         chunk_bytes=ctx.chunk_bytes,
         n_blocks=ctx.n_blocks,
-        levels=ctx.levels,
         decrypt=True,
     )
 

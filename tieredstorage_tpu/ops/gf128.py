@@ -2,9 +2,10 @@
 
 GHASH multiplication by a FIXED field element C is linear over GF(2), so it
 is exactly a 128x128 bit-matrix apply. The device-side GHASH reduction
-(ops/gcm.py) is a log-tree whose level-j combine multiplies by H^(2^j); this
-module builds those matrices (one per level, per segment key) so the entire
-reduction becomes int8 matmuls (mod 2) on the MXU — no carryless-multiply
+(ops/gcm.py) is a grouped-power contraction — each level multiplies up to
+128 slots by precomputed powers of H in one MXU matmul; this module builds
+the stacked per-level operands (ghash_agg_matrices, per segment key) so the
+entire reduction becomes int8 matmuls (mod 2) — no carryless-multiply
 instruction needed, which TPUs don't have.
 
 Conventions: a field element is a 128-bit Python int whose bit i (from the
@@ -88,18 +89,60 @@ def mult_matrix(c: int) -> np.ndarray:
     return m
 
 
-def ghash_level_matrices(h: int, levels: int) -> np.ndarray:
-    """uint8[levels,128,128]: level j's combine matrix = mult by H^(2^j).
+def ghash_agg_plan(m: int, max_k: int = 128) -> list[tuple[int, int]]:
+    """Level plan for grouped GHASH aggregation over m blocks.
 
-    Level 0 pairs single blocks (L*H^1 ^ R), level 1 pairs 2-block nodes
-    (L*H^2 ^ R), etc. H^(2^(j+1)) is the square of H^(2^j).
-    """
-    mats = np.zeros((levels, 128, 128), dtype=np.uint8)
-    c = h
-    for j in range(levels):
-        mats[j] = mult_matrix(c)
-        c = gcm_mult(c, c)
-    return mats
+    Returns [(k, padded_count), ...] per level: each level left-pads the
+    current block count to a multiple of k (leading zero blocks don't change
+    the polynomial) and contracts k slots at a time until one remains. With
+    max_k=128 the contraction is a [B*G, k*128] x [k*128, 128] int8 matmul —
+    one MXU-sized kernel per level instead of the former log2(m) sequential
+    pairwise tree levels (PROFILE.md round-3 consequence 2)."""
+    plan = []
+    cur = max(1, m)
+    while cur > 1:
+        k = min(max_k, cur)
+        padded = -(-cur // k) * k
+        plan.append((k, padded))
+        cur = padded // k
+    if not plan:
+        plan.append((1, 1))
+    return plan
+
+
+def ghash_agg_matrices(h: int, m: int, max_k: int = 128) -> tuple[np.ndarray, ...]:
+    """Per-level grouped-GHASH operands; composed they give
+    T(C) = sum_i C_i * H^(m-1-i) — exactly what the former pairwise tree
+    computed, so the surrounding final-mat/const folding is unchanged.
+
+    Level 1 is int8[8, k_1*16, 128], contracted against the 8 BYTE-bit planes
+    of the raw chunk bytes (plane kbit = (bytes >> kbit) & 1): entry
+    [kbit, s*16+p, o] is the o-th output bit's coefficient for block-slot s,
+    byte p, byte-bit kbit (GCM bit index p*8 + 7 - kbit). This keeps every
+    device intermediate's minor dimension large — a [B, m, 128]-bit layout
+    would tile-pad its [.., 16, 8] expansion 16x in HBM (the round-3 OOM).
+
+    Levels >= 2 are int8[k_L*128, 128]: out = bits[g, :] @ W_L (mod 2), slot
+    j carrying P_L^(k_L-1-j), P_1 = H, P_{L+1} = P_L^(k_L)."""
+    mats = []
+    p = h
+    for lvl, (k, _padded) in enumerate(ghash_agg_plan(m, max_k)):
+        acc = 1 << 127  # multiplicative identity
+        powers = [None] * k
+        for j in range(k - 1, -1, -1):
+            powers[j] = acc
+            acc = gcm_mult(acc, p)
+        w = np.concatenate(
+            [mult_matrix(x).T.astype(np.int8) for x in powers], axis=0
+        )
+        if lvl == 0:
+            w4 = w.reshape(k, 16, 8, 128)  # [slot, byte, bitpos, out]
+            w = np.stack(
+                [w4[:, :, 7 - kbit, :].reshape(k * 16, 128) for kbit in range(8)]
+            )
+        mats.append(np.ascontiguousarray(w))
+        p = gcm_pow(p, k)
+    return tuple(mats)
 
 
 def ghash_reference(h: int, blocks: list[bytes]) -> int:

@@ -73,7 +73,7 @@ def main():
     full = jax.jit(
         lambda rks, iv, d: gcm._gcm_process_batch(
             rks, iv, d, lm, fm, cb,
-            chunk_bytes=chunk_bytes, n_blocks=n_blocks, levels=ctx.levels,
+            chunk_bytes=chunk_bytes, n_blocks=n_blocks,
             decrypt=False,
         )
     )
@@ -100,33 +100,13 @@ def main():
     results["aes_circuit_only"] = t
     err(f"aes circuit only:    {t*1e3:9.1f} ms  {gib/t:8.2f} GiB/s")
 
-    # 3. GHASH alone (bit expansion + tree + final)
+    # 3. GHASH alone (grouped byte-plane matmuls + final)
     ghash_fn = jax.jit(
-        lambda ct: gcm._ghash_of_ct(ct, ctx.levels, n_blocks, lm, fm, cb)
+        lambda ct: gcm._ghash_of_ct(ct, lm, fm, cb)
     )
     t, _ = timeit(ghash_fn, data_dev)
     results["ghash"] = t
-    err(f"ghash (expand+tree): {t*1e3:9.1f} ms  {gib/t:8.2f} GiB/s")
-
-    # 3a. bit expansion alone
-    exp_fn = jax.jit(
-        lambda d: gcm._bytes_to_bits(d.reshape(batch, n_blocks, 16))
-    )
-    t, _ = timeit(exp_fn, data_dev)
-    results["bit_expand"] = t
-    err(f"bit expand alone:    {t*1e3:9.1f} ms  {gib/t:8.2f} GiB/s")
-
-    # 3b. tree alone on pre-expanded bits
-    bits = exp_fn(data_dev)
-    m_pow2 = 1 << ctx.levels
-    if m_pow2 > n_blocks:
-        pad = jnp.zeros((batch, m_pow2 - n_blocks, 128), jnp.uint8)
-        bits = jnp.concatenate([pad, bits], axis=1)
-    bits = jax.block_until_ready(bits)
-    tree_fn = jax.jit(lambda b: gcm._ghash_tree(b, lm, ctx.levels))
-    t, _ = timeit(tree_fn, bits)
-    results["ghash_tree_only"] = t
-    err(f"ghash tree only:     {t*1e3:9.1f} ms  {gib/t:8.2f} GiB/s")
+    err(f"ghash (grouped):     {t*1e3:9.1f} ms  {gib/t:8.2f} GiB/s")
 
     # 4. xor with precomputed keystream (pure elementwise baseline)
     ks = jax.block_until_ready(ks_fn(rk, ivs_dev))

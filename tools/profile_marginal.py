@@ -52,7 +52,7 @@ def run(total_mib: int, chunk_mib: int = 4):
     out = {}
     full = jax.jit(lambda r, i, d: gcm._gcm_process_batch(
         r, i, d, lm, fm, cb, chunk_bytes=chunk_bytes, n_blocks=n_blocks,
-        levels=ctx.levels, decrypt=False))
+        decrypt=False))
     out["full"] = t(full, rk, ivs, data)
     ks_fn = jax.jit(lambda r, i: ctr_keystream_batch(r, i, 1, n_blocks + 1))
     out["ctr"] = t(ks_fn, rk, ivs)
@@ -62,7 +62,7 @@ def run(total_mib: int, chunk_mib: int = 4):
     rkp = rk_planes_from_round_keys(rk)
     circ = jax.jit(aes_encrypt_planes)
     out["circuit"] = t(circ, rkp, planes)
-    gh = jax.jit(lambda d: gcm._ghash_of_ct(d, ctx.levels, n_blocks, lm, fm, cb))
+    gh = jax.jit(lambda d: gcm._ghash_of_ct(d, n_blocks, lm, fm, cb))
     out["ghash"] = t(gh, data)
     return out
 
