@@ -1,0 +1,115 @@
+"""The fused Pallas AES kernel must be bit-exact vs the XLA circuit.
+
+The kernel body (ShiftRows-fused slicing, stacked S-box, MixColumns variable
+wiring, SMEM round-key XORs) is verified on every run by tracing it with
+plain-array stand-ins for the refs — identical math, no Mosaic/interpreter in
+the loop. The full `pallas_call` plumbing (grid, BlockSpecs, SMEM) runs under
+Mosaic's interpreter only when TIEREDSTORAGE_SLOW_TESTS=1: XLA-CPU takes ~8
+minutes to compile the interpreted kernel (the real-TPU Mosaic compile is
+what bench.py exercises).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tieredstorage_tpu.ops import aes_pallas
+from tieredstorage_tpu.ops.aes_bitsliced import (
+    aes_encrypt_planes,
+    ctr_keystream_batch,
+    make_rk_planes,
+)
+
+KEY = bytes(range(32))
+
+
+class _ArrayRef:
+    """Read-only stand-in for a Pallas ref backed by a traced array."""
+
+    def __init__(self, arr):
+        self._arr = arr
+
+    def __getitem__(self, idx):
+        return self._arr[idx]
+
+
+class _CollectRef:
+    """Write-only stand-in collecting kernel outputs."""
+
+    def __init__(self):
+        self.out = {}
+
+    def __setitem__(self, idx, val):
+        self.out[idx] = val
+
+
+def _run_kernel_body(rk2d, st4):
+    out_ref = _CollectRef()
+    aes_pallas._aes_kernel(_ArrayRef(rk2d), _ArrayRef(st4), out_ref)
+    rows = [
+        jnp.stack([out_ref.out[(p, b)] for b in range(8)], axis=0) for p in range(16)
+    ]
+    return jnp.stack(rows, axis=0)
+
+
+def test_kernel_body_matches_xla_circuit():
+    rng = np.random.default_rng(1)
+    rk = jnp.asarray(make_rk_planes(KEY))
+    w = aes_pallas.WORDS_PER_STEP
+    state = jnp.asarray(rng.integers(0, 2**32, (16, 8, w), dtype=np.uint32))
+
+    expected = np.asarray(jax.jit(aes_encrypt_planes)(rk, state))
+    # Eager on purpose: XLA-CPU takes minutes to compile the 10k-op body as
+    # one graph, but executes it op-by-op in ~1 s.
+    got = np.asarray(
+        _run_kernel_body(rk.reshape(15, 128), state.reshape(16, 8, aes_pallas.R, 128))
+    ).reshape(16, 8, w)
+    np.testing.assert_array_equal(got, expected)
+
+
+def test_kernel_body_multi_step_tiling():
+    """Two grid steps' worth of words, each evaluated independently."""
+    rng = np.random.default_rng(2)
+    rk = jnp.asarray(make_rk_planes(KEY))
+    w = aes_pallas.WORDS_PER_STEP
+    state = jnp.asarray(rng.integers(0, 2**32, (16, 8, 2 * w), dtype=np.uint32))
+    expected = np.asarray(jax.jit(aes_encrypt_planes)(rk, state))
+    for step in range(2):
+        sl = state[:, :, step * w : (step + 1) * w]
+        got = np.asarray(
+            _run_kernel_body(rk.reshape(15, 128), sl.reshape(16, 8, aes_pallas.R, 128))
+        ).reshape(16, 8, w)
+        np.testing.assert_array_equal(got, expected[:, :, step * w : (step + 1) * w])
+
+
+@pytest.mark.skipif(
+    os.environ.get("TIEREDSTORAGE_SLOW_TESTS") != "1",
+    reason="interpret-mode Mosaic kernel takes ~8 min to compile on XLA-CPU",
+)
+def test_pallas_call_interpret_end_to_end():
+    rng = np.random.default_rng(3)
+    rk = jnp.asarray(make_rk_planes(KEY))
+    w = aes_pallas.WORDS_PER_STEP
+    state = jnp.asarray(rng.integers(0, 2**32, (16, 8, w), dtype=np.uint32))
+    expected = np.asarray(jax.jit(aes_encrypt_planes)(rk, state))
+    got = np.asarray(aes_pallas.aes_encrypt_planes_pallas(rk, state, interpret=True))
+    np.testing.assert_array_equal(got, expected)
+
+
+def test_keystream_pallas_gate_defaults_off_on_cpu(monkeypatch):
+    """On the CPU backend the XLA circuit is used unless explicitly forced."""
+    monkeypatch.delenv("TIEREDSTORAGE_TPU_PALLAS", raising=False)
+    from tieredstorage_tpu.ops.aes_bitsliced import _use_pallas_circuit
+
+    assert jax.default_backend() == "cpu"
+    assert not _use_pallas_circuit(1 << 20)
+    monkeypatch.setenv("TIEREDSTORAGE_TPU_PALLAS", "1")
+    assert _use_pallas_circuit(8)
+    monkeypatch.setenv("TIEREDSTORAGE_TPU_PALLAS", "0")
+    assert not _use_pallas_circuit(1 << 20)

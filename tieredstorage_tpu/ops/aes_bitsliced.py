@@ -346,6 +346,28 @@ def rk_planes_from_round_keys(round_keys: jnp.ndarray) -> jnp.ndarray:
     return bits * jnp.uint32(0xFFFFFFFF)
 
 
+def _use_pallas_circuit(n_words: int) -> bool:
+    """Route the cipher through the fused Pallas kernel on real TPUs.
+
+    The XLA lowering of the circuit round-trips every gate through HBM
+    (0.66 GiB/s measured, PROFILE.md); the Pallas kernel keeps the planes in
+    VMEM. CPU (tests, virtual meshes) keeps the XLA path — Mosaic interpret
+    mode is orders slower to compile there. TIEREDSTORAGE_TPU_PALLAS=0/1
+    overrides the gate, but is read at trace time: set it before the first
+    call for a given (batch, chunk) shape, or the cached executable wins."""
+    import os
+
+    forced = os.environ.get("TIEREDSTORAGE_TPU_PALLAS")
+    if forced is not None:
+        return forced not in ("0", "false", "off")
+    if n_words < 1024:  # one kernel step; smaller batches aren't worth a pad
+        return False
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
 def ctr_keystream_batch(
     round_keys: jnp.ndarray, ivs: jnp.ndarray, first_counter: int, n_blocks: int
 ) -> jnp.ndarray:
@@ -355,8 +377,8 @@ def ctr_keystream_batch(
     blocks are packed into its own span of words (n_blocks rounded up to a
     multiple of 32), with that chunk's IV planes broadcast across its span.
     Replaces the vmapped per-chunk table cipher (gather-bound) with pure
-    XOR/AND on uint32 lanes.
-    """
+    XOR/AND on uint32 lanes. On TPU the boolean circuit itself runs as the
+    fused Pallas kernel (ops/aes_pallas.py)."""
     rk_planes = rk_planes_from_round_keys(round_keys)
     batch = ivs.shape[0]
     w = (n_blocks + 31) // 32
@@ -385,7 +407,22 @@ def ctr_keystream_batch(
     )  # [B, 16, 8, w]
     # Fold batch into the word axis: [16, 8, B*w].
     state = state.transpose(1, 2, 0, 3).reshape(16, 8, batch * w)
-    out = aes_encrypt_planes(rk_planes, state)
+    n_words = batch * w
+    if _use_pallas_circuit(n_words):
+        from tieredstorage_tpu.ops.aes_pallas import (
+            WORDS_PER_STEP,
+            aes_encrypt_planes_pallas,
+        )
+
+        padded = -(-n_words // WORDS_PER_STEP) * WORDS_PER_STEP
+        if padded != n_words:
+            state = jnp.pad(state, ((0, 0), (0, 0), (0, padded - n_words)))
+        # interpret on CPU lets the forced path run (slowly) off-TPU.
+        out = aes_encrypt_planes_pallas(
+            rk_planes, state, interpret=jax.default_backend() == "cpu"
+        )[:, :, :n_words]
+    else:
+        out = aes_encrypt_planes(rk_planes, state)
     # Unpack to bytes: [16, 8, B, w] → [B, w*32, 16].
     out = out.reshape(16, 8, batch, w)
     j = jnp.arange(32, dtype=jnp.uint32)

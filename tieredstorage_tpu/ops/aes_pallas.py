@@ -1,0 +1,130 @@
+"""Pallas TPU kernel for the bitsliced AES-256 boolean circuit.
+
+The XLA lowering of the ~2000-gate tower-field circuit (ops/aes_bitsliced.py)
+round-trips every gate's uint32[16, 8, W] operand through HBM — measured at
+0.66 GiB/s of keystream on a v5e (PROFILE.md). This kernel evaluates the whole
+circuit per 512 KiB tile inside VMEM: the 128 bit-planes live as (R, 128)
+uint32 vregs, ShiftRows is pure Python-level variable relabeling at trace
+time, MixColumns is relabeling plus XORs, and only the initial/final state
+touches HBM (2 bytes moved per keystream byte).
+
+Wiring notes (replaces the reference's per-chunk JDK `AES/GCM/NoPadding`
+cipher, core/.../transform/EncryptionChunkEnumeration.java:66-81):
+- SubBytes reuses the derived tower-field circuit (`_sbox_planes`), applied
+  once per round on all 16 byte positions stacked along sublanes (16R, 128).
+- MixColumns per column: out[r] = xtime(a ^ c) ^ a ^ (a^c^d^e), with xtime a
+  bit-index rotation feeding bit 7 into bits {0,1,3,4} (poly 0x11B) — all
+  relabeling + XOR, no data movement.
+- Round keys are uint32 full-word masks in SMEM, XORed in as scalars.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tieredstorage_tpu.ops.aes import _NR, _SHIFT_ROWS
+from tieredstorage_tpu.ops.aes_bitsliced import _sbox_planes, _tower
+
+#: Sublane rows per plane per grid step: one (8, 128) uint32 vreg per plane,
+#: i.e. 1024 words = 32768 blocks = 512 KiB of keystream per step.
+R = 8
+WORDS_PER_STEP = R * 128
+
+
+def _xtime_planes(x: list) -> list:
+    """GF(2^8) multiply-by-x on 8 bit-planes (LSB-first bit index)."""
+    return [
+        x[7],
+        x[0] ^ x[7],
+        x[1],
+        x[2] ^ x[7],
+        x[3] ^ x[7],
+        x[4],
+        x[5],
+        x[6],
+    ]
+
+
+def _mix_columns_vars(st: list) -> list:
+    """MixColumns over 16 position-vars of 8 planes each (pos = col*4 + row)."""
+    out = [None] * 16
+    for col in range(4):
+        idx = [col * 4 + r for r in range(4)]
+        all4 = [
+            st[idx[0]][b] ^ st[idx[1]][b] ^ st[idx[2]][b] ^ st[idx[3]][b]
+            for b in range(8)
+        ]
+        for r in range(4):
+            a = st[idx[r]]
+            c = st[idx[(r + 1) % 4]]
+            xt = _xtime_planes([a[b] ^ c[b] for b in range(8)])
+            out[idx[r]] = [xt[b] ^ a[b] ^ all4[b] for b in range(8)]
+    return out
+
+
+def _aes_kernel(rk_ref, in_ref, out_ref):
+    """rk_ref: SMEM uint32[15, 128] round-key masks ([rnd, pos*8 + bit]);
+    in_ref/out_ref: VMEM uint32[16, 8, R, 128] plane tiles."""
+    tw = _tower()
+    st = [
+        [in_ref[p, b] ^ rk_ref[0, p * 8 + b] for b in range(8)] for p in range(16)
+    ]
+    for rnd in range(1, _NR + 1):
+        # SubBytes: all 16 positions stacked along sublanes, one circuit pass.
+        big = [
+            jnp.concatenate([st[p][b] for p in range(16)], axis=0) for b in range(8)
+        ]
+        big = _sbox_planes(tw, big)
+        # Un-stack with ShiftRows fused into the slice index.
+        st = [
+            [
+                jax.lax.slice_in_dim(
+                    big[b], _SHIFT_ROWS[p] * R, (_SHIFT_ROWS[p] + 1) * R, axis=0
+                )
+                for b in range(8)
+            ]
+            for p in range(16)
+        ]
+        if rnd != _NR:
+            st = _mix_columns_vars(st)
+        st = [
+            [st[p][b] ^ rk_ref[rnd, p * 8 + b] for b in range(8)] for p in range(16)
+        ]
+    for p in range(16):
+        for b in range(8):
+            out_ref[p, b] = st[p][b]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def aes_encrypt_planes_pallas(
+    rk_planes: jnp.ndarray, state: jnp.ndarray, *, interpret: bool = False
+) -> jnp.ndarray:
+    """Encrypt a bitsliced state uint32[16, 8, W] with AES-256 in one kernel.
+
+    Drop-in for `aes_bitsliced.aes_encrypt_planes`; W must be a multiple of
+    WORDS_PER_STEP (callers zero-pad and slice). `interpret=True` runs the
+    kernel op-by-op on CPU for tests."""
+    w = state.shape[2]
+    if w % WORDS_PER_STEP:
+        raise ValueError(f"W={w} not a multiple of {WORDS_PER_STEP}")
+    steps = w // WORDS_PER_STEP
+    st4 = state.reshape(16, 8, steps * R, 128)
+    rk = rk_planes.reshape(_NR + 1, 128)
+    out = pl.pallas_call(
+        _aes_kernel,
+        grid=(steps,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((16, 8, R, 128), lambda s: (0, 0, s, 0)),
+        ],
+        out_specs=pl.BlockSpec((16, 8, R, 128), lambda s: (0, 0, s, 0)),
+        out_shape=jax.ShapeDtypeStruct((16, 8, steps * R, 128), jnp.uint32),
+        interpret=interpret,
+    )(rk, st4)
+    return out.reshape(16, 8, w)

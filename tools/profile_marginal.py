@@ -62,7 +62,7 @@ def run(total_mib: int, chunk_mib: int = 4):
     rkp = rk_planes_from_round_keys(rk)
     circ = jax.jit(aes_encrypt_planes)
     out["circuit"] = t(circ, rkp, planes)
-    gh = jax.jit(lambda d: gcm._ghash_of_ct(d, n_blocks, lm, fm, cb))
+    gh = jax.jit(lambda d: gcm._ghash_of_ct(d, lm, fm, cb))
     out["ghash"] = t(gh, data)
     return out
 
