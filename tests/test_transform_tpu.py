@@ -186,6 +186,43 @@ class TestPipelinedWindows:
         assert [len(r) for r in results] == [len(w) for w in windows]
         assert [c for r in results for c in r] == expected
 
+    def test_pipeline_keeps_depth_windows_in_flight(self, key_pair, monkeypatch):
+        """Structural overlap check: window N's blocking finish must happen
+        only after window N+depth has been dispatched — i.e. the generator
+        keeps `pipeline_depth` staged windows in flight behind the one being
+        compressed (upload ∥ compute ∥ download), rather than finishing each
+        window before staging the next."""
+        rng = random.Random(3)
+        all_chunks = [
+            bytes(rng.getrandbits(8) for _ in range(CHUNK)) for _ in range(6)
+        ]
+        opts = TransformOptions(
+            compression=False, encryption=key_pair, ivs=det_ivs(len(all_chunks))
+        )
+        tpu = TpuTransformBackend()
+        tpu.pipeline_depth = 2
+        events = []
+        real_dispatch = TpuTransformBackend._encrypt_dispatch
+        real_finish = TpuTransformBackend._encrypt_finish
+
+        def spy_dispatch(self, chunks, w_opts):
+            events.append("dispatch")
+            return real_dispatch(self, chunks, w_opts)
+
+        def spy_finish(self, staged):
+            events.append("finish")
+            return real_finish(self, staged)
+
+        monkeypatch.setattr(TpuTransformBackend, "_encrypt_dispatch", spy_dispatch)
+        monkeypatch.setattr(TpuTransformBackend, "_encrypt_finish", spy_finish)
+        windows = [all_chunks[i : i + 2] for i in range(0, 6, 2)]
+        out = [c for r in tpu.transform_windows(iter(windows), opts) for c in r]
+        assert len(out) == 6
+        # Depth 2: two dispatches before the first finish, one in flight after.
+        assert events == [
+            "dispatch", "dispatch", "dispatch", "finish", "finish", "finish",
+        ]
+
     def test_windowed_roundtrip_through_detransform(self, key_pair):
         rng = random.Random(11)
         all_chunks = [

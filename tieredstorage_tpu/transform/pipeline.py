@@ -125,8 +125,8 @@ class SegmentTransformation:
                 yield window
 
         got_any = False
-        # transform_windows lets device backends overlap host work on window
-        # N+1 with device work on window N (double-buffered staging).
+        # transform_windows lets device backends keep pipeline_depth windows
+        # in flight (host compress ∥ device encrypt ∥ download staging).
         for transformed in self._backend.transform_windows(windows(), self._opts):
             got_any = got_any or bool(transformed)
             expected = submitted.pop(0)

@@ -42,10 +42,11 @@ from tieredstorage_tpu.transform.api import (
 
 class TpuTransformBackend(TransformBackend):
     preferred_batch_chunks = 256
-    # Window byte cap: keeps one staged window (padded input + output +
-    # keystream intermediates) well inside a v5e's 16 GiB HBM while leaving
-    # room for the double-buffered window in flight behind it.
-    preferred_batch_bytes = 256 << 20
+    # Window byte cap: with pipeline_depth=3 up to 4 windows are in flight
+    # (compress k ∥ encrypt k-1..k-2 ∥ download k-3), each pinning padded
+    # input + ciphertext + keystream intermediates (~5x window bytes), so
+    # 64 MiB windows keep the steady state near ~1.3 GiB of a v5e's 16 GiB.
+    preferred_batch_bytes = 64 << 20
 
     def __init__(self, mesh=None):
         self._mesh = mesh
@@ -56,6 +57,8 @@ class TpuTransformBackend(TransformBackend):
             self.preferred_batch_chunks = int(configs["batch.chunks"])
         if "batch.bytes" in configs:
             self.preferred_batch_bytes = int(configs["batch.bytes"])
+        if "pipeline.depth" in configs:
+            self.pipeline_depth = max(1, int(configs["pipeline.depth"]))
         n = configs.get("mesh.devices")
         if n:
             self._mesh = data_mesh(int(n))
@@ -81,19 +84,27 @@ class TpuTransformBackend(TransformBackend):
             out = self._encrypt_finish(self._encrypt_dispatch(out, opts))
         return out
 
+    #: Staged windows kept in flight before blocking on the oldest: at depth
+    #: N the host compresses window k while the device encrypts k-1..k-N+1
+    #: and the relay streams k-N's ciphertext back — a 3-stage pipeline
+    #: (upload ∥ compute ∥ download) whose steady-state cost is
+    #: max(stage times), not their sum (PROFILE.md consequence 3).
+    pipeline_depth = 3
+
     def transform_windows(self, windows, opts: TransformOptions):
-        """Double-buffered staging (SURVEY §7 step 5): the device encrypts
-        window N while the host compresses window N+1. JAX dispatch is
-        async — `_encrypt_dispatch` returns un-materialized device arrays,
-        and only `_encrypt_finish` (one window later) blocks on them."""
+        """Pipelined staging (SURVEY §7 step 5): JAX dispatch is async —
+        `_encrypt_dispatch` returns un-materialized device arrays and starts
+        their device→host copies; `_encrypt_finish` (pipeline_depth windows
+        later) blocks on them."""
         if opts.encryption is None:
             # Compression-only is host-bound: nothing to overlap against.
             for window in windows:
                 yield self.transform(window, opts)
             return
+        import collections
         import dataclasses
 
-        pending = None
+        pending: "collections.deque" = collections.deque()
         iv_offset = 0
         for window in windows:
             chunks = list(window)
@@ -108,13 +119,14 @@ class TpuTransformBackend(TransformBackend):
             if opts.compression:
                 chunks = self._compress_batch(chunks, w_opts)
             staged = self._encrypt_dispatch(chunks, w_opts) if chunks else None
-            if pending is not None:
-                yield self._encrypt_finish(pending)
-            pending = staged
-            if staged is None:
-                yield []
-        if pending is not None:
-            yield self._encrypt_finish(pending)
+            pending.append(staged)
+            while len(pending) > max(1, self.pipeline_depth):
+                yield self._finish_or_empty(pending.popleft())
+        while pending:
+            yield self._finish_or_empty(pending.popleft())
+
+    def _finish_or_empty(self, staged) -> list[bytes]:
+        return [] if staged is None else self._encrypt_finish(staged)
 
     def _compress_batch(self, chunks: list[bytes], opts: TransformOptions) -> list[bytes]:
         if opts.compression_codec != ZSTD:
@@ -171,6 +183,13 @@ class TpuTransformBackend(TransformBackend):
             if pad:
                 lengths = np.concatenate([lengths, np.full(pad, 16, np.int32)])
             ct, tags = gcm_encrypt_varlen(ctx, ivs_padded, data, lengths)
+        # Start the device->host copies now so the relay streams this
+        # window's ciphertext back while later windows compute.
+        for arr in (ct, tags):
+            try:
+                arr.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                pass  # non-jax arrays (mocked backends) / platforms without it
         return ivs, sizes, ct, tags
 
     def _encrypt_finish(self, staged) -> list[bytes]:
