@@ -251,6 +251,27 @@ def run_bench() -> dict:
     extras["compression_only_gibs"] = round(gib / comp_s, 3)
     extras["compression_ratio"] = round(ratio, 3)
     _err(f"[bench] compression-only (host): {gib / comp_s:.3f} GiB/s, ratio {ratio:.3f}")
+
+    # Device codec (tpu-huff-v1): batched Huffman on-chip, incl transfers.
+    # Guarded: an experimental-codec failure must not zero the round's
+    # already-measured primary metrics.
+    try:
+        from tieredstorage_tpu.transform import thuff as thuff_codec
+
+        thuff_codec.compress_batch(chunks)  # warm jit at the timed shape
+        t0 = time.perf_counter()
+        tframes = thuff_codec.compress_batch(chunks)
+        thuff_s = time.perf_counter() - t0
+        tratio = sum(len(c) for c in tframes) / total_bytes
+        extras["thuff_compress_gibs"] = round(gib / thuff_s, 3)
+        extras["thuff_ratio"] = round(tratio, 3)
+        _err(
+            f"[bench] tpu-huff-v1 device codec (incl tunnel): "
+            f"{gib / thuff_s:.3f} GiB/s, ratio {tratio:.3f}"
+        )
+    except Exception as exc:
+        extras["thuff_error"] = f"{type(exc).__name__}: {exc}"
+        _err(f"[bench] tpu-huff-v1 codec failed: {extras['thuff_error']}")
     tpu.close()
 
     # 4. Host baselines: the reference's strictly sequential per-chunk chain,

@@ -1,0 +1,238 @@
+"""tpu-huff-v1 codec: tables, format, and device round-trips.
+
+The format is pinned by an independent pure-Python bit-walker decoder (no
+shared code with the device path): if the device encoder and the reference
+decoder agree, and the device decoder inverts the device encoder, the wire
+format is fixed on both sides.
+"""
+
+from __future__ import annotations
+
+import math
+import struct
+
+import numpy as np
+import pytest
+
+from tieredstorage_tpu.ops.huffman import JUMP_BLOCK
+from tieredstorage_tpu.transform import thuff
+from tieredstorage_tpu.transform.thuff import (
+    CODEC_ID,
+    ThuffFormatError,
+    canonical_tables,
+    compress_batch,
+    decompress_batch,
+    limited_huffman_lengths,
+)
+
+
+def _kraft(lengths) -> float:
+    return sum(2.0 ** -l for l in lengths if l > 0)
+
+
+class TestTables:
+    def test_kraft_complete_random_freqs(self):
+        rng = np.random.default_rng(0)
+        for _ in range(20):
+            freqs = rng.integers(0, 1000, 256)
+            if np.count_nonzero(freqs) < 2:
+                continue
+            lens = limited_huffman_lengths(freqs)
+            assert _kraft(lens) == pytest.approx(1.0)
+            assert lens.max() <= 15
+            assert np.all((lens > 0) == (freqs > 0))
+
+    def test_matches_unlimited_huffman_cost(self):
+        """With a flat-ish distribution the depth limit never binds, so the
+        package-merge cost must equal the classic Huffman cost."""
+        import heapq
+
+        rng = np.random.default_rng(1)
+        freqs = rng.integers(1, 500, 256)
+        lens = limited_huffman_lengths(freqs)
+        cost = int((lens * freqs).sum())
+
+        heap = [(int(f), i) for i, f in enumerate(freqs)]
+        heapq.heapify(heap)
+        huff_cost = 0
+        while len(heap) > 1:
+            a = heapq.heappop(heap)[0]
+            b = heapq.heappop(heap)[0]
+            huff_cost += a + b
+            heapq.heappush(heap, (a + b, -1))
+        assert cost == huff_cost
+
+    def test_limit_binds_on_fibonacci_freqs(self):
+        """Fibonacci frequencies force unlimited Huffman past depth 15; the
+        limited code must clamp to 15 and stay Kraft-complete."""
+        freqs = np.zeros(256, np.int64)
+        a, b = 1, 1
+        for i in range(24):
+            freqs[i] = a
+            a, b = b, a + b
+        lens = limited_huffman_lengths(freqs)
+        assert lens.max() == 15
+        assert _kraft(lens) == pytest.approx(1.0)
+
+    def test_single_symbol(self):
+        freqs = np.zeros(256, np.int64)
+        freqs[65] = 10
+        lens = limited_huffman_lengths(freqs)
+        assert lens[65] == 1 and lens.sum() == 1
+
+    def test_canonical_codes_prefix_free(self):
+        rng = np.random.default_rng(2)
+        freqs = rng.integers(0, 100, 256)
+        lens = limited_huffman_lengths(freqs)
+        _, first, counts, base, perm = canonical_tables(lens)
+        codes = {}
+        code = 0
+        prev = 0
+        for s in sorted(np.flatnonzero(lens), key=lambda s: (lens[s], s)):
+            code <<= int(lens[s]) - prev
+            prev = int(lens[s])
+            codes[s] = (code, prev)
+            code += 1
+        seen = set()
+        for s, (c, l) in codes.items():
+            bits = format(c, f"0{l}b")
+            for other, (c2, l2) in codes.items():
+                if other != s and l2 >= l:
+                    assert format(c2, f"0{l2}b")[:l] != bits or other == s
+            seen.add(bits)
+        assert len(seen) == len(codes)
+
+
+def _reference_decode(frame: bytes) -> bytes:
+    """Independent bit-walker decoder (MSB-first canonical)."""
+    magic, version, flags, orig_len = struct.unpack_from("<2sBBI", frame)
+    assert magic == b"TH" and version == 1
+    body = frame[8:]
+    if flags & 0x01:
+        return body[:orig_len]
+    bits, n_jump = struct.unpack_from("<IH", body)
+    lens = thuff._unpack_lengths(body[6 : 6 + 128])
+    off = 6 + 128 + 4 * n_jump
+    payload = body[off:]
+
+    # rebuild canonical codes
+    order = sorted(np.flatnonzero(lens), key=lambda s: (lens[s], s))
+    codes = {}
+    code = 0
+    prev = 0
+    for s in order:
+        code <<= int(lens[s]) - prev
+        prev = int(lens[s])
+        codes[(code, prev)] = int(s)
+        code += 1
+
+    def bit(i):
+        return (payload[i >> 3] >> (i & 7)) & 1
+
+    out = bytearray()
+    pos = 0
+    while len(out) < orig_len:
+        c, l = 0, 0
+        while (c, l) not in codes:
+            c = (c << 1) | bit(pos)
+            pos += 1
+            l += 1
+            assert l <= 15, "no code matched"
+        out.append(codes[(c, l)])
+    assert pos <= bits
+    return bytes(out)
+
+
+class TestRoundTrip:
+    @pytest.mark.parametrize(
+        "size", [1, 7, 100, 4095, 4096, 4097, 20_000]
+    )
+    def test_text_roundtrip_and_ratio(self, size):
+        rng = np.random.default_rng(size)
+        text = (b"offset=%08d key=user value=hello " * 700)[:size]
+        frames = compress_batch([text])
+        assert _reference_decode(frames[0]) == text
+        assert decompress_batch(frames)[0] == text
+        if size >= 4095:  # below ~1 KiB the 128 B table wins and RAW kicks in
+            assert len(frames[0]) < 0.75 * len(text)
+
+    def test_incompressible_goes_raw(self):
+        rng = np.random.default_rng(9)
+        noise = rng.integers(0, 256, 10_000, dtype=np.uint8).tobytes()
+        frames = compress_batch([noise])
+        assert frames[0][3] & 0x01  # RAW flag
+        assert len(frames[0]) == len(noise) + 8
+        assert decompress_batch(frames)[0] == noise
+
+    def test_mixed_batch(self):
+        rng = np.random.default_rng(3)
+        chunks = [
+            b"",
+            b"A",
+            b"A" * 5000,
+            rng.integers(0, 256, 3000, dtype=np.uint8).tobytes(),
+            (b"the quick brown fox " * 400),
+            bytes(rng.integers(0, 8, 9000, dtype=np.uint8)),
+        ]
+        frames = compress_batch(chunks)
+        back = decompress_batch(frames)
+        assert back == chunks
+        for f, c in zip(frames, chunks):
+            assert _reference_decode(f) == c
+
+    def test_single_symbol_chunk(self):
+        chunk = b"\x00" * 4097
+        frames = compress_batch([chunk])
+        assert decompress_batch(frames)[0] == chunk
+        assert len(frames[0]) < 800  # ~1 bit/symbol plus tables
+
+    def test_size_guard(self):
+        frames = compress_batch([b"hello world" * 100])
+        with pytest.raises(ThuffFormatError, match="exceeds chunk limit"):
+            decompress_batch(frames, max_original_chunk_size=10)
+
+    def test_corrupt_magic_rejected(self):
+        frames = compress_batch([b"data data data"])
+        bad = b"XX" + frames[0][2:]
+        with pytest.raises(ThuffFormatError, match="magic"):
+            decompress_batch([bad])
+
+    def test_truncated_payload_rejected(self):
+        frames = compress_batch([(b"abcd" * 5000)])
+        assert not frames[0][3] & 0x01
+        with pytest.raises(ThuffFormatError, match="truncated"):
+            decompress_batch([frames[0][:-40]])
+
+    def test_overdeclared_bits_rejected(self):
+        """bits > 15 * orig_len is structurally impossible; reject before
+        sizing any buffer from it."""
+        frames = compress_batch([(b"abcd" * 5000)])
+        f = bytearray(frames[0])
+        struct.pack_into("<I", f, 8, 20000 * 15 + 1)
+        with pytest.raises(ThuffFormatError, match="payload bits"):
+            decompress_batch([bytes(f)])
+
+    def test_jump_corruption_detected_on_block_boundary(self):
+        """Without an encryption layer, corrupted block offsets desync the
+        scan; the full-block boundary check must catch it. (A single payload
+        bit-flip can swap two same-length codes undetectably — that's what
+        the encryption layer's GCM tag is for.)"""
+        data = (b"abcdefgh" * 2048)[: 2 * JUMP_BLOCK]  # exactly 2 full blocks
+        frames = compress_batch([data])
+        assert not frames[0][3] & 0x01
+        f = bytearray(frames[0])
+        # jump[1] lives right after header(8) + bits/njump(6) + lengths(128).
+        off = 8 + 6 + 128 + 4
+        struct.pack_into("<I", f, off, struct.unpack_from("<I", f, off)[0] + 1)
+        with pytest.raises(ThuffFormatError, match="block boundary"):
+            decompress_batch([bytes(f)])
+
+    def test_chunk_over_format_limit_rejected(self):
+        from tieredstorage_tpu.ops.huffman import MAX_CHUNK_BYTES
+
+        class FakeBytes(bytes):  # avoid allocating 128 MiB in the test
+            def __len__(self):
+                return MAX_CHUNK_BYTES + 1
+
+        with pytest.raises(ThuffFormatError, match="frame limit"):
+            compress_batch([FakeBytes(b"x")])

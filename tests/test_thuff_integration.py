@@ -1,0 +1,116 @@
+"""tpu-huff-v1 through the transform backends and the full RSM lifecycle.
+
+VERDICT r2 task 2's done-criteria: the device codec round-trips behind the
+existing `compressionCodec` manifest field, manifests record the codec id,
+and reference-style zstd manifests still load.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+
+import pytest
+
+from tieredstorage_tpu.manifest.segment_manifest import manifest_from_json
+from tieredstorage_tpu.security.aes import AesEncryptionProvider
+from tieredstorage_tpu.transform.api import (
+    THUFF,
+    DetransformOptions,
+    TransformOptions,
+)
+from tieredstorage_tpu.transform.cpu import CpuTransformBackend
+from tieredstorage_tpu.transform.tpu import TpuTransformBackend
+
+CHUNK = 8192
+
+
+def _chunks(n, rng):
+    """Kafka-ish payloads: half text scaffolding, half noise."""
+    out = []
+    for i in range(n):
+        text = (b"offset=%010d key=user-%04d value=payload " % (i, i)) * 120
+        noise = bytes(rng.getrandbits(8) for _ in range(CHUNK - len(text) % CHUNK))
+        out.append((text + noise)[:CHUNK])
+    return out
+
+
+@pytest.mark.parametrize("backend_cls", [TpuTransformBackend, CpuTransformBackend])
+@pytest.mark.parametrize("encrypted", [False, True])
+def test_backend_roundtrip_thuff(backend_cls, encrypted):
+    rng = random.Random(5)
+    chunks = _chunks(6, rng) + [b"", b"x" * 100]
+    dk = AesEncryptionProvider().create_data_key_and_aad() if encrypted else None
+    backend = backend_cls()
+    opts = TransformOptions(
+        compression=True, compression_codec=THUFF, encryption=dk
+    )
+    transformed = backend.transform(chunks, opts)
+    if not encrypted:
+        assert sum(map(len, transformed)) < sum(map(len, chunks))
+    back = backend.detransform(
+        transformed,
+        DetransformOptions(
+            compression=True,
+            compression_codec=THUFF,
+            encryption=dk,
+            max_original_chunk_size=CHUNK,
+        ),
+    )
+    assert back == chunks
+
+
+def test_backends_produce_identical_thuff_frames():
+    """Both backends run the same codec: frames must match byte-for-byte."""
+    rng = random.Random(6)
+    chunks = _chunks(4, rng)
+    opts = TransformOptions(compression=True, compression_codec=THUFF)
+    assert TpuTransformBackend().transform(chunks, opts) == CpuTransformBackend().transform(chunks, opts)
+
+
+class TestRsmLifecycle:
+    def _roundtrip(self, tmp_path, codec_configs, expect_codec):
+        from tests.test_rsm_lifecycle import (
+            make_rsm,
+            make_segment_data,
+            segment_metadata,
+        )
+
+        rsm, storage_root = make_rsm(
+            tmp_path, compression=True, encryption=False,
+            extra_configs=codec_configs,
+        )
+        data = make_segment_data(tmp_path, with_txn=False)
+        md = segment_metadata.__wrapped__()
+        rsm.copy_log_segment_data(md, data)
+        manifests = list(storage_root.rglob("*.rsm-manifest"))
+        assert len(manifests) == 1
+        obj = json.loads(manifests[0].read_text())
+        assert obj.get("compressionCodec") == expect_codec
+        # Wire-compat check: the JSON reloads through the public parser.
+        manifest = manifest_from_json(manifests[0].read_text())
+        assert (manifest.compression_codec or "zstd") == (expect_codec or "zstd")
+        original = data.log_segment.read_bytes()
+        with rsm.fetch_log_segment(md, 0) as s:
+            assert s.read() == original
+        with rsm.fetch_log_segment(md, 777, 9999) as s:
+            assert s.read() == original[777:10000]
+        rsm.delete_log_segment_data(md)
+
+    def test_thuff_segment_lifecycle_records_codec(self, tmp_path):
+        self._roundtrip(
+            tmp_path, {"compression.codec": THUFF}, expect_codec=THUFF
+        )
+
+    def test_zstd_manifests_unchanged(self, tmp_path):
+        # Default codec: manifest omits the field, readable as before.
+        self._roundtrip(tmp_path, {}, expect_codec=None)
+
+    def test_invalid_codec_rejected(self, tmp_path):
+        from tests.test_rsm_lifecycle import make_rsm
+
+        with pytest.raises(ValueError, match="compression.codec"):
+            make_rsm(
+                tmp_path, compression=True, encryption=False,
+                extra_configs={"compression.codec": "lz77-nope"},
+            )

@@ -41,6 +41,16 @@ def _valid_recording_level(name: str, value) -> None:
         )
 
 
+def _codec_id(name: str, value) -> None:
+    from tieredstorage_tpu.transform.api import THUFF, ZSTD
+
+    if value not in (ZSTD, THUFF):
+        raise ConfigException(
+            f"Invalid value {value!r} for configuration {name}: "
+            f"must be one of [{ZSTD!r}, {THUFF!r}]"
+        )
+
+
 def _base_def() -> ConfigDef:
     d = ConfigDef()
     d.define(ConfigKey(
@@ -78,8 +88,9 @@ def _base_def() -> ConfigDef:
     ))
     d.define(ConfigKey(
         "compression.codec", "string", default="zstd", importance="medium",
+        validator=_codec_id,
         doc="Compression codec id recorded in the manifest: 'zstd' "
-            "(reference-compatible) or a TPU-native codec id.",
+            "(reference-compatible) or 'tpu-huff-v1' (device codec).",
     ))
     d.define(ConfigKey(
         "encryption.enabled", "bool", default=False, importance="high",

@@ -1,0 +1,165 @@
+"""Batched canonical-Huffman encode/decode on device (the TPU codec core).
+
+The reference compresses chunks on the JVM heap with zstd-jni
+(core/.../transform/CompressionChunkEnumeration.java:50-63). A TPU has no
+sequential match-finder, so this framework's device codec is an order-0
+length-limited canonical Huffman coder designed around what the chip does
+well, batched over whole chunk windows:
+
+- encode: per-symbol (code, length) lookup is a per-row 256-entry gather,
+  bit positions are one exclusive `cumsum`, and packing is two scatter-adds
+  (contributions of one symbol never overlap in bits, so add == or).
+- decode: block-parallel — the frame records the absolute bit offset of
+  every JUMP_BLOCK-symbol block, so a [rows, blocks] lane grid scans
+  symbols sequentially per block while all blocks decode in parallel
+  (`lax.scan` over the in-block symbol index).
+
+Codes are stored bit-reversed so the stream reads MSB-first; the canonical
+(first_code, count, base, perm) tables per row make length detection a
+15-way vectorized range test, no tree walk. Host-side table construction
+(length-limited package-merge) lives in transform/thuff.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: Symbols per independently-decodable block (the frame stores one absolute
+#: bit offset per block; 4 B per 4096 symbols ≈ 0.1% overhead).
+JUMP_BLOCK = 4096
+
+MAX_CODE_LEN = 15
+
+#: Hard per-chunk cap of the v1 frame format: bit positions are int32
+#: (worst case MAX_CODE_LEN bits/symbol -> 128 MiB * 15 < 2^31) and the
+#: jump-table count is u16 (128 MiB / JUMP_BLOCK = 32768 <= 65535).
+MAX_CHUNK_BYTES = 128 << 20
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def max_words(n_max: int) -> int:
+    """Worst-case payload words for n_max symbols (15 bits each)."""
+    return _ceil_div(n_max * MAX_CODE_LEN, 32) + 1
+
+
+@functools.partial(jax.jit, static_argnames=("n_max",))
+def encode_batch(
+    data: jnp.ndarray,      # uint8[B, n_max], zero-padded past n_sym
+    n_sym: jnp.ndarray,     # int32[B]
+    codes_rev: jnp.ndarray, # int32[B, 256] bit-reversed canonical codes
+    lengths: jnp.ndarray,   # int32[B, 256] code lengths (0 for absent syms)
+    *,
+    n_max: int,
+):
+    """Returns (words uint32[B, W], total_bits int32[B], jump int32[B, J]).
+
+    jump[b, j] is the absolute bit offset of symbol j*JUMP_BLOCK — the
+    per-block entry points the parallel decoder starts from."""
+    batch = data.shape[0]
+    idx = data.astype(jnp.int32)
+    sym_len = jnp.take_along_axis(lengths, idx, axis=1)
+    sym_code = jnp.take_along_axis(codes_rev, idx, axis=1).astype(jnp.uint32)
+    valid = (
+        jnp.arange(n_max, dtype=jnp.int32)[None, :] < n_sym[:, None]
+    )
+    sym_len = jnp.where(valid, sym_len, 0)
+
+    end_bits = jnp.cumsum(sym_len, axis=1, dtype=jnp.int32)
+    bitpos = end_bits - sym_len  # exclusive prefix sum
+    total_bits = end_bits[:, -1]
+
+    w = max_words(n_max)
+    word_idx = bitpos >> 5
+    shift = (bitpos & 31).astype(jnp.uint32)
+    lo = sym_code << shift
+    # code >> (32 - s); s == 0 must yield 0 (no spill into the next word).
+    hi = jnp.where(
+        shift == 0,
+        jnp.uint32(0),
+        sym_code >> (jnp.uint32(32) - jnp.where(shift == 0, 1, shift)),
+    )
+    rows = jnp.arange(batch, dtype=jnp.int32)[:, None]
+    words = jnp.zeros((batch, w), jnp.uint32)
+    words = words.at[rows, word_idx].add(lo, mode="drop")
+    words = words.at[rows, word_idx + 1].add(hi, mode="drop")
+
+    jump = bitpos[:, ::JUMP_BLOCK]
+    return words, total_bits, jump
+
+
+def _bitrev15(v: jnp.ndarray) -> jnp.ndarray:
+    """Reverse the low 15 bits of a uint32 (result in the low 15 bits)."""
+    v = ((v & 0x55555555) << 1) | ((v >> 1) & 0x55555555)
+    v = ((v & 0x33333333) << 2) | ((v >> 2) & 0x33333333)
+    v = ((v & 0x0F0F0F0F) << 4) | ((v >> 4) & 0x0F0F0F0F)
+    v = ((v & 0x00FF00FF) << 8) | ((v >> 8) & 0x00FF00FF)
+    v = (v << 16) | (v >> 16)
+    return v >> 17  # 32-bit reversal, keep the top 15 of the reversed low 15
+
+
+@functools.partial(jax.jit, static_argnames=("n_max",))
+def decode_batch(
+    words: jnp.ndarray,       # uint32[B, W]
+    jump: jnp.ndarray,        # int32[B, J] absolute bit offsets per block
+    first_code: jnp.ndarray,  # int32[B, 16] canonical first code per length
+    counts: jnp.ndarray,      # int32[B, 16] symbols per length
+    base: jnp.ndarray,        # int32[B, 16] perm index of first sym per length
+    perm: jnp.ndarray,        # int32[B, 256] symbols sorted by (len, sym)
+    *,
+    n_max: int,
+):
+    """Returns (symbols uint8[B, n_max_padded], final_bitpos int32[B, J]).
+
+    Pad rows/tails are garbage; callers slice to their per-row n_sym.
+    final_bitpos[b, j] is the bit position after block j's JUMP_BLOCK
+    symbols — for full blocks it must equal jump[b, j+1] (and the frame's
+    total bits for an exactly-full last block), which is the decoder's
+    corruption check."""
+    batch, w = words.shape
+    n_blocks = jump.shape[1]
+    l_axis = jnp.arange(1, MAX_CODE_LEN + 1, dtype=jnp.int32)  # [15]
+
+    def step(bitpos, _):
+        # bitpos int32[B, J]; extract a 15-bit MSB-first window per lane.
+        widx = jnp.minimum(bitpos >> 5, w - 2)
+        s = (bitpos & 31).astype(jnp.uint32)
+        w0 = jnp.take_along_axis(words, widx, axis=1)
+        w1 = jnp.take_along_axis(words, widx + 1, axis=1)
+        window = (w0 >> s) | jnp.where(
+            s == 0, jnp.uint32(0), w1 << (jnp.uint32(32) - jnp.maximum(s, 1))
+        )
+        u15 = _bitrev15(window & jnp.uint32(0x7FFF)).astype(jnp.int32)  # [B, J]
+        # Length detection: the unique l with first[l] <= u15>>(15-l) < first+count.
+        u_l = u15[:, :, None] >> (MAX_CODE_LEN - l_axis)[None, None, :]  # [B,J,15]
+        f = jnp.take(first_code, l_axis, axis=1)[:, None, :]             # [B,1,15]
+        c = jnp.take(counts, l_axis, axis=1)[:, None, :]
+        ok = (u_l >= f) & (u_l < f + c)
+        l_sel = jnp.argmax(ok, axis=2)  # [B, J] -> index into l_axis (l-1)
+        u_sel = jnp.take_along_axis(u_l, l_sel[:, :, None], axis=2)[:, :, 0]
+        f_sel = jnp.take_along_axis(
+            jnp.broadcast_to(f, ok.shape), l_sel[:, :, None], axis=2
+        )[:, :, 0]
+        b_sel = jnp.take_along_axis(
+            jnp.broadcast_to(
+                jnp.take(base, l_axis, axis=1)[:, None, :], ok.shape
+            ),
+            l_sel[:, :, None],
+            axis=2,
+        )[:, :, 0]
+        idx = jnp.clip(b_sel + u_sel - f_sel, 0, 255)
+        sym = jnp.take_along_axis(perm, idx, axis=1).astype(jnp.uint8)
+        return bitpos + l_sel + 1, sym
+
+    final_bitpos, syms = jax.lax.scan(step, jump, None, length=JUMP_BLOCK)
+    # [steps, B, J] -> [B, J, steps] -> [B, J*steps]
+    return (
+        syms.transpose(1, 2, 0).reshape(batch, n_blocks * JUMP_BLOCK),
+        final_bitpos,
+    )

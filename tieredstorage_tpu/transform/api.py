@@ -17,8 +17,11 @@ from tieredstorage_tpu.security.aes import DataKeyAndAAD
 
 #: Compression codec ids recordable in the manifest. "zstd" is the
 #: reference-compatible default (zstd frame with content size, one frame per
-#: chunk — CompressionChunkEnumeration.java:50-63).
+#: chunk — CompressionChunkEnumeration.java:50-63). "tpu-huff-v1" is the
+#: device codec: chunk-batched canonical Huffman encoded/decoded on the TPU
+#: (transform/thuff.py), recorded in the manifest's compressionCodec field.
 ZSTD = "zstd"
+THUFF = "tpu-huff-v1"
 
 
 class AuthenticationError(ValueError):

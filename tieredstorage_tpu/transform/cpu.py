@@ -17,6 +17,7 @@ from cryptography.exceptions import InvalidTag
 
 from tieredstorage_tpu.security.aes import AesEncryptionProvider
 from tieredstorage_tpu.transform.api import (
+    THUFF,
     ZSTD,
     AuthenticationError,
     DetransformOptions,
@@ -29,19 +30,26 @@ class CpuTransformBackend(TransformBackend):
     def transform(self, chunks: Sequence[bytes], opts: TransformOptions) -> list[bytes]:
         out = list(chunks)
         if opts.compression:
-            if opts.compression_codec != ZSTD:
+            if opts.compression_codec == THUFF:
+                # tpu-huff-v1 segments stay readable/writable on hosts (the
+                # codec is plain jnp; on the CPU backend it runs on XLA-CPU).
+                from tieredstorage_tpu.transform import thuff
+
+                out = thuff.compress_batch(out)
+            elif opts.compression_codec != ZSTD:
                 raise ValueError(
-                    f"CPU backend supports only the {ZSTD!r} codec, "
+                    f"CPU backend supports only {ZSTD!r}/{THUFF!r} codecs, "
                     f"got {opts.compression_codec!r}"
                 )
-            # A compressor per chunk size keeps the pledged-src-size frames
-            # identical to the reference's per-chunk Zstd usage.
-            out = [
-                zstandard.ZstdCompressor(
-                    level=opts.compression_level, write_content_size=True
-                ).compress(c)
-                for c in out
-            ]
+            else:
+                # A compressor per chunk size keeps the pledged-src-size
+                # frames identical to the reference's per-chunk Zstd usage.
+                out = [
+                    zstandard.ZstdCompressor(
+                        level=opts.compression_level, write_content_size=True
+                    ).compress(c)
+                    for c in out
+                ]
         if opts.encryption is not None:
             enc = opts.encryption
             ivs = opts.ivs
@@ -69,14 +77,19 @@ class CpuTransformBackend(TransformBackend):
                     ) from None
             out = decrypted
         if opts.compression:
-            if opts.compression_codec != ZSTD:
+            if opts.compression_codec == THUFF:
+                from tieredstorage_tpu.transform import thuff
+
+                out = thuff.decompress_batch(out, opts.max_original_chunk_size)
+            elif opts.compression_codec != ZSTD:
                 raise ValueError(
-                    f"CPU backend supports only the {ZSTD!r} codec, "
+                    f"CPU backend supports only {ZSTD!r}/{THUFF!r} codecs, "
                     f"got {opts.compression_codec!r}"
                 )
-            from tieredstorage_tpu.native import checked_frame_content_sizes
+            else:
+                from tieredstorage_tpu.native import checked_frame_content_sizes
 
-            checked_frame_content_sizes(out, opts.max_original_chunk_size)
-            dctx = zstandard.ZstdDecompressor()
-            out = [dctx.decompress(c) for c in out]
+                checked_frame_content_sizes(out, opts.max_original_chunk_size)
+                dctx = zstandard.ZstdDecompressor()
+                out = [dctx.decompress(c) for c in out]
         return out

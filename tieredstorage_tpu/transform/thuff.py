@@ -1,0 +1,293 @@
+"""`tpu-huff-v1` — the TPU-native chunk compression codec.
+
+Frame format (all little-endian), one frame per chunk, self-contained the
+way the reference's per-chunk zstd frames are
+(core/.../transform/CompressionChunkEnumeration.java:50-63):
+
+    magic "TH" | version 0x01 | flags | orig_len u32
+    flags bit0 = RAW: orig_len raw bytes follow (incompressible fallback,
+                      mirroring zstd's raw-block behavior)
+    else:
+        total_bits u32 | n_jump u16 | code_lengths u4[256] (128 B)
+        jump u32[n_jump]            (absolute bit offset of every
+                                     JUMP_BLOCK-symbol block)
+        payload u32[ceil(total_bits/32)]
+
+Tables are canonical Huffman, length-limited to 15 bits by package-merge;
+the stream stores each code bit-reversed so it reads MSB-first. The heavy
+work (per-symbol lookup, prefix-sum bit placement, scatter packing,
+block-parallel decode) runs batched on device — ops/huffman.py. Histograms
+and table construction are host-side numpy: 256-entry problems are not chip
+work. zstd remains the default/compatibility codec; the manifest records
+`compressionCodec: "tpu-huff-v1"` so either side can detransform.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from tieredstorage_tpu.ops.huffman import (
+    JUMP_BLOCK,
+    MAX_CHUNK_BYTES,
+    MAX_CODE_LEN,
+    decode_batch,
+    encode_batch,
+    max_words,
+)
+
+CODEC_ID = "tpu-huff-v1"
+_MAGIC = b"TH"
+_VERSION = 1
+_FLAG_RAW = 0x01
+_HEADER = struct.Struct("<2sBBI")
+
+
+class ThuffFormatError(ValueError):
+    """Malformed tpu-huff-v1 frame."""
+
+
+# --------------------------------------------------------------------- host
+def limited_huffman_lengths(freqs: np.ndarray, limit: int = MAX_CODE_LEN) -> np.ndarray:
+    """Length-limited Huffman code lengths via package-merge.
+
+    freqs: int[256] symbol counts. Returns int[256] lengths in [0, limit]
+    (0 = symbol absent). Kraft-complete for >= 2 distinct symbols."""
+    syms = np.flatnonzero(freqs)
+    out = np.zeros(256, np.int32)
+    n = len(syms)
+    if n == 0:
+        return out
+    if n == 1:
+        out[syms[0]] = 1
+        return out
+    if n > (1 << limit):
+        raise ValueError("alphabet larger than 2^limit")
+    singles = sorted((int(freqs[s]), (int(s),)) for s in syms)
+    # L_1 = singletons; L_{k+1} = merge(singletons, package(L_k)). A symbol's
+    # code length = how many of the 2(n-1) cheapest items of L_limit contain
+    # it (Larmore–Hirschberg).
+    merged = list(singles)
+    for _ in range(limit - 1):
+        packaged = [
+            (a[0] + b[0], a[1] + b[1])
+            for a, b in zip(merged[0::2], merged[1::2])
+        ]
+        merged = sorted(singles + packaged)
+    for _, members in merged[: 2 * (n - 1)]:
+        for s in members:
+            out[s] += 1
+    return out
+
+
+def canonical_tables(lengths: np.ndarray):
+    """From lengths[256] build encode + decode tables.
+
+    Returns (codes_rev int32[256], first_code int32[16], counts int32[16],
+    base int32[16], perm int32[256]). Codes are canonical (assigned in
+    (length, symbol) order); codes_rev stores them bit-reversed for the
+    LSB-first stream packing."""
+    order = sorted(s for s in range(256) if lengths[s] > 0)
+    order.sort(key=lambda s: (lengths[s], s))
+    codes = np.zeros(256, np.int64)
+    first = np.zeros(16, np.int32)
+    counts = np.zeros(16, np.int32)
+    base = np.zeros(16, np.int32)
+    perm = np.zeros(256, np.int32)
+    code = 0
+    prev_len = 0
+    for i, s in enumerate(order):
+        l = int(lengths[s])
+        code <<= l - prev_len
+        if counts[l] == 0:
+            first[l] = code
+            base[l] = i
+        codes[s] = code
+        counts[l] += 1
+        perm[i] = s
+        code += 1
+        prev_len = l
+    if order and (code << (MAX_CODE_LEN - prev_len)) > (1 << MAX_CODE_LEN):
+        raise ThuffFormatError("over-subscribed canonical code")
+    rev = np.zeros(256, np.int32)
+    for s in range(256):
+        l = int(lengths[s])
+        c = int(codes[s])
+        r = 0
+        for _ in range(l):
+            r = (r << 1) | (c & 1)
+            c >>= 1
+        rev[s] = r
+    return rev, first, counts, base, perm
+
+
+def _pack_lengths(lengths: np.ndarray) -> bytes:
+    nibbles = lengths.astype(np.uint8)
+    return bytes((nibbles[0::2] | (nibbles[1::2] << 4)).tobytes())
+
+
+def _unpack_lengths(raw: bytes) -> np.ndarray:
+    packed = np.frombuffer(raw, dtype=np.uint8)
+    out = np.zeros(256, np.int32)
+    out[0::2] = packed & 0x0F
+    out[1::2] = packed >> 4
+    return out
+
+
+# -------------------------------------------------------------------- batch
+def compress_batch(chunks: list[bytes]) -> list[bytes]:
+    """Compress a window of chunks on device; RAW-frames incompressible ones."""
+    if not chunks:
+        return []
+    for c in chunks:
+        if len(c) > MAX_CHUNK_BYTES:
+            raise ThuffFormatError(
+                f"chunk of {len(c)} bytes exceeds the v1 frame limit of "
+                f"{MAX_CHUNK_BYTES} (int32 bit offsets, u16 jump count); "
+                f"use a smaller chunk.size or the zstd codec"
+            )
+    live = [(i, c) for i, c in enumerate(chunks) if len(c) > 0]
+    out: list[bytes] = [
+        _HEADER.pack(_MAGIC, _VERSION, _FLAG_RAW, 0) for _ in chunks
+    ]
+    if not live:
+        return out
+    n_max = _bucket(max(len(c) for _, c in live))
+    batch = len(live)
+    data = np.zeros((batch, n_max), np.uint8)
+    n_sym = np.zeros(batch, np.int32)
+    lengths = np.zeros((batch, 256), np.int32)
+    codes_rev = np.zeros((batch, 256), np.int32)
+    for row, (_, c) in enumerate(live):
+        arr = np.frombuffer(c, dtype=np.uint8)
+        data[row, : len(arr)] = arr
+        n_sym[row] = len(arr)
+        lens = limited_huffman_lengths(np.bincount(arr, minlength=256))
+        lengths[row] = lens
+        codes_rev[row], *_ = canonical_tables(lens)
+
+    words, total_bits, jump = encode_batch(
+        data, n_sym, codes_rev, lengths, n_max=n_max
+    )
+    words = np.asarray(words)
+    total_bits = np.asarray(total_bits)
+    jump = np.asarray(jump)
+
+    for row, (i, c) in enumerate(live):
+        bits = int(total_bits[row])
+        n_words = -(-bits // 32)
+        n_jump = -(-len(c) // JUMP_BLOCK)
+        body = (
+            struct.pack("<IH", bits, n_jump)
+            + _pack_lengths(lengths[row])
+            + jump[row, :n_jump].astype("<u4").tobytes()
+            + words[row, :n_words].astype("<u4").tobytes()
+        )
+        if len(body) + _HEADER.size >= len(c) + _HEADER.size:
+            out[i] = _HEADER.pack(_MAGIC, _VERSION, _FLAG_RAW, len(c)) + c
+        else:
+            out[i] = _HEADER.pack(_MAGIC, _VERSION, 0, len(c)) + body
+    return out
+
+
+def decompress_batch(
+    frames: list[bytes], max_original_chunk_size: int | None = None
+) -> list[bytes]:
+    """Decompress a window of tpu-huff-v1 frames (block-parallel on device)."""
+    if not frames:
+        return []
+    out: list[bytes | None] = [None] * len(frames)
+    coded: list[tuple] = []  # (frame idx, orig_len, lens, jump, words, bits)
+    for i, f in enumerate(frames):
+        if len(f) < _HEADER.size:
+            raise ThuffFormatError("frame shorter than header")
+        magic, version, flags, orig_len = _HEADER.unpack_from(f)
+        if magic != _MAGIC or version != _VERSION:
+            raise ThuffFormatError("bad magic/version")
+        if max_original_chunk_size is not None and orig_len > max_original_chunk_size:
+            raise ThuffFormatError(
+                f"declared size {orig_len} exceeds chunk limit "
+                f"{max_original_chunk_size}"
+            )
+        if orig_len > MAX_CHUNK_BYTES:
+            raise ThuffFormatError(
+                f"declared size {orig_len} exceeds the v1 frame limit"
+            )
+        body = f[_HEADER.size :]
+        if flags & _FLAG_RAW:
+            if len(body) != orig_len:
+                raise ThuffFormatError("raw frame length mismatch")
+            out[i] = body
+            continue
+        if len(body) < 6 + 128:
+            raise ThuffFormatError("coded frame shorter than tables")
+        bits, n_jump = struct.unpack_from("<IH", body)
+        if bits > orig_len * MAX_CODE_LEN:
+            raise ThuffFormatError(
+                f"declared {bits} payload bits exceeds {MAX_CODE_LEN}x the "
+                f"declared symbol count"
+            )
+        lens = _unpack_lengths(body[6 : 6 + 128])
+        off = 6 + 128
+        expect_jump = -(-orig_len // JUMP_BLOCK)
+        if n_jump != expect_jump:
+            raise ThuffFormatError("jump table size mismatch")
+        jump = np.frombuffer(body, dtype="<u4", count=n_jump, offset=off).astype(
+            np.int32
+        )
+        off += 4 * n_jump
+        n_words = -(-bits // 32)
+        if len(body) - off < 4 * n_words:
+            raise ThuffFormatError("payload truncated")
+        words = np.frombuffer(body, dtype="<u4", count=n_words, offset=off)
+        coded.append((i, orig_len, lens, jump, words, bits))
+
+    if not coded:
+        return [b if b is not None else b"" for b in out]
+
+    n_max = _bucket(max(c[1] for c in coded))
+    j_max = -(-n_max // JUMP_BLOCK)
+    w_max = max_words(n_max)
+    batch = len(coded)
+    words_b = np.zeros((batch, w_max), np.uint32)
+    jump_b = np.zeros((batch, j_max), np.int32)
+    first_b = np.zeros((batch, 16), np.int32)
+    counts_b = np.zeros((batch, 16), np.int32)
+    base_b = np.zeros((batch, 16), np.int32)
+    perm_b = np.zeros((batch, 256), np.int32)
+    for row, (_, orig_len, lens, jump, words, _bits) in enumerate(coded):
+        _, first_b[row], counts_b[row], base_b[row], perm_b[row] = canonical_tables(
+            lens
+        )
+        words_b[row, : len(words)] = words
+        jump_b[row, : len(jump)] = jump
+
+    decoded_dev, final_bitpos = decode_batch(
+        words_b, jump_b, first_b, counts_b, base_b, perm_b, n_max=n_max
+    )
+    decoded = np.asarray(decoded_dev)
+    final_bitpos = np.asarray(final_bitpos)
+    for row, (i, orig_len, lens, jump, words, bits) in enumerate(coded):
+        # Corruption check without an auth layer: every full block must end
+        # exactly where the next block's jump entry (or the frame's total
+        # bit count, for an exactly-full last block) says it starts.
+        expected_ends = list(jump[1:])
+        if orig_len and orig_len % JUMP_BLOCK == 0:
+            expected_ends.append(bits)
+        full = len(expected_ends)
+        if full and not np.array_equal(
+            final_bitpos[row, :full], np.asarray(expected_ends, np.int32)
+        ):
+            raise ThuffFormatError(
+                f"corrupt payload in frame {i}: block boundary mismatch"
+            )
+        out[i] = decoded[row, :orig_len].tobytes()
+    return [b if b is not None else b"" for b in out]
+
+
+def _bucket(n: int) -> int:
+    """Quantize jit-static shapes the same way the varlen GCM path does."""
+    from tieredstorage_tpu.ops.gcm import bucket_max_bytes
+
+    return bucket_max_bytes(n)

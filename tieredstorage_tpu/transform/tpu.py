@@ -32,6 +32,7 @@ from tieredstorage_tpu.ops.gcm import (
 from tieredstorage_tpu.parallel.mesh import data_mesh, pad_batch, shard_rows
 from tieredstorage_tpu.security.aes import IV_SIZE, TAG_SIZE
 from tieredstorage_tpu.transform.api import (
+    THUFF,
     ZSTD,
     AuthenticationError,
     DetransformOptions,
@@ -129,8 +130,12 @@ class TpuTransformBackend(TransformBackend):
         return [] if staged is None else self._encrypt_finish(staged)
 
     def _compress_batch(self, chunks: list[bytes], opts: TransformOptions) -> list[bytes]:
+        if opts.compression_codec == THUFF:
+            from tieredstorage_tpu.transform import thuff
+
+            return thuff.compress_batch(chunks)
         if opts.compression_codec != ZSTD:
-            raise ValueError(f"Codec {opts.compression_codec!r} not yet implemented")
+            raise ValueError(f"Codec {opts.compression_codec!r} not implemented")
         level = opts.compression_level
         if self._use_native():
             return native.zstd_compress_batch(chunks, level=level)
@@ -220,8 +225,12 @@ class TpuTransformBackend(TransformBackend):
         if opts.encryption is not None:
             out = self._decrypt_batch(out, opts)
         if opts.compression:
+            if opts.compression_codec == THUFF:
+                from tieredstorage_tpu.transform import thuff
+
+                return thuff.decompress_batch(out, opts.max_original_chunk_size)
             if opts.compression_codec != ZSTD:
-                raise ValueError(f"Codec {opts.compression_codec!r} not yet implemented")
+                raise ValueError(f"Codec {opts.compression_codec!r} not implemented")
             if self._use_native():
                 out = native.zstd_decompress_batch(
                     out, max_decompressed=opts.max_original_chunk_size
