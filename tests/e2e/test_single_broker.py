@@ -1,10 +1,13 @@
-"""Ordered single-broker e2e scenario against the S3 emulator.
+"""Ordered single-broker e2e scenario across the storage-backend matrix.
 
 Replays the reference's e2e scenario shape (SingleBrokerTest.java:276-661,
 @TestMethodOrder): remoteCopy → remoteRead → remoteManualDelete →
 retention cleanup → topicDelete, with 10 000 records across 3 partitions,
 1 KiB chunks, chunk-unaligned segment sizes, compression+encryption on.
-Tests share module state and run in definition order.
+Tests share module state and run in definition order, once per backend:
+S3, GCS, Azure, and S3-through-SOCKS5 emulators — the reference's
+MinIO/fake-gcs-server/Azurite/SOCKS5 subclass matrix
+(e2e/.../SingleBrokerTest.java:161-214) without containers.
 """
 
 from __future__ import annotations
@@ -15,7 +18,6 @@ import tempfile
 import pytest
 
 from tests.e2e.broker import BrokerSim, SegmentState
-from tests.emulators.s3_emulator import S3Emulator
 from tieredstorage_tpu.rsm import RemoteStorageManager
 from tieredstorage_tpu.security.rsa import generate_key_pair_pem_files
 
@@ -25,19 +27,109 @@ N_RECORDS = 10_000
 CHUNK_SIZE = 1024  # 1 KiB chunks like the reference's e2e workload
 
 
-@pytest.fixture(scope="module")
-def env():
-    emulator = S3Emulator().start()
-    tmp = pathlib.Path(tempfile.mkdtemp())
-    pub, priv = generate_key_pair_pem_files(tmp)
-    rsm = RemoteStorageManager()
-    rsm.configure(
-        {
+def _backend_setup(kind: str, stops: list):
+    """Start the emulator (and proxy) for one backend matrix entry.
+
+    Appends stop callables to `stops` AS things start, so a mid-setup
+    failure still tears down what got built. Returns (storage configs,
+    object-key lister) — mirrors the reference's SingleBrokerTest subclass
+    matrix over MinIO/fake-gcs-server/Azurite/SOCKS5
+    (e2e/.../SingleBrokerTest.java:161-214 + subclasses)."""
+    if kind.startswith("s3"):
+        from tests.emulators.s3_emulator import S3Emulator
+
+        emulator = S3Emulator().start()
+        stops.append(emulator.stop)
+        configs = {
             "storage.backend.class": "tieredstorage_tpu.storage.s3:S3Storage",
             "storage.s3.bucket.name": "e2e-bucket",
             "storage.s3.endpoint.url": emulator.endpoint,
             "storage.aws.access.key.id": "e2e",
             "storage.aws.secret.access.key": "secret",
+        }
+        if kind == "s3-socks5":
+            from tests.emulators.socks5_server import Socks5Server
+
+            proxy = Socks5Server().start()
+            stops.append(proxy.stop)
+            host, port = proxy.address
+            configs["storage.proxy.host"] = host
+            configs["storage.proxy.port"] = port
+
+            def list_keys():
+                with emulator.state.lock:
+                    assert proxy.connections >= 1, "traffic bypassed the proxy"
+                    return sorted(k for _, k in emulator.state.objects)
+
+        else:
+            def list_keys():
+                with emulator.state.lock:
+                    return sorted(k for _, k in emulator.state.objects)
+
+    elif kind == "gcs":
+        from tests.emulators.gcs_emulator import GcsEmulator
+
+        emulator = GcsEmulator().start()
+        stops.append(emulator.stop)
+        configs = {
+            "storage.backend.class": "tieredstorage_tpu.storage.gcs:GcsStorage",
+            "storage.gcs.bucket.name": "e2e-bucket",
+            "storage.gcs.endpoint.url": emulator.endpoint,
+        }
+
+        def list_keys():
+            with emulator.state.lock:
+                return sorted(k for _, k in emulator.state.objects)
+
+    elif kind == "azure":
+        from tests.emulators.azure_emulator import AzureEmulator
+
+        emulator = AzureEmulator(
+            account="devaccount",
+            account_key="ZGV2LWtleS1kZXYta2V5LWRldi1rZXktZGV2LWtleSE=",
+        ).start()
+        stops.append(emulator.stop)
+        configs = {
+            "storage.backend.class": "tieredstorage_tpu.storage.azure:AzureBlobStorage",
+            "storage.azure.container.name": "e2e-container",
+            "storage.azure.account.name": "devaccount",
+            "storage.azure.account.key": "ZGV2LWtleS1kZXYta2V5LWRldi1rZXktZGV2LWtleSE=",
+            "storage.azure.endpoint.url": emulator.endpoint,
+        }
+
+        def list_keys():
+            with emulator.state.lock:
+                return sorted(k for _, k in emulator.state.blobs)
+
+    else:  # pragma: no cover
+        raise AssertionError(kind)
+    return configs, list_keys
+
+
+@pytest.fixture(scope="module", params=["s3", "gcs", "azure", "s3-socks5"])
+def env(request):
+    stops: list = []
+    try:
+        yield from _env_impl(request, stops)
+    finally:
+        # Runs on setup failure too — a half-built matrix entry must not
+        # leak emulator/proxy threads into the remaining params.
+        for stop in reversed(stops):
+            try:
+                stop()
+            except Exception:
+                pass
+
+
+def _env_impl(request, stops):
+    storage_configs, list_keys = _backend_setup(request.param, stops)
+    tmp = pathlib.Path(tempfile.mkdtemp())
+    pub, priv = generate_key_pair_pem_files(tmp)
+    rsm = RemoteStorageManager()
+    stops.append(rsm.close)
+    rsm.configure(
+        {
+            **storage_configs,
             "chunk.size": CHUNK_SIZE,
             "key.prefix": "e2e/",
             "compression.enabled": True,
@@ -53,10 +145,7 @@ def env():
     )
     broker = BrokerSim(tmp / "logs", rsm)
     broker.create_topic(TOPIC, PARTITIONS)
-    state = {"broker": broker, "emulator": emulator, "rsm": rsm}
-    yield state
-    rsm.close()
-    emulator.stop()
+    yield {"broker": broker, "list_keys": list_keys, "rsm": rsm}
 
 
 def _produce_workload(broker: BrokerSim) -> dict[int, list[bytes]]:
@@ -86,9 +175,7 @@ def test_1_remote_copy(env):
     env["tiered_count"] = tiered
     # Remote object set matches the metadata topic: every live segment has
     # exactly .log + .indexes + .rsm-manifest in the store.
-    emulator = env["emulator"]
-    with emulator.state.lock:
-        object_keys = sorted(k for _, k in emulator.state.objects)
+    object_keys = env["list_keys"]()
     live = broker.tracker.remote_segments()
     assert len(live) == tiered
     assert len(object_keys) == 3 * tiered
@@ -124,9 +211,7 @@ def test_3_remote_manual_delete(env):
     cut = live_before[1].end_offset + 1  # drop the first two remote segments
     deleted = broker.delete_records(TOPIC, 0, cut)
     assert deleted == 2
-    emulator = env["emulator"]
-    with emulator.state.lock:
-        remaining = sorted(k for _, k in emulator.state.objects)
+    remaining = env["list_keys"]()
     # Objects of the deleted segments are gone from the store.
     assert len(remaining) == 3 * (env["tiered_count"] - deleted)
     # Consuming from 0 snaps to the new log start offset (Kafka's
@@ -163,9 +248,7 @@ def test_5_topic_delete(env):
     deleted = broker.delete_topic(TOPIC)
     assert deleted == live
     assert broker.tracker.remote_segments() == []
-    emulator = env["emulator"]
-    with emulator.state.lock:
-        assert not emulator.state.objects  # store empty
+    assert not env["list_keys"]()  # store empty
     # Every tracked segment ended in DELETE_SEGMENT_FINISHED.
     finished = {
         e.segment_id.id

@@ -1,0 +1,141 @@
+"""Sidecar contract tests: the RSM surface across a real process boundary.
+
+A `python -m tieredstorage_tpu.sidecar` subprocess hosts the full RSM
+(filesystem backend, compression+encryption); SidecarRsmClient drives
+copy → ranged fetch → fetch-index → delete against it. Failover semantics
+get their own tests: a dead endpoint with a deadline must reroute each
+call to the local fallback RSM, while real answers (NOT_FOUND) must
+propagate untouched.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tests.test_rsm_lifecycle import make_rsm, make_segment_data, segment_metadata
+from tieredstorage_tpu.errors import RemoteResourceNotFoundException
+from tieredstorage_tpu.manifest.segment_indexes import IndexType
+from tieredstorage_tpu.security.rsa import generate_key_pair_pem_files
+from tieredstorage_tpu.sidecar.client import (
+    FailoverRemoteStorageManager,
+    SidecarRsmClient,
+    SidecarUnavailableError,
+)
+
+
+@pytest.fixture(scope="module")
+def sidecar(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("sidecar")
+    storage_root = tmp / "remote"
+    storage_root.mkdir()
+    pub, priv = generate_key_pair_pem_files(tmp, prefix="sc")
+    config = {
+        "storage.backend.class": "tieredstorage_tpu.storage.filesystem.FileSystemStorage",
+        "storage.root": str(storage_root),
+        "chunk.size": 4096,
+        "compression.enabled": True,
+        "encryption.enabled": True,
+        "encryption.key.pair.id": "k1",
+        "encryption.key.pairs": ["k1"],
+        "encryption.key.pairs.k1.public.key.file": str(pub),
+        "encryption.key.pairs.k1.private.key.file": str(priv),
+        "custom.metadata.fields.include": "REMOTE_SIZE,OBJECT_PREFIX,OBJECT_KEY",
+    }
+    cfg_path = tmp / "sidecar.json"
+    cfg_path.write_text(json.dumps(config))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tieredstorage_tpu.sidecar", "--config", str(cfg_path)],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        cwd=str(pathlib.Path(__file__).resolve().parents[1]),
+    )
+    line = proc.stdout.readline()
+    assert line.startswith("SIDECAR_READY port="), (line, proc.stderr.read() if proc.poll() is not None else "")
+    port = int(line.strip().split("port=")[1])
+    client = SidecarRsmClient(f"127.0.0.1:{port}", timeout=60)
+    yield {"client": client, "storage_root": storage_root, "tmp": tmp, "proc": proc}
+    client.close()
+    proc.terminate()
+    proc.wait(timeout=10)
+
+
+class TestContract:
+    def test_copy_fetch_index_delete_across_process(self, sidecar, tmp_path):
+        client = sidecar["client"]
+        data = make_segment_data(tmp_path, with_txn=True)
+        md = segment_metadata.__wrapped__()
+        custom = client.copy_log_segment_data(md, data)
+        assert custom  # custom metadata round-trips the boundary
+        md = md.with_custom_metadata(custom)
+
+        stored = list(sidecar["storage_root"].rglob("*"))
+        assert any(p.suffix == ".log" for p in stored if p.is_file())
+
+        original = data.log_segment.read_bytes()
+        assert client.fetch_log_segment(md, 0).read() == original
+        assert (
+            client.fetch_log_segment(md, 1000, 8999).read() == original[1000:9000]
+        )
+        assert client.fetch_index(md, IndexType.OFFSET).read() == b"OFFSETIDX" * 16
+        assert (
+            client.fetch_index(md, IndexType.LEADER_EPOCH).read()
+            == b"leader-epoch-checkpoint-content"
+        )
+        client.delete_log_segment_data(md)
+        left = [p for p in sidecar["storage_root"].rglob("*") if p.is_file()]
+        assert not left
+
+    def test_not_found_maps_across_boundary(self, sidecar):
+        md = segment_metadata.__wrapped__()
+        with pytest.raises(RemoteResourceNotFoundException):
+            sidecar["client"].fetch_log_segment(md, 0)
+
+    def test_bad_range_maps_to_value_error(self, sidecar, tmp_path):
+        client = sidecar["client"]
+        data = make_segment_data(tmp_path, with_txn=False)
+        md = segment_metadata.__wrapped__()
+        md = md.with_custom_metadata(client.copy_log_segment_data(md, data))
+        with pytest.raises(ValueError):
+            client.fetch_log_segment(md, -1)
+        client.delete_log_segment_data(md)
+
+
+class TestFailover:
+    def test_dead_endpoint_falls_back_to_local_rsm(self, tmp_path):
+        local, storage_root = make_rsm(tmp_path, compression=True, encryption=False)
+        dead = SidecarRsmClient("127.0.0.1:1", timeout=0.5)
+        rsm = FailoverRemoteStorageManager(dead, local, timeout=0.5)
+        data = make_segment_data(tmp_path, with_txn=False)
+        md = segment_metadata.__wrapped__()
+        custom = rsm.copy_log_segment_data(md, data)
+        md = md.with_custom_metadata(custom)
+        assert rsm.fallback_calls == 1
+        original = data.log_segment.read_bytes()
+        assert rsm.fetch_log_segment(md, 0).read() == original
+        rsm.delete_log_segment_data(md)
+        assert rsm.fallback_calls == 3
+        rsm.close()
+
+    def test_real_answers_propagate_not_fallback(self, sidecar, tmp_path):
+        """NOT_FOUND from a healthy sidecar must NOT trigger the fallback."""
+        local, _ = make_rsm(tmp_path, compression=False, encryption=False)
+        rsm = FailoverRemoteStorageManager(
+            sidecar["client"], local, timeout=60
+        )
+        with pytest.raises(RemoteResourceNotFoundException):
+            rsm.fetch_log_segment(segment_metadata.__wrapped__(), 0)
+        assert rsm.fallback_calls == 0
+        local.close()
+
+    def test_unavailable_error_type(self):
+        dead = SidecarRsmClient("127.0.0.1:1", timeout=0.3)
+        with pytest.raises(SidecarUnavailableError):
+            dead.fetch_log_segment(segment_metadata.__wrapped__(), 0)
+        dead.close()

@@ -1,0 +1,14 @@
+"""gRPC sidecar: the broker↔accelerator process boundary (SURVEY §7 step 9).
+
+The reference runs as an in-process JVM plugin; this framework keeps the
+TPU runtime in its own process. `server` hosts a configured
+RemoteStorageManager behind the RemoteStorageSidecar service; `client`
+offers the same Python RSM surface over the wire plus timeout-based
+failover to a local CPU-path RSM.
+"""
+
+from tieredstorage_tpu.sidecar.client import (  # noqa: F401
+    FailoverRemoteStorageManager,
+    SidecarRsmClient,
+)
+from tieredstorage_tpu.sidecar.server import SidecarServer  # noqa: F401

@@ -1,0 +1,3 @@
+from tieredstorage_tpu.sidecar.server import main
+
+main()

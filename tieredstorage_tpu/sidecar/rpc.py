@@ -1,0 +1,111 @@
+"""Shared method table for the hand-bound gRPC service.
+
+grpc_tools (the protoc gRPC plugin) is not in this image, so the service
+is registered from this table on both sides: the server via
+`grpc.method_handlers_generic_handler`, the client via
+`channel.unary_unary`/`unary_stream` with the generated message classes'
+serializers. protoc itself generates sidecar_pb2 (see sidecar.proto).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from tieredstorage_tpu.sidecar import sidecar_pb2 as pb
+
+SERVICE = "tieredstorage.sidecar.v1.RemoteStorageSidecar"
+
+
+@dataclasses.dataclass(frozen=True)
+class Method:
+    name: str
+    request: type
+    response: type
+    server_streaming: bool = False
+
+    @property
+    def path(self) -> str:
+        return f"/{SERVICE}/{self.name}"
+
+
+METHODS = {
+    m.name: m
+    for m in (
+        Method("Copy", pb.CopyRequest, pb.CopyResponse),
+        Method("Fetch", pb.FetchRequest, pb.FetchChunk, server_streaming=True),
+        Method(
+            "FetchIndex", pb.FetchIndexRequest, pb.FetchChunk, server_streaming=True
+        ),
+        Method("Delete", pb.DeleteRequest, pb.Empty),
+        Method("Health", pb.Empty, pb.Empty),
+    )
+}
+
+#: Per-message ceiling for unary payloads (whole segments ride CopyRequest).
+MAX_MESSAGE_BYTES = 512 << 20
+
+#: Fetch/FetchIndex stream frame size.
+STREAM_CHUNK_BYTES = 1 << 20
+
+
+def channel_options() -> list[tuple[str, int]]:
+    return [
+        ("grpc.max_send_message_length", MAX_MESSAGE_BYTES),
+        ("grpc.max_receive_message_length", MAX_MESSAGE_BYTES),
+    ]
+
+
+def metadata_to_proto(md, *, include_custom: bool = True) -> pb.SegmentMetadata:
+    """RemoteLogSegmentMetadata -> proto."""
+    rid = md.remote_log_segment_id
+    tip = rid.topic_id_partition
+    out = pb.SegmentMetadata(
+        id=pb.SegmentId(
+            topic_id=bytes(tip.topic_id.raw),
+            topic=tip.topic_partition.topic,
+            partition=tip.topic_partition.partition,
+            segment_id=bytes(rid.id.raw),
+        ),
+        start_offset=md.start_offset,
+        end_offset=md.end_offset,
+        max_timestamp_ms=md.max_timestamp_ms,
+        broker_id=md.broker_id,
+        event_timestamp_ms=md.event_timestamp_ms,
+        segment_size_bytes=md.segment_size_in_bytes,
+    )
+    for epoch, offset in md.segment_leader_epochs.items():
+        out.leader_epochs[int(epoch)] = int(offset)
+    if include_custom and md.custom_metadata is not None:
+        out.custom_metadata = bytes(md.custom_metadata)
+        out.has_custom_metadata = True
+    return out
+
+
+def metadata_from_proto(msg: pb.SegmentMetadata):
+    from tieredstorage_tpu.metadata import (
+        KafkaUuid,
+        RemoteLogSegmentId,
+        RemoteLogSegmentMetadata,
+        TopicIdPartition,
+        TopicPartition,
+    )
+
+    return RemoteLogSegmentMetadata(
+        remote_log_segment_id=RemoteLogSegmentId(
+            TopicIdPartition(
+                KafkaUuid(bytes(msg.id.topic_id)),
+                TopicPartition(msg.id.topic, msg.id.partition),
+            ),
+            KafkaUuid(bytes(msg.id.segment_id)),
+        ),
+        start_offset=msg.start_offset,
+        end_offset=msg.end_offset,
+        max_timestamp_ms=msg.max_timestamp_ms,
+        broker_id=msg.broker_id,
+        event_timestamp_ms=msg.event_timestamp_ms,
+        segment_leader_epochs=dict(msg.leader_epochs),
+        segment_size_in_bytes=msg.segment_size_bytes,
+        custom_metadata=(
+            bytes(msg.custom_metadata) if msg.has_custom_metadata else None
+        ),
+    )

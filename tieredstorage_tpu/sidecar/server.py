@@ -1,0 +1,183 @@
+"""The sidecar server: a RemoteStorageManager behind gRPC.
+
+Runs the full TPU transform/storage runtime in its own process; brokers
+(or the Python SidecarRsmClient) drive copy/fetch/fetch-index/delete over
+the RemoteStorageSidecar service. RSM error types map onto gRPC status
+codes so clients can distinguish missing segments (NOT_FOUND) from bad
+requests (INVALID_ARGUMENT) and runtime failures (INTERNAL).
+
+Start standalone:  python -m tieredstorage_tpu.sidecar --config cfg.json
+(`--port 0` picks a free port; the bound port is printed as
+`SIDECAR_READY port=<n>` for supervising processes to scrape.)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import pathlib
+import tempfile
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from tieredstorage_tpu.errors import RemoteResourceNotFoundException
+from tieredstorage_tpu.manifest.segment_indexes import IndexType
+from tieredstorage_tpu.metadata import LogSegmentData
+from tieredstorage_tpu.sidecar import rpc
+from tieredstorage_tpu.sidecar import sidecar_pb2 as pb
+
+
+class SidecarServer:
+    def __init__(self, rsm, *, port: int = 0, max_workers: int = 8):
+        self._rsm = rsm
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers),
+            options=rpc.channel_options(),
+        )
+        self._server.add_generic_rpc_handlers((self._handler(),))
+        self.port = self._server.add_insecure_port(f"127.0.0.1:{port}")
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "SidecarServer":
+        self._server.start()
+        return self
+
+    def stop(self, grace: Optional[float] = 1.0) -> None:
+        self._server.stop(grace).wait()
+        self._rsm.close()
+
+    # ------------------------------------------------------------- handlers
+    def _handler(self):
+        impls = {
+            "Copy": self._copy,
+            "Fetch": self._fetch,
+            "FetchIndex": self._fetch_index,
+            "Delete": self._delete,
+            "Health": lambda req, ctx: pb.Empty(),
+        }
+        handlers = {}
+        for name, method in rpc.METHODS.items():
+            make = (
+                grpc.unary_stream_rpc_method_handler
+                if method.server_streaming
+                else grpc.unary_unary_rpc_method_handler
+            )
+            handlers[name] = make(
+                self._guard(impls[name], streaming=method.server_streaming),
+                request_deserializer=method.request.FromString,
+                response_serializer=method.response.SerializeToString,
+            )
+        return grpc.method_handlers_generic_handler(rpc.SERVICE, handlers)
+
+    @staticmethod
+    def _guard(fn, *, streaming: bool):
+        """Map RSM exceptions to gRPC status codes (also mid-stream)."""
+
+        def classify(exc: Exception):
+            if isinstance(exc, RemoteResourceNotFoundException):
+                return grpc.StatusCode.NOT_FOUND
+            if isinstance(exc, (ValueError, KeyError)):
+                return grpc.StatusCode.INVALID_ARGUMENT
+            return grpc.StatusCode.INTERNAL
+
+        if streaming:
+            def wrapped(request, context):
+                try:
+                    yield from fn(request, context)
+                except Exception as exc:  # noqa: BLE001 — boundary translation
+                    context.abort(classify(exc), f"{type(exc).__name__}: {exc}")
+
+        else:
+            def wrapped(request, context):
+                try:
+                    return fn(request, context)
+                except Exception as exc:  # noqa: BLE001 — boundary translation
+                    context.abort(classify(exc), f"{type(exc).__name__}: {exc}")
+
+        return wrapped
+
+    def _copy(self, request: pb.CopyRequest, context) -> pb.CopyResponse:
+        md = rpc.metadata_from_proto(request.metadata)
+        # LogSegmentData carries paths; materialize the shipped bytes in a
+        # scratch dir for the duration of the copy.
+        with tempfile.TemporaryDirectory(prefix="sidecar-copy-") as tmp:
+            base = pathlib.Path(tmp) / "segment"
+            files = {
+                "log": request.log_segment,
+                "index": request.offset_index,
+                "timeindex": request.time_index,
+                "snapshot": request.producer_snapshot,
+            }
+            paths = {}
+            for suffix, blob in files.items():
+                p = base.with_suffix("." + suffix)
+                p.write_bytes(blob)
+                paths[suffix] = p
+            txn = None
+            if request.has_transaction_index:
+                txn = base.with_suffix(".txnindex")
+                txn.write_bytes(request.transaction_index)
+            data = LogSegmentData(
+                log_segment=paths["log"],
+                offset_index=paths["index"],
+                time_index=paths["timeindex"],
+                producer_snapshot_index=paths["snapshot"],
+                transaction_index=txn,
+                leader_epoch_index=bytes(request.leader_epoch_index),
+            )
+            custom = self._rsm.copy_log_segment_data(md, data)
+        return pb.CopyResponse(custom_metadata=custom or b"")
+
+    def _fetch(self, request: pb.FetchRequest, context):
+        md = rpc.metadata_from_proto(request.metadata)
+        end = request.end_position if request.has_end else None
+        with contextlib.closing(
+            self._rsm.fetch_log_segment(md, request.start_position, end)
+        ) as stream:
+            while True:
+                block = stream.read(rpc.STREAM_CHUNK_BYTES)
+                if not block:
+                    return
+                yield pb.FetchChunk(data=block)
+
+    def _fetch_index(self, request: pb.FetchIndexRequest, context):
+        md = rpc.metadata_from_proto(request.metadata)
+        index_type = IndexType[request.index_type]
+        with contextlib.closing(self._rsm.fetch_index(md, index_type)) as stream:
+            while True:
+                block = stream.read(rpc.STREAM_CHUNK_BYTES)
+                if not block:
+                    return
+                yield pb.FetchChunk(data=block)
+
+    def _delete(self, request: pb.DeleteRequest, context) -> pb.Empty:
+        self._rsm.delete_log_segment_data(rpc.metadata_from_proto(request.metadata))
+        return pb.Empty()
+
+
+def main(argv: Optional[list[str]] = None) -> None:
+    import argparse
+    import signal
+    import sys
+    import threading
+
+    parser = argparse.ArgumentParser(description="tieredstorage_tpu gRPC sidecar")
+    parser.add_argument("--config", required=True, help="JSON file of RSM configs")
+    parser.add_argument("--port", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    from tieredstorage_tpu.rsm import RemoteStorageManager
+
+    rsm = RemoteStorageManager()
+    rsm.configure(json.loads(pathlib.Path(args.config).read_text()))
+    server = SidecarServer(rsm, port=args.port).start()
+    print(f"SIDECAR_READY port={server.port}", flush=True)
+
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    server.stop()
+    sys.exit(0)

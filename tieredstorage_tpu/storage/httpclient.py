@@ -173,15 +173,20 @@ class HttpClient:
         *,
         headers: Optional[Mapping[str, str]] = None,
         body: bytes = b"",
+        idempotent: Optional[bool] = None,
     ) -> HttpResponse:
-        """Issue a request and read the full response body."""
+        """Issue a request and read the full response body.
+
+        `idempotent` overrides the method-based replay classification for
+        calls the caller KNOWS are safe to replay (e.g. S3 DeleteObjects is
+        a POST, but deleting already-deleted keys is a no-op)."""
         import time as _time
 
         t0 = _time.perf_counter()
         err: Optional[BaseException] = None
         status = 0
         try:
-            resp = self._roundtrip(method, path_and_query, headers, body)
+            resp = self._roundtrip(method, path_and_query, headers, body, idempotent)
             status = resp.status
             data = resp.read()
             return HttpResponse(status, dict(resp.getheaders()), data)
@@ -220,7 +225,9 @@ class HttpClient:
 
     _IDEMPOTENT = frozenset({"GET", "HEAD", "PUT", "DELETE"})
 
-    def _roundtrip(self, method, path_and_query, headers, body) -> http.client.HTTPResponse:
+    def _roundtrip(
+        self, method, path_and_query, headers, body, idempotent=None
+    ) -> http.client.HTTPResponse:
         conn = self._pooled()
         reused = getattr(self._local, "conn_used", False)
         sent = False
@@ -237,7 +244,10 @@ class HttpClient:
             # POSTs) only when the failure happened while SENDING — once the
             # full request went out, the server may have executed it, and a
             # replay could run it twice.
-            if not reused or (sent and method not in self._IDEMPOTENT):
+            replay_safe = (
+                idempotent if idempotent is not None else method in self._IDEMPOTENT
+            )
+            if not reused or (sent and not replay_safe):
                 raise
             conn = self._pooled()
             conn.request(method, path_and_query, body=body, headers=dict(headers or {}))

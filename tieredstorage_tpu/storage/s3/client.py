@@ -125,11 +125,18 @@ class S3Client:
         body: bytes = b"",
         extra_headers: Optional[Mapping[str, str]] = None,
         ok: tuple[int, ...] = (200,),
+        idempotent: Optional[bool] = None,
     ) -> HttpResponse:
         query = dict(query or {})
         path = self._path(key)
         headers = self._headers(method, path, query, body, extra_headers)
-        resp = self.http.request(method, path + self._query_string(query), headers=headers, body=body)
+        resp = self.http.request(
+            method,
+            path + self._query_string(query),
+            headers=headers,
+            body=body,
+            idempotent=idempotent,
+        )
         if resp.status not in ok:
             raise _parse_error(resp)
         return resp
@@ -171,7 +178,13 @@ class S3Client:
             "Content-MD5": base64.b64encode(hashlib.md5(body).digest()).decode(),
             "Content-Type": "application/xml",
         }
-        resp = self._call("POST", "", query={"delete": ""}, body=body, extra_headers=extra)
+        # Replay-safe despite being a POST: re-deleting deleted keys is a
+        # no-op, so a stale pooled connection (e.g. through a SOCKS proxy)
+        # may retry once.
+        resp = self._call(
+            "POST", "", query={"delete": ""}, body=body, extra_headers=extra,
+            idempotent=True,
+        )
         # Non-quiet errors come back per-key; surface the first one.
         try:
             root = ET.fromstring(resp.body)

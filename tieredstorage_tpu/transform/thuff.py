@@ -30,6 +30,7 @@ import numpy as np
 
 from tieredstorage_tpu.ops.huffman import (
     JUMP_BLOCK,
+    _ceil_div,
     MAX_CHUNK_BYTES,
     MAX_CODE_LEN,
     decode_batch,
@@ -81,15 +82,14 @@ def limited_huffman_lengths(freqs: np.ndarray, limit: int = MAX_CODE_LEN) -> np.
     return out
 
 
-def canonical_tables(lengths: np.ndarray):
-    """From lengths[256] build encode + decode tables.
+def _canonical_assign(lengths: np.ndarray):
+    """Shared canonical-code walk: codes in (length, symbol) order.
 
-    Returns (codes_rev int32[256], first_code int32[16], counts int32[16],
-    base int32[16], perm int32[256]). Codes are canonical (assigned in
-    (length, symbol) order); codes_rev stores them bit-reversed for the
-    LSB-first stream packing."""
-    order = sorted(s for s in range(256) if lengths[s] > 0)
-    order.sort(key=lambda s: (lengths[s], s))
+    Returns (codes int64[256], first int32[16], counts int32[16],
+    base int32[16], perm int32[256])."""
+    order = sorted(
+        (s for s in range(256) if lengths[s] > 0), key=lambda s: (lengths[s], s)
+    )
     codes = np.zeros(256, np.int64)
     first = np.zeros(16, np.int32)
     counts = np.zeros(16, np.int32)
@@ -110,16 +110,39 @@ def canonical_tables(lengths: np.ndarray):
         prev_len = l
     if order and (code << (MAX_CODE_LEN - prev_len)) > (1 << MAX_CODE_LEN):
         raise ThuffFormatError("over-subscribed canonical code")
-    rev = np.zeros(256, np.int32)
-    for s in range(256):
-        l = int(lengths[s])
-        c = int(codes[s])
-        r = 0
-        for _ in range(l):
-            r = (r << 1) | (c & 1)
-            c >>= 1
-        rev[s] = r
-    return rev, first, counts, base, perm
+    return codes, first, counts, base, perm
+
+
+def _bitrev15_np(v: np.ndarray) -> np.ndarray:
+    v = v.astype(np.int64)
+    v = ((v & 0x5555) << 1) | ((v >> 1) & 0x5555)
+    v = ((v & 0x3333) << 2) | ((v >> 2) & 0x3333)
+    v = ((v & 0x0F0F) << 4) | ((v >> 4) & 0x0F0F)
+    v = ((v & 0x00FF) << 8) | ((v >> 8) & 0x00FF)
+    return v >> 1  # 16-bit reversal, drop to 15
+
+
+def encode_tables(lengths: np.ndarray) -> np.ndarray:
+    """codes_rev int32[256]: canonical codes bit-reversed for the LSB-first
+    stream packing (rev(code, l) = bitrev15(code) >> (15 - l))."""
+    codes, *_ = _canonical_assign(lengths)
+    shift = np.maximum(MAX_CODE_LEN - lengths, 0)
+    return np.where(
+        lengths > 0, _bitrev15_np(codes) >> shift, 0
+    ).astype(np.int32)
+
+
+def decode_tables(lengths: np.ndarray):
+    """(first_code, counts, base, perm) for the device decoder."""
+    _, first, counts, base, perm = _canonical_assign(lengths)
+    return first, counts, base, perm
+
+
+def canonical_tables(lengths: np.ndarray):
+    """Both directions' tables (tests/tools; hot paths use the split fns)."""
+    codes_rev = encode_tables(lengths)
+    first, counts, base, perm = decode_tables(lengths)
+    return codes_rev, first, counts, base, perm
 
 
 def _pack_lengths(lengths: np.ndarray) -> bytes:
@@ -165,7 +188,7 @@ def compress_batch(chunks: list[bytes]) -> list[bytes]:
         n_sym[row] = len(arr)
         lens = limited_huffman_lengths(np.bincount(arr, minlength=256))
         lengths[row] = lens
-        codes_rev[row], *_ = canonical_tables(lens)
+        codes_rev[row] = encode_tables(lens)
 
     words, total_bits, jump = encode_batch(
         data, n_sym, codes_rev, lengths, n_max=n_max
@@ -176,8 +199,8 @@ def compress_batch(chunks: list[bytes]) -> list[bytes]:
 
     for row, (i, c) in enumerate(live):
         bits = int(total_bits[row])
-        n_words = -(-bits // 32)
-        n_jump = -(-len(c) // JUMP_BLOCK)
+        n_words = _ceil_div(bits, 32)
+        n_jump = _ceil_div(len(c), JUMP_BLOCK)
         body = (
             struct.pack("<IH", bits, n_jump)
             + _pack_lengths(lengths[row])
@@ -230,14 +253,15 @@ def decompress_batch(
             )
         lens = _unpack_lengths(body[6 : 6 + 128])
         off = 6 + 128
-        expect_jump = -(-orig_len // JUMP_BLOCK)
-        if n_jump != expect_jump:
+        if n_jump != _ceil_div(orig_len, JUMP_BLOCK):
             raise ThuffFormatError("jump table size mismatch")
+        if len(body) - off < 4 * n_jump:
+            raise ThuffFormatError("jump table truncated")
         jump = np.frombuffer(body, dtype="<u4", count=n_jump, offset=off).astype(
             np.int32
         )
         off += 4 * n_jump
-        n_words = -(-bits // 32)
+        n_words = _ceil_div(bits, 32)
         if len(body) - off < 4 * n_words:
             raise ThuffFormatError("payload truncated")
         words = np.frombuffer(body, dtype="<u4", count=n_words, offset=off)
@@ -247,7 +271,7 @@ def decompress_batch(
         return [b if b is not None else b"" for b in out]
 
     n_max = _bucket(max(c[1] for c in coded))
-    j_max = -(-n_max // JUMP_BLOCK)
+    j_max = _ceil_div(n_max, JUMP_BLOCK)
     w_max = max_words(n_max)
     batch = len(coded)
     words_b = np.zeros((batch, w_max), np.uint32)
@@ -257,9 +281,7 @@ def decompress_batch(
     base_b = np.zeros((batch, 16), np.int32)
     perm_b = np.zeros((batch, 256), np.int32)
     for row, (_, orig_len, lens, jump, words, _bits) in enumerate(coded):
-        _, first_b[row], counts_b[row], base_b[row], perm_b[row] = canonical_tables(
-            lens
-        )
+        first_b[row], counts_b[row], base_b[row], perm_b[row] = decode_tables(lens)
         words_b[row, : len(words)] = words
         jump_b[row, : len(jump)] = jump
 
