@@ -220,7 +220,10 @@ def run_bench() -> dict:
     )
 
     # 3. Transfer-inclusive pipelines (tunnel-capped; see PROFILE.md).
+    from tieredstorage_tpu.utils.tracing import Tracer
+
     tpu = TpuTransformBackend()
+    tpu.tracer = Tracer(enabled=True)
 
     def windowed(o):
         def run():
@@ -272,6 +275,11 @@ def run_bench() -> dict:
     except Exception as exc:
         extras["thuff_error"] = f"{type(exc).__name__}: {exc}"
         _err(f"[bench] tpu-huff-v1 codec failed: {extras['thuff_error']}")
+    for name, agg in sorted(tpu.tracer.summary().items()):
+        _err(
+            f"[bench]   span {name}: n={agg['count']} "
+            f"total={agg['total_s']*1e3:.0f}ms avg={agg['avg_s']*1e3:.1f}ms"
+        )
     tpu.close()
 
     # 4. Host baselines: the reference's strictly sequential per-chunk chain,

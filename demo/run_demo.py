@@ -84,6 +84,7 @@ def main() -> None:
             "fetch.chunk.cache.class": "tieredstorage_tpu.fetch.cache.memory.MemoryChunkCache",
             "fetch.chunk.cache.size": 16 * 1024 * 1024,
             "fetch.chunk.cache.prefetch.max.size": 64 * 1024,
+            "tracing.enabled": True,
         }
     )
     print(f"· RemoteStorageManager up (transform backend: {args.transform}, "
@@ -124,6 +125,14 @@ def main() -> None:
 
     deleted = broker.delete_topic("demo-topic")
     print(f"· topic deleted; {deleted} remote segments removed")
+
+    print("· span summary (tracing.enabled):", file=sys.stderr)
+    for name, agg in sorted(rsm.tracer.summary().items()):
+        print(
+            f"    {name}: n={agg['count']} total={agg['total_s']*1e3:.1f}ms "
+            f"avg={agg['avg_s']*1e3:.2f}ms max={agg['max_s']*1e3:.2f}ms",
+            file=sys.stderr,
+        )
     rsm.close()
     if emulator is not None:
         with emulator.state.lock:

@@ -44,8 +44,7 @@ def make_segment_bytes(size: int = SEGMENT_SIZE, compressed: bool = False) -> by
     return header + body + rnd
 
 
-@pytest.fixture
-def segment_metadata():
+def make_segment_metadata() -> RemoteLogSegmentMetadata:
     tip = TopicIdPartition(TOPIC_ID, TopicPartition("topic", 7))
     return RemoteLogSegmentMetadata(
         remote_log_segment_id=RemoteLogSegmentId(tip, SEGMENT_ID),
@@ -53,6 +52,11 @@ def segment_metadata():
         end_offset=2000,
         segment_size_in_bytes=SEGMENT_SIZE,
     )
+
+
+@pytest.fixture
+def segment_metadata():
+    return make_segment_metadata()
 
 
 @pytest.fixture

@@ -18,7 +18,7 @@ import time
 
 import pytest
 
-from tests.test_rsm_lifecycle import make_rsm, make_segment_data, segment_metadata
+from tests.test_rsm_lifecycle import make_rsm, make_segment_data, make_segment_metadata
 from tieredstorage_tpu.errors import RemoteResourceNotFoundException
 from tieredstorage_tpu.manifest.segment_indexes import IndexType
 from tieredstorage_tpu.security.rsa import generate_key_pair_pem_files
@@ -70,7 +70,7 @@ class TestContract:
     def test_copy_fetch_index_delete_across_process(self, sidecar, tmp_path):
         client = sidecar["client"]
         data = make_segment_data(tmp_path, with_txn=True)
-        md = segment_metadata.__wrapped__()
+        md = make_segment_metadata()
         custom = client.copy_log_segment_data(md, data)
         assert custom  # custom metadata round-trips the boundary
         md = md.with_custom_metadata(custom)
@@ -93,14 +93,14 @@ class TestContract:
         assert not left
 
     def test_not_found_maps_across_boundary(self, sidecar):
-        md = segment_metadata.__wrapped__()
+        md = make_segment_metadata()
         with pytest.raises(RemoteResourceNotFoundException):
             sidecar["client"].fetch_log_segment(md, 0)
 
     def test_bad_range_maps_to_value_error(self, sidecar, tmp_path):
         client = sidecar["client"]
         data = make_segment_data(tmp_path, with_txn=False)
-        md = segment_metadata.__wrapped__()
+        md = make_segment_metadata()
         md = md.with_custom_metadata(client.copy_log_segment_data(md, data))
         with pytest.raises(ValueError):
             client.fetch_log_segment(md, -1)
@@ -113,7 +113,7 @@ class TestFailover:
         dead = SidecarRsmClient("127.0.0.1:1", timeout=0.5)
         rsm = FailoverRemoteStorageManager(dead, local, timeout=0.5)
         data = make_segment_data(tmp_path, with_txn=False)
-        md = segment_metadata.__wrapped__()
+        md = make_segment_metadata()
         custom = rsm.copy_log_segment_data(md, data)
         md = md.with_custom_metadata(custom)
         assert rsm.fallback_calls == 1
@@ -130,12 +130,12 @@ class TestFailover:
             sidecar["client"], local, timeout=60
         )
         with pytest.raises(RemoteResourceNotFoundException):
-            rsm.fetch_log_segment(segment_metadata.__wrapped__(), 0)
+            rsm.fetch_log_segment(make_segment_metadata(), 0)
         assert rsm.fallback_calls == 0
         local.close()
 
     def test_unavailable_error_type(self):
         dead = SidecarRsmClient("127.0.0.1:1", timeout=0.3)
         with pytest.raises(SidecarUnavailableError):
-            dead.fetch_log_segment(segment_metadata.__wrapped__(), 0)
+            dead.fetch_log_segment(make_segment_metadata(), 0)
         dead.close()

@@ -73,7 +73,7 @@ class TestRsmLifecycle:
         from tests.test_rsm_lifecycle import (
             make_rsm,
             make_segment_data,
-            segment_metadata,
+            make_segment_metadata,
         )
 
         rsm, storage_root = make_rsm(
@@ -81,7 +81,7 @@ class TestRsmLifecycle:
             extra_configs=codec_configs,
         )
         data = make_segment_data(tmp_path, with_txn=False)
-        md = segment_metadata.__wrapped__()
+        md = make_segment_metadata()
         rsm.copy_log_segment_data(md, data)
         manifests = list(storage_root.rglob("*.rsm-manifest"))
         assert len(manifests) == 1

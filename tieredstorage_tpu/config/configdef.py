@@ -45,6 +45,13 @@ def in_range(min_value=None, max_value=None):
                 f"Invalid value {value} for configuration {name}: Value must be no more than {max_value}"
             )
 
+    # Reference docs render ranges as "[min,...,max]" (docs/configs.rst:13).
+    if min_value is not None and max_value is not None:
+        check.description = f"[{min_value},...,{max_value}]"
+    elif min_value is not None:
+        check.description = f"[{min_value},...]"
+    else:
+        check.description = f"[...,{max_value}]"
     return check
 
 
@@ -56,12 +63,18 @@ def null_or(validator: Callable[[str, Any], None]):
         if value is not None:
             validator(name, value)
 
+    inner = getattr(validator, "description", None)
+    if inner:
+        check.description = f"null or {inner}"
     return check
 
 
 def non_empty_string(name: str, value) -> None:
     if value is not None and str(value).strip() == "":
         raise ConfigException(f"Invalid value for configuration {name}: String must be non-empty")
+
+
+non_empty_string.description = "non-empty string"
 
 
 def subclass_of(base: type):
@@ -71,6 +84,7 @@ def subclass_of(base: type):
                 f"Invalid value {value} for configuration {name}: Must be a subclass of {base.__name__}"
             )
 
+    check.description = f"Any implementation of {base.__name__}"
     return check
 
 

@@ -41,6 +41,9 @@ def _valid_recording_level(name: str, value) -> None:
         )
 
 
+_valid_recording_level.description = "[INFO, DEBUG]"
+
+
 def _codec_id(name: str, value) -> None:
     from tieredstorage_tpu.transform.api import THUFF, ZSTD
 
@@ -49,6 +52,9 @@ def _codec_id(name: str, value) -> None:
             f"Invalid value {value!r} for configuration {name}: "
             f"must be one of [{ZSTD!r}, {THUFF!r}]"
         )
+
+
+_codec_id.description = "[zstd, tpu-huff-v1]"
 
 
 def _base_def() -> ConfigDef:
@@ -91,6 +97,19 @@ def _base_def() -> ConfigDef:
         validator=_codec_id,
         doc="Compression codec id recorded in the manifest: 'zstd' "
             "(reference-compatible) or 'tpu-huff-v1' (device codec).",
+    ))
+    d.define(ConfigKey(
+        "tracing.enabled", "bool", default=False, importance="low",
+        doc="Record spans around RSM operations and, on the TPU transform "
+            "backend, compress/dispatch/finish/decrypt stages "
+            "(utils/tracing.py); summaries are exposed via "
+            "RemoteStorageManager.tracer.",
+    ))
+    d.define(ConfigKey(
+        "tracing.jax.profiler.enabled", "bool", default=False, importance="low",
+        doc="Forward tracing spans into jax.profiler TraceAnnotations so "
+            "they appear next to device kernels in XProf timelines "
+            "(requires tracing.enabled).",
     ))
     d.define(ConfigKey(
         "encryption.enabled", "bool", default=False, importance="high",
@@ -206,6 +225,14 @@ class RemoteStorageManagerConfig:
     @property
     def chunk_size(self) -> int:
         return self._values["chunk.size"]
+
+    @property
+    def tracing_enabled(self) -> bool:
+        return self._values["tracing.enabled"]
+
+    @property
+    def tracing_jax_profiler_enabled(self) -> bool:
+        return self._values["tracing.jax.profiler.enabled"]
 
     @property
     def compression_enabled(self) -> bool:

@@ -14,6 +14,7 @@ the reference's per-chunk Enumeration chain.
 from __future__ import annotations
 
 import io
+import functools
 import logging
 import time
 from pathlib import Path
@@ -61,9 +62,27 @@ from tieredstorage_tpu.storage.core import (
 from tieredstorage_tpu.transform.api import DetransformOptions, TransformOptions
 from tieredstorage_tpu.transform.pipeline import SegmentTransformation
 from tieredstorage_tpu.utils.ratelimit import RateLimitedStream, TokenBucket
+from tieredstorage_tpu.utils.tracing import NOOP_TRACER, Tracer
 from tieredstorage_tpu.utils.streams import ClosableStreamHolder
 
 log = logging.getLogger(__name__)
+
+
+def _traced(name: str):
+    """Span around an RSM operation, tagged with topic/partition (SURVEY §5:
+    the reference only has SLF4J boundary logs; these spans also forward
+    into jax.profiler timelines when tracing.jax.profiler.enabled)."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(self, metadata, *args, **kwargs):
+            tp = metadata.remote_log_segment_id.topic_id_partition.topic_partition
+            with self.tracer.span(name, topic=tp.topic, partition=tp.partition):
+                return fn(self, metadata, *args, **kwargs)
+
+        return wrapper
+
+    return deco
 
 
 class RemoteStorageManager:
@@ -80,6 +99,7 @@ class RemoteStorageManager:
         self._manifest_cache: Optional[MemorySegmentManifestCache] = None
         self._indexes_cache: Optional[MemorySegmentIndexesCache] = None
         self._metrics = None
+        self.tracer = NOOP_TRACER
 
     # ------------------------------------------------------------------ setup
     def configure(self, configs: Mapping[str, object]) -> None:
@@ -92,12 +112,18 @@ class RemoteStorageManager:
             recording_level=config.metrics_recording_level,
         ))
 
+        self.tracer = Tracer(
+            enabled=config.tracing_enabled,
+            use_jax_profiler=config.tracing_jax_profiler_enabled,
+        )
+
         storage = config.storage_backend_class()
         storage.configure(config.storage_configs())
         self._storage = storage
 
         backend = config.transform_backend_class()
         backend.configure(config.transform_configs())
+        backend.tracer = self.tracer
         self._transform_backend = backend
 
         self._object_key_factory = ObjectKeyFactory(config.key_prefix, config.key_prefix_mask)
@@ -159,6 +185,7 @@ class RemoteStorageManager:
         return self._config
 
     # ----------------------------------------------------------------- upload
+    @_traced("rsm.copy_log_segment_data")
     def copy_log_segment_data(
         self, metadata: RemoteLogSegmentMetadata, segment_data: LogSegmentData
     ) -> Optional[bytes]:
@@ -371,6 +398,7 @@ class RemoteStorageManager:
         decoder = self._rsa.data_key_decoder if self._rsa is not None else None
         return manifest_from_json(text, data_key_decoder=decoder)
 
+    @_traced("rsm.fetch_log_segment")
     def fetch_log_segment(
         self,
         metadata: RemoteLogSegmentMetadata,
@@ -411,6 +439,7 @@ class RemoteStorageManager:
         except StorageBackendException as e:
             raise RemoteStorageException(str(e)) from e
 
+    @_traced("rsm.fetch_index")
     def fetch_index(self, metadata: RemoteLogSegmentMetadata, index_type: IndexType) -> BinaryIO:
         self._require_configured()
         try:
@@ -451,6 +480,7 @@ class RemoteStorageManager:
         return self._transform_backend.detransform([blob], opts)[0]
 
     # ----------------------------------------------------------------- delete
+    @_traced("rsm.delete_log_segment_data")
     def delete_log_segment_data(self, metadata: RemoteLogSegmentMetadata) -> None:
         self._require_configured()
         log.debug("Deleting log segment data for %s", metadata)

@@ -14,6 +14,7 @@ import dataclasses
 from typing import Iterable, Iterator, Optional, Sequence
 
 from tieredstorage_tpu.security.aes import DataKeyAndAAD
+from tieredstorage_tpu.utils.tracing import NOOP_TRACER
 
 #: Compression codec ids recordable in the manifest. "zstd" is the
 #: reference-compatible default (zstd frame with content size, one frame per
@@ -94,6 +95,10 @@ class DetransformOptions:
 
 class TransformBackend(abc.ABC):
     """Maps batches of chunks through [compress] -> [encrypt] and back."""
+
+    #: Span recorder; the RSM injects its configured Tracer after
+    #: construction so backend dispatches appear nested under RSM spans.
+    tracer = NOOP_TRACER
 
     #: Preferred number of chunks per transform call; the pipeline feeds
     #: windows of roughly this size. TPU backends set this to fill the chip.
