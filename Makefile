@@ -1,0 +1,30 @@
+# Developer entry points (counterpart of /root/reference/Makefile).
+PYTHON ?= python
+
+.PHONY: test test-e2e bench demo docs docker lint clean
+
+test:
+	$(PYTHON) -m pytest tests/ -q --ignore=tests/e2e
+
+test-e2e:
+	$(PYTHON) -m pytest tests/e2e -q
+
+bench:
+	$(PYTHON) bench.py
+
+demo:
+	$(PYTHON) demo/run_demo.py
+
+docs:
+	$(PYTHON) -m tieredstorage_tpu.docs.configs_docs > docs/configs.rst
+	$(PYTHON) -m tieredstorage_tpu.docs.metrics_docs > docs/metrics.rst
+
+docker:
+	docker build -t tieredstorage-tpu -f docker/Dockerfile .
+
+lint:
+	$(PYTHON) -m compileall -q tieredstorage_tpu tests tools bench.py
+
+clean:
+	find . -name __pycache__ -type d -exec rm -rf {} +
+	rm -f native/*.so

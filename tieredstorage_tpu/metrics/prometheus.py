@@ -1,0 +1,84 @@
+"""Prometheus text-format exporter over the metrics registry.
+
+The reference's demo stacks wire JMX through a jmx-exporter sidecar into
+Prometheus (demo/compose-local-fs.yml:31); this build's registry is plain
+Python, so the exporter is a ~zero-dependency HTTP endpoint serving
+`/metrics` in the Prometheus exposition format (text/plain; version 0.0.4).
+Used by the sidecar's `--metrics-port` and the compose demo stack.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Iterable
+
+from tieredstorage_tpu.metrics.core import MetricName, MetricsRegistry
+
+_INVALID = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _metric_line(mn: MetricName, value: float) -> str:
+    name = _INVALID.sub("_", f"{mn.group}_{mn.name}".replace("-", "_"))
+    if mn.tags:
+        label_str = ",".join(
+            f'{_INVALID.sub("_", k)}="{v}"' for k, v in mn.tags
+        )
+        return f"{name}{{{label_str}}} {value}"
+    return f"{name} {value}"
+
+
+def render(registries: Iterable[MetricsRegistry]) -> str:
+    """Exposition-format dump of every metric in the given registries."""
+    lines = []
+    for registry in registries:
+        for mn in registry.metric_names:
+            try:
+                value = float(registry.value(mn))
+            except Exception:
+                continue  # a failing gauge must not take down the scrape
+            lines.append(_metric_line(mn, value))
+    return "\n".join(lines) + "\n"
+
+
+class PrometheusExporter:
+    """Serves /metrics for one or more registries on 127.0.0.1:<port>."""
+
+    def __init__(self, registries: Iterable[MetricsRegistry], *, port: int = 0,
+                 host: str = "0.0.0.0"):
+        regs = list(registries)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: A002 — quiet server
+                pass
+
+            def do_GET(self) -> None:
+                if self.path.split("?")[0] != "/metrics":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = render(outer.registries).encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.registries = regs
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+
+    def start(self) -> "PrometheusExporter":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

@@ -30,14 +30,18 @@ from tieredstorage_tpu.sidecar import sidecar_pb2 as pb
 
 
 class SidecarServer:
-    def __init__(self, rsm, *, port: int = 0, max_workers: int = 8):
+    def __init__(
+        self, rsm, *, port: int = 0, host: str = "127.0.0.1", max_workers: int = 8
+    ):
         self._rsm = rsm
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers),
             options=rpc.channel_options(),
         )
         self._server.add_generic_rpc_handlers((self._handler(),))
-        self.port = self._server.add_insecure_port(f"127.0.0.1:{port}")
+        # Loopback by default (tests, co-located brokers); containers pass
+        # --host 0.0.0.0 so the published port actually answers.
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "SidecarServer":
@@ -166,18 +170,37 @@ def main(argv: Optional[list[str]] = None) -> None:
     parser = argparse.ArgumentParser(description="tieredstorage_tpu gRPC sidecar")
     parser.add_argument("--config", required=True, help="JSON file of RSM configs")
     parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument(
+        "--metrics-port", type=int, default=None,
+        help="Serve Prometheus /metrics for the RSM registry on this port "
+             "(the compose demo stack's scrape target).",
+    )
     args = parser.parse_args(argv)
 
     from tieredstorage_tpu.rsm import RemoteStorageManager
 
     rsm = RemoteStorageManager()
     rsm.configure(json.loads(pathlib.Path(args.config).read_text()))
-    server = SidecarServer(rsm, port=args.port).start()
-    print(f"SIDECAR_READY port={server.port}", flush=True)
+    exporter = None
+    if args.metrics_port is not None:
+        from tieredstorage_tpu.metrics.prometheus import PrometheusExporter
+
+        exporter = PrometheusExporter(
+            [rsm.metrics.registry], port=args.metrics_port
+        ).start()
+    server = SidecarServer(rsm, port=args.port, host=args.host).start()
+    print(
+        f"SIDECAR_READY port={server.port}"
+        + (f" metrics_port={exporter.port}" if exporter else ""),
+        flush=True,
+    )
 
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_: stop.set())
     stop.wait()
+    if exporter is not None:
+        exporter.stop()
     server.stop()
     sys.exit(0)
