@@ -1,7 +1,7 @@
 # Developer entry points (counterpart of /root/reference/Makefile).
 PYTHON ?= python
 
-.PHONY: test test-e2e bench demo docs docker lint clean
+.PHONY: test test-e2e bench demo docs docker lint mutation clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q --ignore=tests/e2e
@@ -24,6 +24,12 @@ docker:
 
 lint:
 	$(PYTHON) -m compileall -q tieredstorage_tpu tests tools bench.py
+
+# Mutation testing (counterpart of the reference's pitest gate,
+# /root/reference/build.gradle:24): flips operators in core pure-logic
+# modules and requires the owning suites to notice.
+mutation:
+	$(PYTHON) tools/mutation_test.py --budget 40
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} +

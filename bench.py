@@ -193,8 +193,13 @@ def run_bench() -> dict:
 
     # BENCH_CHUNK_BYTES/BENCH_N_CHUNKS shrink the workload for CPU smoke
     # runs of the harness itself; the official protocol is the default.
-    chunk_bytes = int(os.environ.get("BENCH_CHUNK_BYTES", 4 << 20))
-    n_chunks = int(os.environ.get("BENCH_N_CHUNKS", 64))  # 256 MiB segment window
+    # On CPU fallback (TPU relay unreachable) the default shrinks itself:
+    # 256 MiB through the bitsliced circuit on one host core runs tens of
+    # minutes, long enough for a driver timeout to lose the JSON line —
+    # a small measured-on-CPU number with the error field beats no artifact.
+    default_chunk, default_n = (4 << 20, 64) if platform == "tpu" else (1 << 20, 8)
+    chunk_bytes = int(os.environ.get("BENCH_CHUNK_BYTES", default_chunk))
+    n_chunks = int(os.environ.get("BENCH_N_CHUNKS", default_n))
     chunks = make_segment(n_chunks, chunk_bytes)
     total_bytes = n_chunks * chunk_bytes
     gib = total_bytes / (1 << 30)

@@ -68,6 +68,13 @@ class TestChunkSizesCodec:
         with pytest.raises(ValueError):
             encode_chunk_sizes([10, -1, 5])
 
+    def test_zero_values_are_valid(self):
+        # 0 is a legal size (an empty final transformed chunk) — only
+        # strictly negative values are rejected.
+        assert decode_chunk_sizes(encode_chunk_sizes([0])) == [0]
+        assert decode_chunk_sizes(encode_chunk_sizes([100, 0])) == [100, 0]
+        assert decode_chunk_sizes(encode_chunk_sizes([0, 0, 0])) == [0, 0, 0]
+
     def test_property_round_trip(self):
         rng = random.Random(42)
         for _ in range(50):
@@ -119,6 +126,13 @@ class TestFixedSizeChunkIndex:
         assert [c.id for c in idx.chunks_for_range(BytesRange.of(200, 10_000))] == [2]
         assert idx.chunks_for_range(BytesRange.of(250, 300)) == []
 
+    def test_chunks_for_range_clamps_to_last_chunk_on_aligned_file(self):
+        # file_size 300 is chunk-aligned: a range past EOF must clamp to
+        # offset 299 (chunk 2), not drift into a phantom chunk 3.
+        idx = FixedSizeChunkIndex(100, 300, 110, 110)
+        assert [c.id for c in idx.chunks_for_range(BytesRange.of(250, 10_000))] == [2]
+        assert [c.id for c in idx.chunks_for_range(BytesRange.of(0, 10_000))] == [0, 1, 2]
+
     def test_empty_file(self):
         idx = FixedSizeChunkIndex(100, 0, 0, 0)
         assert idx.chunk_count == 0
@@ -156,6 +170,18 @@ class TestVariableSizeChunkIndex:
     def test_unknown_type_rejected(self):
         with pytest.raises(ValueError):
             chunk_index_from_json({"type": "wat"})
+
+    def test_equality_discriminates(self):
+        idx = VariableSizeChunkIndex(100, 250, [30, 20, 10])
+        assert idx == VariableSizeChunkIndex(100, 250, [30, 20, 10])
+        assert idx != VariableSizeChunkIndex(100, 250, [30, 20, 11])
+        assert idx != VariableSizeChunkIndex(100, 240, [30, 20, 10])
+        assert idx != VariableSizeChunkIndex(50, 250, [30, 20, 10])
+        assert idx != FixedSizeChunkIndex(100, 250, 110, 80)
+        assert FixedSizeChunkIndex(100, 250, 110, 80) != idx
+        assert FixedSizeChunkIndex(100, 250, 110, 80) != FixedSizeChunkIndex(
+            100, 250, 110, 81
+        )
 
 
 class TestBuilders:

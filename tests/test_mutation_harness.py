@@ -1,0 +1,125 @@
+"""The mutation harness itself must be trustworthy: site discovery, the
+single-mutation guarantee, in-place apply/restore, and the kill-rate gate.
+Counterpart of the reference's pitest wiring (/root/reference/build.gradle:24)."""
+
+from __future__ import annotations
+
+import ast
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+HARNESS = REPO / "tools" / "mutation_test.py"
+
+sys.path.insert(0, str(REPO / "tools"))
+from mutation_test import find_sites, mutate_source  # noqa: E402
+
+SRC = """\
+def sign(v):
+    if v < 0:
+        return -1
+    if v > 0:
+        return 1
+    return 0
+
+
+def total(xs):
+    acc = 0
+    for x in xs:
+        acc = acc + x
+    return acc
+"""
+
+
+def test_find_sites_enumerates_operators():
+    _, sites = find_sites(SRC)
+    kinds = [k for _, k, _ in sites]
+    assert kinds.count("cmp") == 2  # v < 0, v > 0
+    assert kinds.count("bin") == 1  # acc + x
+    descs = " | ".join(d for _, _, d in sites)
+    assert "Lt -> LtE" in descs and "Gt -> GtE" in descs and "Add -> Sub" in descs
+
+
+def test_mutate_applies_exactly_one_site():
+    tree, sites = find_sites(SRC)
+    mutated = mutate_source(tree, sites[0][0])
+    # First site flips v < 0 to v <= 0; the second comparison is untouched.
+    assert "v <= 0" in mutated and "v > 0" in mutated and "acc + x" in mutated
+    ast.parse(mutated)  # mutant is valid python
+
+
+def test_annotations_are_not_mutation_sites():
+    # `X | None` in a hint is a BitOr node but never executes; mutating it
+    # produces a guaranteed survivor, so hints must not be sites.
+    src = (
+        "def f(x: int | None, *, y: int | str = 3) -> bytes | None:\n"
+        "    z: int | None = x\n"
+        "    return bytes([z + y])\n"
+    )
+    _, sites = find_sites(src)
+    assert [d for _, _, d in sites] == ["line 3: Add -> Sub"]
+
+
+def test_each_site_id_is_addressable():
+    tree, sites = find_sites(SRC)
+    outputs = {mutate_source(tree, sid) for sid, _, _ in sites}
+    assert len(outputs) == len(sites)  # every mutation is distinct
+
+
+def _write_project(tmp_path: Path, *, weak: bool) -> tuple[str, str]:
+    (tmp_path / "mod.py").write_text(SRC)
+    body = (
+        "import mod\n"
+        "def test_smoke():\n"
+        "    assert mod.total([]) == 0\n"
+        if weak
+        else "import mod\n"
+        "def test_sign():\n"
+        "    assert mod.sign(-2) == -1\n"
+        "    assert mod.sign(0) == 0\n"
+        "    assert mod.sign(2) == 1\n"
+        "def test_total():\n"
+        "    assert mod.total([1, 2, 3]) == 6\n"
+        "    assert mod.total([]) == 0\n"
+    )
+    (tmp_path / "test_mod.py").write_text(body)
+    return "mod.py", "test_mod.py"
+
+
+def _run(tmp_path: Path, extra: list[str]) -> subprocess.CompletedProcess:
+    mod, tests = "mod.py", "test_mod.py"
+    return subprocess.run(
+        [
+            sys.executable,
+            str(HARNESS),
+            "--module",
+            mod,
+            "--tests",
+            tests,
+            "--repo",
+            str(tmp_path),
+            "--timeout",
+            "60",
+            *extra,
+        ],
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+
+
+def test_strong_suite_kills_mutants_and_restores_file(tmp_path):
+    _write_project(tmp_path, weak=False)
+    before = (tmp_path / "mod.py").read_text()
+    proc = _run(tmp_path, ["--budget", "4", "--min-kill-rate", "0.7"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "killed" in proc.stdout
+    assert (tmp_path / "mod.py").read_text() == before  # restored
+
+
+def test_weak_suite_fails_the_gate(tmp_path):
+    _write_project(tmp_path, weak=True)
+    proc = _run(tmp_path, ["--budget", "3", "--min-kill-rate", "0.9"])
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "SURVIVED" in proc.stdout

@@ -111,6 +111,16 @@ class TestVarint:
         write_varlong(-1, out)
         assert bytes(out) == b"\x01"  # zigzag(-1) = 1
 
+    def test_truncated_varint_raises_value_error(self):
+        # Continuation bit set but the stream ends: must be a clean
+        # ValueError (never an IndexError), including at pos == len(data).
+        with pytest.raises(ValueError, match="Truncated"):
+            read_unsigned_varint(b"\x80", 1)
+        with pytest.raises(ValueError, match="Truncated"):
+            read_unsigned_varint(b"\x80\x80", 0)
+        with pytest.raises(ValueError, match="Truncated"):
+            read_unsigned_varint(b"", 0)
+
 
 class TestCustomMetadataSerde:
     def test_round_trip_all_fields(self):

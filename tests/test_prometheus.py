@@ -34,6 +34,18 @@ def test_render_exposition_format():
     ) in out
 
 
+def test_label_values_are_escaped():
+    # Backslash, quote, and newline in a tag value must stay one
+    # well-formed exposition line or the whole scrape fails to parse.
+    registry = MetricsRegistry(MetricConfig())
+    registry.add_gauge(
+        MetricName.of("seg-copy", "rsm", tags={"topic": 'a"b\\c\nd'}), lambda: 42
+    )
+    out = render([registry])
+    assert 'topic="a\\"b\\\\c\\nd"' in out, out
+    assert out.count("\n") == 1
+
+
 def test_failing_gauge_does_not_break_scrape():
     registry = MetricsRegistry(MetricConfig())
     registry.add_gauge(MetricName.of("ok", "g"), lambda: 1)

@@ -19,11 +19,16 @@ from tieredstorage_tpu.metrics.core import MetricName, MetricsRegistry
 _INVALID = re.compile(r"[^a-zA-Z0-9_:]")
 
 
+def _escape_label(v: object) -> str:
+    # Exposition-format label escaping: backslash, double quote, newline.
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
 def _metric_line(mn: MetricName, value: float) -> str:
     name = _INVALID.sub("_", f"{mn.group}_{mn.name}".replace("-", "_"))
     if mn.tags:
         label_str = ",".join(
-            f'{_INVALID.sub("_", k)}="{v}"' for k, v in mn.tags
+            f'{_INVALID.sub("_", k)}="{_escape_label(v)}"' for k, v in mn.tags
         )
         return f"{name}{{{label_str}}} {value}"
     return f"{name} {value}"
@@ -46,7 +51,7 @@ class PrometheusExporter:
     """Serves /metrics for one or more registries on 127.0.0.1:<port>."""
 
     def __init__(self, registries: Iterable[MetricsRegistry], *, port: int = 0,
-                 host: str = "0.0.0.0"):
+                 host: str = "127.0.0.1"):
         regs = list(registries)
         outer = self
 

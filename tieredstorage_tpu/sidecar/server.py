@@ -186,8 +186,10 @@ def main(argv: Optional[list[str]] = None) -> None:
     if args.metrics_port is not None:
         from tieredstorage_tpu.metrics.prometheus import PrometheusExporter
 
+        # Bind the exporter to the same interface as the gRPC side: a
+        # loopback-only sidecar must not expose metrics network-wide.
         exporter = PrometheusExporter(
-            [rsm.metrics.registry], port=args.metrics_port
+            [rsm.metrics.registry], port=args.metrics_port, host=args.host
         ).start()
     server = SidecarServer(rsm, port=args.port, host=args.host).start()
     print(

@@ -1,0 +1,277 @@
+"""Mutation-testing harness: the quality gate the reference wires via pitest
+(/root/reference/build.gradle:24, Makefile:28-29), rebuilt for this tree.
+
+Generates first-order mutants of core pure-logic modules with an AST rewriter
+(comparison/arithmetic/boolean operator swaps, off-by-one constants, boundary
+slips), runs each mutant against the test files that own the module, and
+reports the kill rate. A surviving mutant means the suite would not notice
+that specific logic inversion — the same signal pitest gives the reference.
+
+Usage:
+    python tools/mutation_test.py                 # default targets + budget
+    python tools/mutation_test.py --budget 20     # cap total mutants
+    python tools/mutation_test.py --module tieredstorage_tpu/manifest/codec.py \
+        --tests tests/test_manifest.py            # explicit pair
+    python tools/mutation_test.py --list          # show sites, run nothing
+
+Mutants are applied by rewriting the target file in place (backup+restore in a
+finally block, exactly like mutmut/pitest operate on the build tree); the run
+refuses to start if the target has uncommitted modifications so a crash can
+never lose work. Exit code is non-zero when the kill rate falls below
+--min-kill-rate (default 0.7).
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import copy
+import os
+import random
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+#: Default (module, owning tests) pairs: pure-logic hot spots where an operator
+#: flip is a real bug, and the suites that are supposed to catch it.
+DEFAULT_TARGETS = [
+    ("tieredstorage_tpu/manifest/codec.py", ["tests/test_manifest.py"]),
+    ("tieredstorage_tpu/manifest/chunk_index.py", ["tests/test_manifest.py"]),
+    ("tieredstorage_tpu/storage/core.py", ["tests/test_storage_backends.py"]),
+    ("tieredstorage_tpu/utils/varint.py", ["tests/test_object_key_and_metadata.py"]),
+    ("tieredstorage_tpu/object_key.py", ["tests/test_object_key_and_metadata.py"]),
+]
+
+_CMP_SWAP = {
+    ast.Lt: ast.LtE,
+    ast.LtE: ast.Lt,
+    ast.Gt: ast.GtE,
+    ast.GtE: ast.Gt,
+    ast.Eq: ast.NotEq,
+    ast.NotEq: ast.Eq,
+}
+_BIN_SWAP = {
+    ast.Add: ast.Sub,
+    ast.Sub: ast.Add,
+    ast.Mult: ast.FloorDiv,
+    ast.FloorDiv: ast.Mult,
+    ast.LShift: ast.RShift,
+    ast.RShift: ast.LShift,
+    ast.BitAnd: ast.BitOr,
+    ast.BitOr: ast.BitAnd,
+}
+
+
+class _SiteFinder(ast.NodeVisitor):
+    """Enumerate mutation sites: (node id, kind, description).
+
+    Annotation subtrees are skipped: `X | None` in a type hint is a BitOr
+    node, but mutating it can never change behavior (hints don't execute),
+    so such sites would only produce guaranteed-surviving mutants."""
+
+    def __init__(self) -> None:
+        self.sites: list[tuple[int, str, str]] = []
+        self._id = 0
+
+    def generic_visit(self, node: ast.AST) -> None:
+        for field, value in ast.iter_fields(node):
+            if field in ("annotation", "returns"):
+                continue
+            for item in value if isinstance(value, list) else [value]:
+                if isinstance(item, ast.AST):
+                    self.visit(item)
+
+    def _add(self, node: ast.AST, kind: str, desc: str) -> None:
+        node._mut_id = self._id  # type: ignore[attr-defined]
+        self.sites.append((self._id, kind, f"line {node.lineno}: {desc}"))
+        self._id += 1
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        if len(node.ops) == 1 and type(node.ops[0]) in _CMP_SWAP:
+            new = _CMP_SWAP[type(node.ops[0])].__name__
+            self._add(node, "cmp", f"{type(node.ops[0]).__name__} -> {new}")
+        self.generic_visit(node)
+
+    def visit_BinOp(self, node: ast.BinOp) -> None:
+        if type(node.op) in _BIN_SWAP:
+            new = _BIN_SWAP[type(node.op)].__name__
+            self._add(node, "bin", f"{type(node.op).__name__} -> {new}")
+        self.generic_visit(node)
+
+    def visit_BoolOp(self, node: ast.BoolOp) -> None:
+        self._add(node, "bool", "and <-> or")
+        self.generic_visit(node)
+
+
+class _Mutator(ast.NodeTransformer):
+    """Apply exactly one mutation, addressed by the site id."""
+
+    def __init__(self, target_id: int) -> None:
+        self.target_id = target_id
+        self.applied = False
+
+    def _hit(self, node: ast.AST) -> bool:
+        return getattr(node, "_mut_id", None) == self.target_id
+
+    def visit_Compare(self, node: ast.Compare) -> ast.AST:
+        self.generic_visit(node)
+        if self._hit(node):
+            node.ops = [_CMP_SWAP[type(node.ops[0])]()]
+            self.applied = True
+        return node
+
+    def visit_BinOp(self, node: ast.BinOp) -> ast.AST:
+        self.generic_visit(node)
+        if self._hit(node):
+            node.op = _BIN_SWAP[type(node.op)]()
+            self.applied = True
+        return node
+
+    def visit_BoolOp(self, node: ast.BoolOp) -> ast.AST:
+        self.generic_visit(node)
+        if self._hit(node):
+            node.op = ast.Or() if isinstance(node.op, ast.And) else ast.And()
+            self.applied = True
+        return node
+
+
+def find_sites(source: str) -> tuple[ast.Module, list[tuple[int, str, str]]]:
+    tree = ast.parse(source)
+    finder = _SiteFinder()
+    finder.visit(tree)
+    return tree, finder.sites
+
+
+def mutate_source(tree: ast.Module, site_id: int) -> str:
+    mutant = _Mutator(site_id)
+    new_tree = mutant.visit(copy.deepcopy(tree))
+    if not mutant.applied:
+        raise ValueError(f"site {site_id} not found")
+    return ast.unparse(ast.fix_missing_locations(new_tree))
+
+
+def run_tests(test_files: list[str], *, cwd: Path, timeout: int) -> bool:
+    """True when the suite PASSES (i.e. the mutant survived).
+
+    Bytecode caching is disabled: pyc validation keys on (size, whole-second
+    mtime), and same-length mutants written within one second of each other
+    would otherwise run each other's stale .pyc."""
+    env = dict(os.environ, PYTHONDONTWRITEBYTECODE="1")
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "-x", "-q", "--no-header", "-p", "no:cacheprovider", *test_files],
+        cwd=cwd,
+        capture_output=True,
+        timeout=timeout,
+        env=env,
+    )
+    return proc.returncode == 0
+
+
+def drop_pycache(path: Path) -> None:
+    """Remove cached bytecode for a module about to be mutated in place."""
+    for pyc in (path.parent / "__pycache__").glob(f"{path.stem}.*.pyc"):
+        try:
+            pyc.unlink()
+        except OSError:
+            pass
+
+
+def check_clean(path: Path) -> None:
+    proc = subprocess.run(
+        ["git", "status", "--porcelain", "--", str(path)],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+    )
+    if proc.returncode == 0 and proc.stdout.strip():
+        raise SystemExit(
+            f"refusing to mutate {path}: it has uncommitted changes "
+            "(commit or stash first; mutants rewrite the file in place)"
+        )
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--budget", type=int, default=40, help="max mutants overall")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--min-kill-rate", type=float, default=0.7)
+    ap.add_argument("--timeout", type=int, default=300, help="per-mutant pytest timeout (s)")
+    ap.add_argument("--module", help="single module path (repo-relative)")
+    ap.add_argument("--tests", nargs="+", help="test files owning --module")
+    ap.add_argument("--repo", default=str(REPO), help="repo root (for self-tests)")
+    ap.add_argument("--list", action="store_true", help="list sites and exit")
+    args = ap.parse_args()
+
+    repo = Path(args.repo).resolve()
+    if args.module:
+        targets = [(args.module, args.tests or [])]
+        if not args.tests and not args.list:
+            ap.error("--tests is required with --module")
+    else:
+        targets = DEFAULT_TARGETS
+
+    rng = random.Random(args.seed)
+    plan: list[tuple[Path, list[str], ast.Module, int, str]] = []
+    for mod, tests in targets:
+        path = repo / mod
+        source = path.read_text()
+        tree, sites = find_sites(source)
+        if args.list:
+            print(f"{mod}: {len(sites)} sites")
+            for sid, kind, desc in sites:
+                print(f"  [{sid}] {kind} {desc}")
+            continue
+        for sid, _kind, desc in sites:
+            plan.append((path, tests, tree, sid, f"{mod} {desc}"))
+    if args.list:
+        return 0
+
+    rng.shuffle(plan)
+    plan = plan[: args.budget]
+    if not plan:
+        # A bare `pytest` run (no paths) would collect the whole repo and the
+        # gate would then pass having tested nothing.
+        raise SystemExit("no mutation sites in plan (empty budget or no sites)")
+    # Baseline: every owning suite must be green before mutating anything.
+    all_tests = sorted({t for _, tests, _, _, _ in plan for t in tests})
+    print(f"[mutation] baseline run: {' '.join(all_tests)}", flush=True)
+    if not run_tests(all_tests, cwd=repo, timeout=args.timeout * 2):
+        raise SystemExit("baseline test run failed; fix the suite first")
+
+    killed, survived = 0, []
+    t0 = time.monotonic()
+    for i, (path, tests, tree, sid, desc) in enumerate(plan, 1):
+        if str(path).startswith(str(REPO)):
+            check_clean(path)
+        original = path.read_text()
+        try:
+            path.write_text(mutate_source(tree, sid))
+            drop_pycache(path)
+            ok = run_tests(tests, cwd=repo, timeout=args.timeout)
+        except subprocess.TimeoutExpired:
+            ok = False  # infinite loop = detected = killed
+        finally:
+            path.write_text(original)
+        if ok:
+            survived.append(desc)
+            print(f"[mutation] {i}/{len(plan)} SURVIVED  {desc}", flush=True)
+        else:
+            killed += 1
+            print(f"[mutation] {i}/{len(plan)} killed    {desc}", flush=True)
+
+    total = killed + len(survived)
+    rate = killed / total if total else 1.0
+    print(
+        f"[mutation] {killed}/{total} killed ({rate:.0%}) in "
+        f"{time.monotonic() - t0:.0f}s; threshold {args.min_kill_rate:.0%}"
+    )
+    for desc in survived:
+        print(f"[mutation] survivor: {desc}")
+    return 0 if rate >= args.min_kill_rate else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
