@@ -113,3 +113,19 @@ def test_keystream_pallas_gate_defaults_off_on_cpu(monkeypatch):
     assert _use_pallas_circuit(8)
     monkeypatch.setenv("TIEREDSTORAGE_TPU_PALLAS", "0")
     assert not _use_pallas_circuit(1 << 20)
+
+
+def test_preflight_failure_degrades_to_xla_circuit(monkeypatch):
+    """A Mosaic lowering/runtime failure must disable the kernel, not raise:
+    the unattended round-end bench warms this path and an exception there
+    costs the whole artifact."""
+    from tieredstorage_tpu.ops import aes_bitsliced, aes_pallas
+
+    def boom(*a, **k):
+        raise RuntimeError("mosaic lowering failed")
+
+    monkeypatch.setattr(aes_pallas, "aes_encrypt_planes_pallas", boom)
+    monkeypatch.setattr(aes_bitsliced, "_PALLAS_PREFLIGHT", [])
+    assert aes_bitsliced._pallas_preflight_ok() is False
+    # Memoized: the second call must not retry (and not raise either).
+    assert aes_bitsliced._pallas_preflight_ok() is False

@@ -131,6 +131,36 @@ class TestLoadingCache:
         time.sleep(0.05)
         assert ("a", RemovalCause.EXPIRED) in removed
 
+    def test_exactly_at_capacity_evicts_nothing(self):
+        pool = ThreadPoolExecutor(2)
+        removed = []
+        cache = LoadingCache(
+            executor=pool, max_weight=10, weigher=len,
+            removal_listener=lambda k, v, c: removed.append((k, c)),
+        )
+        cache.get("a", lambda: "x" * 4, timeout=5)
+        cache.get("b", lambda: "y" * 6, timeout=5)  # total weight == max
+        time.sleep(0.05)
+        assert removed == []
+        assert cache.get_if_present("a") is not None
+        assert cache.get_if_present("b") is not None
+
+    def test_load_time_stat_is_a_sane_duration(self):
+        # total_load_time_ns accumulates (end - start); a sign slip there
+        # turns it into an absolute-clock-sized number.
+        pool = ThreadPoolExecutor(2)
+        cache = LoadingCache(executor=pool)
+        cache.get("ok", lambda: "v", timeout=5)
+        with pytest.raises(RuntimeError):
+            cache.get("boom", self._raise_runtime, timeout=5)
+        assert cache.stats.load_successes == 1
+        assert cache.stats.load_failures == 1
+        assert 0 <= cache.stats.total_load_time_ns < 60 * 10**9
+
+    @staticmethod
+    def _raise_runtime():
+        raise RuntimeError("boom")
+
     def test_load_failure_not_cached(self):
         pool = ThreadPoolExecutor(2)
         cache = LoadingCache(executor=pool)

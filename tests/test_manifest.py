@@ -68,6 +68,27 @@ class TestChunkSizesCodec:
         with pytest.raises(ValueError):
             encode_chunk_sizes([10, -1, 5])
 
+    def test_int32_max_is_accepted(self):
+        # The guard is strictly `> 0x7FFFFFFF`: INT32_MAX itself is legal in
+        # both the body and the last position.
+        values = [0x7FFFFFFF, 1, 0x7FFFFFFF]
+        assert decode_chunk_sizes(encode_chunk_sizes(values)) == values
+        with pytest.raises(ValueError):
+            encode_chunk_sizes([0x80000000, 1])
+        with pytest.raises(ValueError):
+            encode_chunk_sizes([1, 0x80000000])
+
+    @pytest.mark.parametrize("bpv_target", [2, 3, 4])
+    def test_bytes_per_value_steps_up_just_past_boundary(self, bpv_target):
+        # spread == 2^(8*(b-1)) no longer fits b-1 bytes; the encoder must
+        # step up to b, or decode returns corrupted sizes.
+        spread = 1 << (8 * (bpv_target - 1))
+        values = [100, 100 + spread, 50]
+        data = encode_chunk_sizes(values)
+        _, _, bpv = struct.unpack_from(">iiB", data, 0)
+        assert bpv == bpv_target
+        assert decode_chunk_sizes(data) == values
+
     def test_zero_values_are_valid(self):
         # 0 is a legal size (an empty final transformed chunk) — only
         # strictly negative values are rejected.

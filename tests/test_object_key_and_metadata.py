@@ -111,6 +111,32 @@ class TestVarint:
         write_varlong(-1, out)
         assert bytes(out) == b"\x01"  # zigzag(-1) = 1
 
+    def test_unbounded_read_drains_whole_stream(self):
+        from tieredstorage_tpu.utils.ratelimit import RateLimitedStream, TokenBucket
+
+        payload = bytes(range(256)) * 1000  # 256 000 B, > one 64 KiB chunk
+        stream = RateLimitedStream(io.BytesIO(payload), TokenBucket(10 << 20))
+        assert stream.read() == payload
+
+    def test_short_read_refunds_exactly_the_unused_tokens(self):
+        from tieredstorage_tpu.utils.ratelimit import RateLimitedStream, TokenBucket
+
+        class ShortReads(io.RawIOBase):
+            """Returns at most 100 bytes per read regardless of request."""
+
+            def readable(self):
+                return True
+
+            def read(self, size=-1):
+                return b"x" * min(size, 100)
+
+        bucket = TokenBucket(10 << 20)
+        stream = RateLimitedStream(ShortReads(), bucket)
+        assert stream.read(10_000) == b"x" * 100
+        # Consumed 10 000, refunded 9 900: ~100 tokens short of capacity
+        # (greedy refill may add back a sliver of drift, never 100's worth).
+        assert bucket._tokens <= bucket.capacity - 50
+
     def test_truncated_varint_raises_value_error(self):
         # Continuation bit set but the stream ends: must be a clean
         # ValueError (never an IndexError), including at pos == len(data).
@@ -176,6 +202,14 @@ class TestRecordBatchHeuristic:
         p.write_bytes(b"\x00" * 4)
         with pytest.raises(InvalidRecordBatchException):
             first_batch_compression_codec(p)
+
+    def test_exactly_legacy_header_len_is_readable(self, tmp_path):
+        # 18 bytes is a complete legacy header (magic + attributes present):
+        # the too-short guard is strictly `< 18`.
+        p = tmp_path / "exact.log"
+        p.write_bytes(struct.pack(">qiibb", 0, 100, 0, 1, 0x02))
+        assert len(p.read_bytes()) == 18
+        assert first_batch_compression_codec(p) == 2
 
     def test_bad_magic_rejected(self, tmp_path):
         p = tmp_path / "bad.log"

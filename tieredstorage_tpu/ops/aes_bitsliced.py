@@ -346,6 +346,43 @@ def rk_planes_from_round_keys(round_keys: jnp.ndarray) -> jnp.ndarray:
     return bits * jnp.uint32(0xFFFFFFFF)
 
 
+_PALLAS_PREFLIGHT: list[bool] = []  # memoized: does the kernel lower+run here?
+
+
+def _pallas_preflight_ok() -> bool:
+    """Compile and run the fused kernel once on a minimal tile.
+
+    A Mosaic lowering or runtime failure on this platform must degrade to
+    the XLA circuit, not take down the caller (the round-end benchmark runs
+    unattended; an exception during its jit warmup would cost the artifact)."""
+    if _PALLAS_PREFLIGHT:
+        return _PALLAS_PREFLIGHT[0]
+    try:
+        from tieredstorage_tpu.ops.aes_pallas import (
+            WORDS_PER_STEP,
+            aes_encrypt_planes_pallas,
+        )
+
+        rk = rk_planes_from_round_keys(jnp.asarray(key_expansion(bytes(range(32)))))
+        state = jnp.zeros((16, 8, WORDS_PER_STEP), jnp.uint32)
+        out = jax.block_until_ready(aes_encrypt_planes_pallas(rk, state))
+        # All input words are identical (zero), so EVERY output word must
+        # equal the XLA circuit's — a lane/tile-indexing bug anywhere in the
+        # step must fail the gate, not just one in word 0.
+        ref = jax.block_until_ready(aes_encrypt_planes(rk, state[:, :, :1]))
+        ok = bool(jnp.all(out == ref))
+    except Exception as exc:  # pragma: no cover - platform-specific
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "Pallas AES kernel unavailable on this platform, "
+            "falling back to the XLA circuit: %s", exc,
+        )
+        ok = False
+    _PALLAS_PREFLIGHT.append(ok)
+    return ok
+
+
 def _use_pallas_circuit(n_words: int) -> bool:
     """Route the cipher through the fused Pallas kernel on real TPUs.
 
@@ -354,7 +391,9 @@ def _use_pallas_circuit(n_words: int) -> bool:
     VMEM. CPU (tests, virtual meshes) keeps the XLA path — Mosaic interpret
     mode is orders slower to compile there. TIEREDSTORAGE_TPU_PALLAS=0/1
     overrides the gate, but is read at trace time: set it before the first
-    call for a given (batch, chunk) shape, or the cached executable wins."""
+    call for a given (batch, chunk) shape, or the cached executable wins.
+    First TPU use preflights the kernel on a minimal tile and falls back to
+    the XLA circuit if Mosaic can't lower or run it on this platform."""
     import os
 
     forced = os.environ.get("TIEREDSTORAGE_TPU_PALLAS")
@@ -363,9 +402,11 @@ def _use_pallas_circuit(n_words: int) -> bool:
     if n_words < 1024:  # one kernel step; smaller batches aren't worth a pad
         return False
     try:
-        return jax.default_backend() in ("tpu", "axon")
+        if jax.default_backend() not in ("tpu", "axon"):
+            return False
     except Exception:
         return False
+    return _pallas_preflight_ok()
 
 
 def ctr_keystream_batch(

@@ -43,6 +43,11 @@ DEFAULT_TARGETS = [
     ("tieredstorage_tpu/storage/core.py", ["tests/test_storage_backends.py"]),
     ("tieredstorage_tpu/utils/varint.py", ["tests/test_object_key_and_metadata.py"]),
     ("tieredstorage_tpu/object_key.py", ["tests/test_object_key_and_metadata.py"]),
+    ("tieredstorage_tpu/utils/ratelimit.py", ["tests/test_object_key_and_metadata.py"]),
+    ("tieredstorage_tpu/custom_metadata.py", ["tests/test_object_key_and_metadata.py"]),
+    ("tieredstorage_tpu/kafka_records.py", ["tests/test_object_key_and_metadata.py"]),
+    ("tieredstorage_tpu/utils/caching.py", ["tests/test_chunk_cache.py"]),
+    ("tieredstorage_tpu/fetch/enumeration.py", ["tests/test_rsm_lifecycle.py"]),
 ]
 
 _CMP_SWAP = {
@@ -179,10 +184,15 @@ def drop_pycache(path: Path) -> None:
             pass
 
 
-def check_clean(path: Path) -> None:
+def check_clean(path: Path, repo: Path) -> None:
+    """Refuse to mutate a file with uncommitted changes (mutants rewrite it
+    in place; a crash between write and restore would lose the edits).
+
+    Runs in the target repo, not the harness's install location, so --repo
+    runs are guarded too. A non-git target (e.g. the self-test's tmp dir)
+    has nothing to lose to a restore failure, so it's exempt."""
     proc = subprocess.run(
-        ["git", "status", "--porcelain", "--", str(path)],
-        cwd=REPO,
+        ["git", "-C", str(repo), "status", "--porcelain", "--", str(path)],
         capture_output=True,
         text=True,
     )
@@ -238,14 +248,20 @@ def main() -> int:
     # Baseline: every owning suite must be green before mutating anything.
     all_tests = sorted({t for _, tests, _, _, _ in plan for t in tests})
     print(f"[mutation] baseline run: {' '.join(all_tests)}", flush=True)
-    if not run_tests(all_tests, cwd=repo, timeout=args.timeout * 2):
+    try:
+        baseline_ok = run_tests(all_tests, cwd=repo, timeout=args.timeout * 2)
+    except subprocess.TimeoutExpired:
+        raise SystemExit(
+            f"baseline test run exceeded {args.timeout * 2}s; "
+            "raise --timeout or trim the targets"
+        ) from None
+    if not baseline_ok:
         raise SystemExit("baseline test run failed; fix the suite first")
 
     killed, survived = 0, []
     t0 = time.monotonic()
     for i, (path, tests, tree, sid, desc) in enumerate(plan, 1):
-        if str(path).startswith(str(REPO)):
-            check_clean(path)
+        check_clean(path, repo)
         original = path.read_text()
         try:
             path.write_text(mutate_source(tree, sid))

@@ -1,0 +1,105 @@
+"""Round-3 attribution: device-resident marginal GiB/s of every GCM stage,
+with the Pallas AES kernel and the XLA circuit side by side.
+
+Extends tools/profile_marginal.py (round-2 numbers in PROFILE.md): the same
+floor-subtracted two-size slope method, plus the fused Pallas circuit
+(ops/aes_pallas.py) measured directly against the XLA lowering it replaces,
+and the grouped-power GHASH. `ctr(dflt)` minus `circuit_pl` isolates the
+plane pack/unpack cost that still runs in XLA around the kernel.
+
+Usage: PYTHONPATH=. python tools/profile_r3.py [small_MiB large_MiB [chunk_MiB]]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tieredstorage_tpu.ops import gcm
+from tieredstorage_tpu.ops.aes_bitsliced import (
+    aes_encrypt_planes,
+    ctr_keystream_batch,
+    rk_planes_from_round_keys,
+)
+from tieredstorage_tpu.ops.aes_pallas import WORDS_PER_STEP, aes_encrypt_planes_pallas
+
+err = lambda *a: print(*a, file=sys.stderr, flush=True)
+
+
+def t(fn, *args, iters=3, warmup=1):
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def run(total_mib: int, chunk_mib: int = 4) -> dict[str, float]:
+    chunk_bytes = chunk_mib << 20
+    batch = (total_mib << 20) // chunk_bytes
+    if batch < 1:
+        raise SystemExit(f"total {total_mib} MiB < one {chunk_mib} MiB chunk")
+    ctx = gcm.make_context(bytes(range(32)), b"aad", chunk_bytes)
+    rng = np.random.default_rng(0)
+    materialize = jax.jit(lambda x: x ^ np.uint8(1))
+    data = jax.block_until_ready(
+        materialize(jax.device_put(rng.integers(0, 256, (batch, chunk_bytes), np.uint8)))
+    )
+    ivs = jax.block_until_ready(
+        materialize(jax.device_put(rng.integers(0, 256, (batch, 12), np.uint8)))
+    )
+    rk, lm, fm, cb = gcm._device_consts(ctx)
+    n_blocks = ctx.n_blocks
+
+    out = {}
+    full = jax.jit(
+        lambda r, i, d: gcm._gcm_process_batch(
+            r, i, d, lm, fm, cb,
+            chunk_bytes=chunk_bytes, n_blocks=n_blocks, decrypt=False,
+        )
+    )
+    out["full"] = t(full, rk, ivs, data)
+    out["ctr(dflt)"] = t(
+        jax.jit(lambda r, i: ctr_keystream_batch(r, i, 1, n_blocks + 1)), rk, ivs
+    )
+
+    # The two circuit implementations on identical pre-packed planes.
+    w = batch * ((n_blocks + 1 + 31) // 32)
+    w_pad = -(-w // WORDS_PER_STEP) * WORDS_PER_STEP
+    planes = jax.block_until_ready(
+        materialize(
+            jax.device_put(
+                rng.integers(0, 2**32, (16, 8, w_pad), np.uint32).view(np.uint8)
+            )
+        ).view(jnp.uint32)
+    )
+    rkp = jax.block_until_ready(jax.jit(rk_planes_from_round_keys)(jnp.asarray(rk)))
+    out["circuit_xla"] = t(jax.jit(aes_encrypt_planes), rkp, planes)
+    if jax.default_backend() != "cpu":  # interpret mode is orders slower; skip
+        out["circuit_pl"] = t(aes_encrypt_planes_pallas, rkp, planes)
+    out["ghash"] = t(jax.jit(lambda d: gcm._ghash_of_ct(d, lm, fm, cb)), data)
+    return out
+
+
+def main() -> None:
+    a_mib = int(sys.argv[1]) if len(sys.argv) > 1 else 32
+    b_mib = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+    chunk_mib = int(sys.argv[3]) if len(sys.argv) > 3 else 4
+    err(f"[profile_r3] platform={jax.default_backend()} devices={jax.devices()}")
+    ra, rb = run(a_mib, chunk_mib), run(b_mib, chunk_mib)
+    err(f"{'stage':12s} {a_mib:4d}MiB(ms) {b_mib:4d}MiB(ms)  marginal GiB/s")
+    for k in ra:
+        slope = (rb[k] - ra[k]) / ((b_mib - a_mib) / 1024)
+        g = 1 / slope if slope > 0 else float("inf")
+        err(f"{k:12s} {ra[k]*1e3:10.1f} {rb[k]*1e3:10.1f} {g:10.2f}")
+
+
+if __name__ == "__main__":
+    main()
