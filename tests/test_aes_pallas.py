@@ -129,3 +129,29 @@ def test_preflight_failure_degrades_to_xla_circuit(monkeypatch):
     assert aes_bitsliced._pallas_preflight_ok() is False
     # Memoized: the second call must not retry (and not raise either).
     assert aes_bitsliced._pallas_preflight_ok() is False
+
+
+def test_preflight_works_under_a_jit_trace(monkeypatch):
+    """The gate is consulted while the caller's jit is TRACING; omnistaging
+    must not turn the verdict into a TracerBoolConversionError that the
+    except-clause memoizes as a permanent False on healthy TPUs."""
+    from tieredstorage_tpu.ops import aes_bitsliced, aes_pallas
+
+    # Stand-in "kernel" that is definitionally correct (the XLA circuit),
+    # so a healthy platform must yield ok=True even mid-trace.
+    monkeypatch.setattr(
+        aes_pallas,
+        "aes_encrypt_planes_pallas",
+        lambda rk, state, **kw: aes_bitsliced.aes_encrypt_planes(rk, state),
+    )
+    monkeypatch.setattr(aes_bitsliced, "_PALLAS_PREFLIGHT", [])
+
+    verdicts = []
+
+    @jax.jit
+    def traced(x):
+        verdicts.append(aes_bitsliced._pallas_preflight_ok())
+        return x + 1
+
+    traced(jnp.zeros(4))
+    assert verdicts == [True]

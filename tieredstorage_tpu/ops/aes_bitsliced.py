@@ -363,14 +363,22 @@ def _pallas_preflight_ok() -> bool:
             aes_encrypt_planes_pallas,
         )
 
-        rk = rk_planes_from_round_keys(jnp.asarray(key_expansion(bytes(range(32)))))
-        state = jnp.zeros((16, 8, WORDS_PER_STEP), jnp.uint32)
-        out = jax.block_until_ready(aes_encrypt_planes_pallas(rk, state))
-        # All input words are identical (zero), so EVERY output word must
-        # equal the XLA circuit's — a lane/tile-indexing bug anywhere in the
-        # step must fail the gate, not just one in word 0.
-        ref = jax.block_until_ready(aes_encrypt_planes(rk, state[:, :, :1]))
-        ok = bool(jnp.all(out == ref))
+        # The gate is consulted at TRACE time (ctr_keystream_batch runs
+        # under the caller's jit), where omnistaging would turn these
+        # constants into tracers and the bool() below into a
+        # TracerBoolConversionError — which the except would memoize as a
+        # permanent False on perfectly healthy TPUs. Force eager evaluation.
+        with jax.ensure_compile_time_eval():
+            rk = rk_planes_from_round_keys(
+                jnp.asarray(key_expansion(bytes(range(32))))
+            )
+            state = jnp.zeros((16, 8, WORDS_PER_STEP), jnp.uint32)
+            out = jax.block_until_ready(aes_encrypt_planes_pallas(rk, state))
+            # All input words are identical (zero), so EVERY output word
+            # must equal the XLA circuit's — a lane/tile-indexing bug
+            # anywhere in the step must fail the gate, not just word 0.
+            ref = jax.block_until_ready(aes_encrypt_planes(rk, state[:, :, :1]))
+            ok = bool(jnp.all(out == ref))
     except Exception as exc:  # pragma: no cover - platform-specific
         import logging
 
