@@ -1,0 +1,75 @@
+"""Variable-length GCM under shard_map: the production upload path
+(compress → varlen encrypt) sharded over the data mesh, with the per-row
+transformed sizes all-gathered as the chunk-index build requires
+(SURVEY.md §7 step 5). The fixed-size mesh path is covered by the official
+`__graft_entry__.dryrun_multichip`; this pins the varlen core the transform
+backend actually uses when compression is on (`transform/tpu.py`)."""
+
+from __future__ import annotations
+
+import secrets
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from tieredstorage_tpu.ops import gcm  # noqa: E402
+from tieredstorage_tpu.parallel.mesh import DATA_AXIS, data_mesh  # noqa: E402
+from tieredstorage_tpu.security.aes import IV_SIZE, TAG_SIZE  # noqa: E402
+
+
+def test_sharded_varlen_encrypt_matches_single_device():
+    mesh = data_mesh(8)
+    batch = 16  # 2 rows per device
+    key = secrets.token_bytes(32)
+    aad = secrets.token_bytes(32)
+    rng = np.random.default_rng(5)
+    lengths = rng.integers(1, 900, batch).astype(np.int32)
+    ctx = gcm.make_varlen_context(key, aad, int(lengths.max()))
+    data = np.zeros((batch, ctx.max_bytes), np.uint8)
+    for i, l in enumerate(lengths):
+        data[i, :l] = rng.integers(0, 256, l, dtype=np.uint8)
+    ivs = rng.integers(0, 256, (batch, 12), dtype=np.uint8)
+    len_blocks = gcm._host_len_blocks(ctx, lengths)
+
+    consts = gcm._device_consts(ctx)
+    round_keys, aad_blocks, agg_mats, h_mat = consts
+
+    def shard_step(iv, d, ln, lb):
+        ct, tags = gcm._gcm_varlen_batch(
+            round_keys, iv, d, ln, lb, aad_blocks, agg_mats, h_mat,
+            max_bytes=ctx.max_bytes, m_max=ctx.m_max,
+            m_a=ctx.aad_blocks.shape[0], m_cap=ctx.m_cap, decrypt=False,
+        )
+        # Chunk-index collective: every chip needs every row's transformed
+        # size (IV || ct || tag) to place chunks in the segment object.
+        sizes = jnp.int32(IV_SIZE + TAG_SIZE) + ln
+        all_sizes = jax.lax.all_gather(sizes, DATA_AXIS, tiled=True)
+        total = jax.lax.psum(jnp.sum(sizes), DATA_AXIS)
+        return ct, tags, all_sizes, total
+
+    row = P(DATA_AXIS)
+    row2 = P(DATA_AXIS, None)
+    step = jax.jit(
+        jax.shard_map(
+            shard_step,
+            mesh=mesh,
+            in_specs=(row2, row2, row, row2),
+            out_specs=(row2, row2, P(None), P()),
+            check_vma=False,
+        )
+    )
+    put = lambda a, s: jax.device_put(a, NamedSharding(mesh, s))
+    ct_s, tags_s, all_sizes, total = step(
+        put(ivs, row2), put(data, row2), put(lengths, row), put(len_blocks, row2)
+    )
+
+    ct_1, tags_1 = gcm.gcm_encrypt_varlen(ctx, ivs, data, lengths)
+    np.testing.assert_array_equal(np.asarray(ct_s), np.asarray(ct_1))
+    np.testing.assert_array_equal(np.asarray(tags_s), np.asarray(tags_1))
+    expected_sizes = IV_SIZE + TAG_SIZE + lengths
+    np.testing.assert_array_equal(np.asarray(all_sizes), expected_sizes)
+    assert int(total) == int(expected_sizes.sum())

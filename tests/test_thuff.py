@@ -227,6 +227,34 @@ class TestRoundTrip:
         with pytest.raises(ThuffFormatError, match="block boundary"):
             decompress_batch([bytes(f)])
 
+    def test_partial_final_block_desync_detected(self):
+        """A frame whose only block is partial has no next-jump boundary to
+        check; the decoded code lengths must instead sum to total_bits.
+        Shifting the jump entry desyncs the scan and the sum moves."""
+        data = (b"abcdefgh" * 400)[:3000]  # one partial block
+        frames = compress_batch([data])
+        assert not frames[0][3] & 0x01
+        f = bytearray(frames[0])
+        off = 8 + 6 + 128  # jump[0]
+        struct.pack_into("<I", f, off, struct.unpack_from("<I", f, off)[0] + 1)
+        with pytest.raises(ThuffFormatError, match="final block"):
+            decompress_batch([bytes(f)])
+
+    def test_partial_final_block_bits_mismatch_detected(self):
+        """Inflating the declared total_bits of a partial-block frame must
+        fail the final-block end check, not silently decode."""
+        data = (b"the quick brown fox " * 200)[:3000]
+        frames = compress_batch([data])
+        assert not frames[0][3] & 0x01
+        f = bytearray(frames[0])
+        bits = struct.unpack_from("<I", f, 8)[0]
+        struct.pack_into("<I", f, 8, bits + 7)
+        # Keep the payload-word count consistent with the inflated bits so
+        # the truncation guard doesn't fire first.
+        f += b"\x00\x00\x00\x00"
+        with pytest.raises(ThuffFormatError, match="final block"):
+            decompress_batch([bytes(f)])
+
     def test_chunk_over_format_limit_rejected(self):
         from tieredstorage_tpu.ops.huffman import MAX_CHUNK_BYTES
 

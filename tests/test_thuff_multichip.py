@@ -15,6 +15,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
 from tieredstorage_tpu.ops.huffman import encode_batch  # noqa: E402
 from tieredstorage_tpu.parallel.mesh import DATA_AXIS, data_mesh  # noqa: E402
 from tieredstorage_tpu.transform.thuff import (  # noqa: E402
+    assemble_frame,
     compress_batch,
     decompress_batch,
     encode_tables,
@@ -84,14 +85,39 @@ def test_sharded_encode_matches_single_device_and_gathers_sizes():
 
 
 def test_sharded_frames_round_trip_through_the_codec():
-    # Frames assembled from mesh-computed outputs must decode with the
+    # Frames assembled from MESH-computed outputs must decode with the
     # standard (single-device) decompress path — proving chips can encode
     # independently while any host reads the result.
-    chunks = [
-        (np.random.default_rng(i).integers(0, 256, 3000, dtype=np.uint8) % 17)
-        .astype(np.uint8).tobytes()
-        for i in range(16)
+    mesh = data_mesh(8)
+    n_max = 4096
+    batch = 16
+    rng = np.random.default_rng(21)
+    data, n_sym, codes_rev, lengths = _make_rows(batch, n_max, rng)
+
+    step = jax.jit(
+        jax.shard_map(
+            lambda d, n, c, l: encode_batch(d, n, c, l, n_max=n_max),
+            mesh=mesh,
+            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS, None), P(DATA_AXIS, None)),
+            out_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS, None)),
+            check_vma=False,
+        )
+    )
+    args = [
+        jax.device_put(a, NamedSharding(mesh, s))
+        for a, s in zip(
+            (data, n_sym, codes_rev, lengths),
+            (P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS, None), P(DATA_AXIS, None)),
+        )
     ]
-    frames = compress_batch(chunks)  # single-device reference path
+    words, total_bits, jump = (np.asarray(x) for x in step(*args))
+
+    chunks = [data[r, : n_sym[r]].tobytes() for r in range(batch)]
+    frames = [
+        assemble_frame(chunks[r], lengths[r], jump[r], words[r], int(total_bits[r]))
+        for r in range(batch)
+    ]
     assert decompress_batch(frames) == chunks
     assert sum(len(f) for f in frames) < sum(len(c) for c in chunks)
+    # The reference single-device path produces byte-identical frames.
+    assert frames == compress_batch(chunks)

@@ -198,20 +198,32 @@ def compress_batch(chunks: list[bytes]) -> list[bytes]:
     jump = np.asarray(jump)
 
     for row, (i, c) in enumerate(live):
-        bits = int(total_bits[row])
-        n_words = _ceil_div(bits, 32)
-        n_jump = _ceil_div(len(c), JUMP_BLOCK)
-        body = (
-            struct.pack("<IH", bits, n_jump)
-            + _pack_lengths(lengths[row])
-            + jump[row, :n_jump].astype("<u4").tobytes()
-            + words[row, :n_words].astype("<u4").tobytes()
+        out[i] = assemble_frame(
+            c, lengths[row], jump[row], words[row], int(total_bits[row])
         )
-        if len(body) + _HEADER.size >= len(c) + _HEADER.size:
-            out[i] = _HEADER.pack(_MAGIC, _VERSION, _FLAG_RAW, len(c)) + c
-        else:
-            out[i] = _HEADER.pack(_MAGIC, _VERSION, 0, len(c)) + body
     return out
+
+
+def assemble_frame(
+    chunk: bytes,
+    lengths: np.ndarray,
+    jump: np.ndarray,
+    words: np.ndarray,
+    total_bits: int,
+) -> bytes:
+    """Build one v1 frame from the device encoder's per-row outputs
+    (`ops.huffman.encode_batch`), falling back to RAW when coding loses."""
+    n_words = _ceil_div(total_bits, 32)
+    n_jump = _ceil_div(len(chunk), JUMP_BLOCK)
+    body = (
+        struct.pack("<IH", total_bits, n_jump)
+        + _pack_lengths(np.asarray(lengths))
+        + np.asarray(jump)[:n_jump].astype("<u4").tobytes()
+        + np.asarray(words)[:n_words].astype("<u4").tobytes()
+    )
+    if len(body) >= len(chunk):
+        return _HEADER.pack(_MAGIC, _VERSION, _FLAG_RAW, len(chunk)) + chunk
+    return _HEADER.pack(_MAGIC, _VERSION, 0, len(chunk)) + body
 
 
 def decompress_batch(
@@ -304,6 +316,23 @@ def decompress_batch(
             raise ThuffFormatError(
                 f"corrupt payload in frame {i}: block boundary mismatch"
             )
+        rem = orig_len % JUMP_BLOCK
+        if rem:
+            # Partial final block: the decoder scans past the true last
+            # symbol, so final_bitpos can't be compared directly — but the
+            # decoded symbols' code lengths pin where the real stream must
+            # end. A desynced tail lands on a different total (same-length
+            # symbol substitutions are the residual blind spot, as for the
+            # full-block check; integrity with an adversary is the
+            # encryption layer's tag, not this codec's).
+            last = (len(jump) - 1) * JUMP_BLOCK
+            tail = decoded[row, last : last + rem].astype(np.int64)
+            end = int(jump[-1]) + int(lens[tail].sum())
+            if end != bits:
+                raise ThuffFormatError(
+                    f"corrupt payload in frame {i}: final block ends at bit "
+                    f"{end}, frame declares {bits}"
+                )
         out[i] = decoded[row, :orig_len].tobytes()
     return [b if b is not None else b"" for b in out]
 
