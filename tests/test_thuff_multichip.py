@@ -41,37 +41,44 @@ def _make_rows(batch: int, n_max: int, rng) -> tuple[np.ndarray, ...]:
     return data, n_sym, codes_rev, lengths
 
 
+def _mesh_encode(mesh, data, n_sym, codes_rev, lengths, *, n_max, gather_sizes):
+    """Run encode_batch under shard_map over row-sharded inputs; optionally
+    all_gather the per-row bit counts (the chunk-index size collective)."""
+
+    def shard_step(d, n, c, l):
+        words, total_bits, jump = encode_batch(d, n, c, l, n_max=n_max)
+        if not gather_sizes:
+            return words, total_bits, jump
+        all_bits = jax.lax.all_gather(total_bits, DATA_AXIS, tiled=True)
+        return words, total_bits, jump, all_bits
+
+    row, row2 = P(DATA_AXIS), P(DATA_AXIS, None)
+    out_specs = (row2, row, row2) + ((P(None),) if gather_sizes else ())
+    step = jax.jit(
+        jax.shard_map(
+            shard_step,
+            mesh=mesh,
+            in_specs=(row2, row, row2, row2),
+            out_specs=out_specs,
+            check_vma=False,
+        )
+    )
+    args = [
+        jax.device_put(a, NamedSharding(mesh, s))
+        for a, s in zip((data, n_sym, codes_rev, lengths), (row2, row, row2, row2))
+    ]
+    return step(*args)
+
+
 def test_sharded_encode_matches_single_device_and_gathers_sizes():
     mesh = data_mesh(8)
     n_max = 4096
     batch = 16  # 2 rows per device
     rng = np.random.default_rng(7)
     data, n_sym, codes_rev, lengths = _make_rows(batch, n_max, rng)
-
-    def shard_step(d, n, c, l):
-        words, total_bits, jump = encode_batch(d, n, c, l, n_max=n_max)
-        # The chunk-index collective: every chip needs every row's
-        # transformed size (bit count) to build the segment's index.
-        all_bits = jax.lax.all_gather(total_bits, DATA_AXIS, tiled=True)
-        return words, total_bits, jump, all_bits
-
-    step = jax.jit(
-        jax.shard_map(
-            shard_step,
-            mesh=mesh,
-            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS, None), P(DATA_AXIS, None)),
-            out_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS, None), P(None)),
-            check_vma=False,
-        )
+    words_s, bits_s, jump_s, all_bits = _mesh_encode(
+        mesh, data, n_sym, codes_rev, lengths, n_max=n_max, gather_sizes=True
     )
-    args = [
-        jax.device_put(a, NamedSharding(mesh, s))
-        for a, s in zip(
-            (data, n_sym, codes_rev, lengths),
-            (P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS, None), P(DATA_AXIS, None)),
-        )
-    ]
-    words_s, bits_s, jump_s, all_bits = step(*args)
 
     words_1, bits_1, jump_1 = encode_batch(
         jnp.asarray(data), jnp.asarray(n_sym), jnp.asarray(codes_rev),
@@ -93,24 +100,12 @@ def test_sharded_frames_round_trip_through_the_codec():
     batch = 16
     rng = np.random.default_rng(21)
     data, n_sym, codes_rev, lengths = _make_rows(batch, n_max, rng)
-
-    step = jax.jit(
-        jax.shard_map(
-            lambda d, n, c, l: encode_batch(d, n, c, l, n_max=n_max),
-            mesh=mesh,
-            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS, None), P(DATA_AXIS, None)),
-            out_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS, None)),
-            check_vma=False,
+    words, total_bits, jump = (
+        np.asarray(x)
+        for x in _mesh_encode(
+            mesh, data, n_sym, codes_rev, lengths, n_max=n_max, gather_sizes=False
         )
     )
-    args = [
-        jax.device_put(a, NamedSharding(mesh, s))
-        for a, s in zip(
-            (data, n_sym, codes_rev, lengths),
-            (P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS, None), P(DATA_AXIS, None)),
-        )
-    ]
-    words, total_bits, jump = (np.asarray(x) for x in step(*args))
 
     chunks = [data[r, : n_sym[r]].tobytes() for r in range(batch)]
     frames = [
