@@ -234,18 +234,18 @@ def _ranged_fetch_measured(root, chunks: list[bytes], chunk_bytes: int) -> dict:
         "fetch.chunk.cache.size": 1 << 30,
         "fetch.chunk.cache.prefetch.max.size": 16 << 20,
     })
-    tip = TopicIdPartition(KafkaUuid.random(), TopicPartition("bench", 0))
-    meta = RemoteLogSegmentMetadata(
-        RemoteLogSegmentId(tip, KafkaUuid.random()), 0, 1,
-        segment_size_in_bytes=len(segment),
-    )
-    rsm.copy_log_segment_data(
-        meta,
-        LogSegmentData(seg_path, root / "off.idx", root / "time.idx",
-                       root / "prod.idx", None, b"bench"),
-    )
-
     try:
+        tip = TopicIdPartition(KafkaUuid.random(), TopicPartition("bench", 0))
+        meta = RemoteLogSegmentMetadata(
+            RemoteLogSegmentId(tip, KafkaUuid.random()), 0, 1,
+            segment_size_in_bytes=len(segment),
+        )
+        rsm.copy_log_segment_data(
+            meta,
+            LogSegmentData(seg_path, root / "off.idx", root / "time.idx",
+                           root / "prod.idx", None, b"bench"),
+        )
+
         rng = np.random.default_rng(3)
         read_bytes = 64 << 10
         lat_ms = []

@@ -118,6 +118,55 @@ def test_strong_suite_kills_mutants_and_restores_file(tmp_path):
     assert (tmp_path / "mod.py").read_text() == before  # restored
 
 
+def test_sigterm_mid_mutant_restores_the_file(tmp_path):
+    """Killing the harness while a mutant is applied must not leave the
+    mutated source in the tree (observed in practice: a stopped sweep left
+    an ast-rewritten file behind before this hook existed)."""
+    import signal
+    import time
+
+    (tmp_path / "mod.py").write_text(SRC)
+    # Baseline must stay fast: sleep (holding the mutant window open for the
+    # SIGTERM) only when some behavior differs, i.e. a mutant is active.
+    # Every mutable site in SRC changes one of these outputs.
+    (tmp_path / "test_mod.py").write_text(
+        "import time\nimport mod\n"
+        "def test_slow():\n"
+        "    mutated = (mod.sign(0) != 0 or mod.sign(2) != 1\n"
+        "               or mod.sign(-2) != -1 or mod.total([1, 2]) != 3)\n"
+        "    if mutated:\n"
+        "        time.sleep(60)\n"
+        "    assert not mutated\n"
+    )
+    before = (tmp_path / "mod.py").read_text()
+    proc = subprocess.Popen(
+        [
+            sys.executable, str(HARNESS),
+            "--module", "mod.py", "--tests", "test_mod.py",
+            "--repo", str(tmp_path), "--budget", "1", "--timeout", "120",
+        ],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    try:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if (tmp_path / "mod.py").read_text() != before:
+                break  # mutant is on disk
+            if proc.poll() is not None:
+                raise AssertionError("harness exited before applying a mutant")
+            time.sleep(0.1)
+        else:
+            raise AssertionError("mutant never applied")
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=30) != 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    assert (tmp_path / "mod.py").read_text() == before
+
+
 def test_weak_suite_fails_the_gate(tmp_path):
     _write_project(tmp_path, weak=True)
     proc = _run(tmp_path, ["--budget", "3", "--min-kill-rate", "0.9"])

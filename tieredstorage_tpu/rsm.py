@@ -405,6 +405,18 @@ class RemoteStorageManager:
         start_position: int,
         end_position: Optional[int] = None,
     ) -> BinaryIO:
+        """Ranged read of the original segment bytes as a lazy stream.
+
+        Cancellation note: the reference special-cases Java thread
+        interrupts mid-fetch and returns an empty stream instead of erroring
+        (RemoteStorageManager.java:563-592), because Kafka's fetch threads
+        cancel in-flight reads routinely. This runtime gets the same
+        property structurally: the returned stream is lazy
+        (FetchChunkEnumeration fetches chunk N+1 only when the consumer
+        reads past chunk N, and close() stops the enumeration early), so an
+        abandoned read costs nothing and raises nothing; over the gRPC
+        sidecar boundary a cancelled RPC simply stops draining the stream.
+        """
         config = self._require_configured()
         if start_position < 0:
             raise ValueError(f"startPosition must be non-negative, {start_position} given")

@@ -25,9 +25,11 @@ from __future__ import annotations
 
 import argparse
 import ast
+import atexit
 import copy
 import os
 import random
+import signal
 import subprocess
 import sys
 import time
@@ -175,6 +177,33 @@ def run_tests(test_files: list[str], *, cwd: Path, timeout: int) -> bool:
     return proc.returncode == 0
 
 
+#: (path, original_source) of the mutant currently applied on disk, if any.
+#: SIGTERM/SIGINT or interpreter exit mid-mutant must restore it — a killed
+#: harness must never leave a mutated file in the working tree.
+_IN_FLIGHT: list[tuple[Path, str]] = []
+
+
+def _restore_in_flight(*_sig) -> None:
+    while _IN_FLIGHT:
+        path, original = _IN_FLIGHT.pop()
+        try:
+            path.write_text(original)
+            drop_pycache(path)
+        except OSError:
+            print(f"[mutation] FAILED to restore {path}", file=sys.stderr)
+    if _sig:  # invoked as a signal handler: exit after restoring
+        raise SystemExit(128 + _sig[0])
+
+
+def _install_restore_hooks() -> None:
+    atexit.register(_restore_in_flight)
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, _restore_in_flight)
+        except (ValueError, OSError):
+            pass  # non-main thread / unsupported platform
+
+
 def drop_pycache(path: Path) -> None:
     """Remove cached bytecode for a module about to be mutated in place."""
     for pyc in (path.parent / "__pycache__").glob(f"{path.stem}.*.pyc"):
@@ -241,6 +270,7 @@ def main() -> int:
 
     rng.shuffle(plan)
     plan = plan[: args.budget]
+    _install_restore_hooks()
     if not plan:
         # A bare `pytest` run (no paths) would collect the whole repo and the
         # gate would then pass having tested nothing.
@@ -263,6 +293,7 @@ def main() -> int:
     for i, (path, tests, tree, sid, desc) in enumerate(plan, 1):
         check_clean(path, repo)
         original = path.read_text()
+        _IN_FLIGHT.append((path, original))
         try:
             path.write_text(mutate_source(tree, sid))
             drop_pycache(path)
@@ -271,6 +302,8 @@ def main() -> int:
             ok = False  # infinite loop = detected = killed
         finally:
             path.write_text(original)
+            drop_pycache(path)
+            _IN_FLIGHT.clear()
         if ok:
             survived.append(desc)
             print(f"[mutation] {i}/{len(plan)} SURVIVED  {desc}", flush=True)
