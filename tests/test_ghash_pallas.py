@@ -1,0 +1,122 @@
+"""Pallas GHASH level-1 kernel: bit-exactness against the XLA plane path and
+a numpy mod-2 reference (interpret mode on CPU), plus the platform gate."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from tieredstorage_tpu.ops import gcm, ghash_pallas  # noqa: E402
+from tieredstorage_tpu.ops.ghash_pallas import (  # noqa: E402
+    ROWS_PER_STEP,
+    ghash_level1_pallas,
+    use_pallas_ghash,
+)
+
+
+def _numpy_level1(data: np.ndarray, w1: np.ndarray) -> np.ndarray:
+    planes = np.stack([(data >> p) & 1 for p in range(8)]).astype(np.int64)
+    return (np.einsum("prk,pko->ro", planes, w1.astype(np.int64)) & 1).astype(np.int8)
+
+
+def test_kernel_matches_numpy_reference_single_step():
+    rng = np.random.default_rng(1)
+    k = 256
+    data = rng.integers(0, 256, (ROWS_PER_STEP, k), dtype=np.uint8)
+    w1 = rng.integers(0, 2, (8, k, 128), dtype=np.int8)
+    got = np.asarray(
+        ghash_level1_pallas(jnp.asarray(data), jnp.asarray(w1), interpret=True)
+    )
+    np.testing.assert_array_equal(got, _numpy_level1(data, w1))
+
+
+def test_kernel_matches_numpy_reference_multi_step():
+    rng = np.random.default_rng(2)
+    k = 128
+    rows = 3 * ROWS_PER_STEP
+    data = rng.integers(0, 256, (rows, k), dtype=np.uint8)
+    w1 = rng.integers(0, 2, (8, k, 128), dtype=np.int8)
+    got = np.asarray(
+        ghash_level1_pallas(jnp.asarray(data), jnp.asarray(w1), interpret=True)
+    )
+    np.testing.assert_array_equal(got, _numpy_level1(data, w1))
+
+
+def test_kernel_rejects_bad_shapes():
+    data = jnp.zeros((ROWS_PER_STEP + 1, 128), jnp.uint8)
+    w1 = jnp.zeros((8, 128, 128), jnp.int8)
+    with pytest.raises(ValueError, match="multiple"):
+        ghash_level1_pallas(data, w1, interpret=True)
+    with pytest.raises(ValueError, match="weights"):
+        ghash_level1_pallas(
+            jnp.zeros((ROWS_PER_STEP, 256), jnp.uint8), w1, interpret=True
+        )
+
+
+def test_gate_defaults_off_on_cpu(monkeypatch):
+    monkeypatch.delenv("TIEREDSTORAGE_TPU_PALLAS_GHASH", raising=False)
+    assert jax.default_backend() == "cpu"
+    assert not use_pallas_ghash(1 << 20, 2048)
+    monkeypatch.setenv("TIEREDSTORAGE_TPU_PALLAS_GHASH", "1")
+    assert use_pallas_ghash(ROWS_PER_STEP, 256)
+    # Forcing overrides platform/preflight, never shape validity.
+    assert not use_pallas_ghash(8, 8)
+    monkeypatch.setenv("TIEREDSTORAGE_TPU_PALLAS_GHASH", "0")
+    assert not use_pallas_ghash(1 << 20, 2048)
+
+
+def test_gate_requires_tiled_shapes(monkeypatch):
+    monkeypatch.delenv("TIEREDSTORAGE_TPU_PALLAS_GHASH", raising=False)
+    # Un-tiled K or a sub-step row count must never reach the kernel,
+    # whatever the platform says.
+    assert not use_pallas_ghash(1 << 20, 2048 + 64)
+    assert not use_pallas_ghash(ROWS_PER_STEP - 1, 2048)
+
+
+def test_preflight_failure_degrades_gracefully(monkeypatch):
+    monkeypatch.setattr(ghash_pallas, "_PREFLIGHT", [])
+    monkeypatch.setattr(
+        ghash_pallas,
+        "ghash_level1_pallas",
+        lambda *a, **k: (_ for _ in ()).throw(RuntimeError("mosaic failed")),
+    )
+    assert ghash_pallas._preflight_ok() is False
+    assert ghash_pallas._preflight_ok() is False  # memoized, no retry
+
+
+def test_forced_integrated_path_matches_xla(monkeypatch):
+    """The full grouped-GHASH with the kernel forced on (interpret mode)
+    must produce the same node bits as the XLA plane path — through the
+    public tag computation, over a multi-level tree."""
+    import secrets
+
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+    key = secrets.token_bytes(32)
+    aad = secrets.token_bytes(16)
+    chunk_bytes = 8192  # m=512 blocks: two grouped levels
+    ctx = gcm.make_context(key, aad, chunk_bytes)
+    rng = np.random.default_rng(3)
+    # Enough rows to clear the ROWS_PER_STEP gate floor with k1 dividing in.
+    batch = 80
+    data = rng.integers(0, 256, (batch, chunk_bytes), dtype=np.uint8)
+    ivs = rng.integers(0, 256, (batch, 12), dtype=np.uint8)
+
+    monkeypatch.setenv("TIEREDSTORAGE_TPU_PALLAS_GHASH", "1")
+    gcm._gcm_process_batch.clear_cache()
+    try:
+        ct_f, tags_f = (
+            np.asarray(a) for a in gcm.gcm_encrypt_chunks(ctx, ivs, data)
+        )
+    finally:
+        monkeypatch.setenv("TIEREDSTORAGE_TPU_PALLAS_GHASH", "0")
+        gcm._gcm_process_batch.clear_cache()
+
+    oracle = AESGCM(key)
+    for i in (0, batch // 2, batch - 1):
+        expected = oracle.encrypt(ivs[i].tobytes(), data[i].tobytes(), aad)
+        assert ct_f[i].tobytes() == expected[:-16]
+        assert tags_f[i].tobytes() == expected[-16:]

@@ -137,18 +137,33 @@ def _ghash_grouped(data_flat: jnp.ndarray, agg_mats: tuple) -> jnp.ndarray:
         data_flat = jnp.concatenate(
             [jnp.zeros((batch, pad_bytes), jnp.uint8), data_flat], axis=1
         )
-    planes = jnp.stack(
-        [(data_flat >> np.uint8(kbit)) & np.uint8(1) for kbit in range(8)]
-    ).astype(jnp.int8)
-    x = (
-        jax.lax.dot_general(
-            planes.reshape(8, batch * g, k1 * 16),
-            w1,
-            (((0, 2), (0, 1)), ((), ())),
-            preferred_element_type=jnp.int32,
-        )
-        & 1
-    ).astype(jnp.int8).reshape(batch, g, 128)
+    from tieredstorage_tpu.ops import ghash_pallas
+
+    if ghash_pallas.use_pallas_ghash(batch * g, k1 * 16):
+        # In-kernel plane extraction: bytes cross HBM once instead of as
+        # 8 materialized int8 planes (ghash_pallas.py).
+        rows = batch * g
+        mat = data_flat.reshape(rows, k1 * 16)
+        padded = _ceil_div(rows, ghash_pallas.ROWS_PER_STEP) * ghash_pallas.ROWS_PER_STEP
+        if padded != rows:
+            mat = jnp.pad(mat, ((0, padded - rows), (0, 0)))
+        # interpret off-TPU lets the forced path run (slowly) anywhere.
+        x = ghash_pallas.ghash_level1_pallas(
+            mat, w1, interpret=jax.default_backend() not in ("tpu", "axon")
+        )[:rows].reshape(batch, g, 128)
+    else:
+        planes = jnp.stack(
+            [(data_flat >> np.uint8(kbit)) & np.uint8(1) for kbit in range(8)]
+        ).astype(jnp.int8)
+        x = (
+            jax.lax.dot_general(
+                planes.reshape(8, batch * g, k1 * 16),
+                w1,
+                (((0, 2), (0, 1)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )
+            & 1
+        ).astype(jnp.int8).reshape(batch, g, 128)
     for w in agg_mats[1:]:
         k = w.shape[0] // 128
         m = x.shape[1]

@@ -1,0 +1,137 @@
+"""Pallas TPU kernel for grouped-GHASH level 1.
+
+The XLA formulation (ops/gcm.py `_ghash_grouped`) materializes 8 int8
+bit-planes of the ciphertext in HBM — 8 bytes of traffic per payload byte —
+before contracting them against the level-1 operand on the MXU. This kernel
+reads the raw bytes once: a [R_T, K] uint8 tile lands in VMEM, the 8 planes
+are extracted as in-register shifts/masks, and 8 f32 MXU matmuls accumulate
+the 128 output bits (values bounded by K ≤ 2048 < 2^24, so f32 accumulation
+is exact; the mod-2 reduction happens once at the end). HBM traffic drops to
+read-bytes + write-nodes (~1.06 B/B).
+
+Levels >= 2 stay in XLA: they touch 128x less data.
+
+Replaces the per-chunk GHASH of the reference's JDK GCM cipher
+(core/.../transform/EncryptionChunkEnumeration.java:66-81) together with
+ops/gcm.py; wired behind the same preflight-and-fallback gate pattern as the
+Pallas AES circuit (ops/aes_bitsliced._use_pallas_circuit).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+#: Rows of the flattened [B*G, K] level-1 matrix per grid step. 256 rows x
+#: 2048 cols keeps the tile (512 KiB) + per-plane f32 operand (2 MiB) + the
+#: f32 weight slice (1 MiB) well inside VMEM.
+ROWS_PER_STEP = 256
+
+
+_PREFLIGHT: list[bool] = []  # memoized per-process platform verdict
+
+
+def _preflight_ok() -> bool:
+    """Compile and run the kernel once on a small tile, cross-checked
+    against an exact numpy mod-2 reference. Any Mosaic lowering/runtime
+    failure or mismatch degrades to the XLA level-1 path with a warning
+    (same contract as aes_bitsliced._pallas_preflight_ok; runs under
+    ensure_compile_time_eval because the gate is consulted at trace time)."""
+    if _PREFLIGHT:
+        return _PREFLIGHT[0]
+    import numpy as np
+
+    try:
+        rng = np.random.default_rng(0)
+        k = 256
+        data = rng.integers(0, 256, (ROWS_PER_STEP, k), dtype=np.uint8)
+        w1 = rng.integers(0, 2, (8, k, 128), dtype=np.int8)
+        planes = np.stack([(data >> p) & 1 for p in range(8)]).astype(np.int64)
+        expect = (
+            np.einsum("prk,pko->ro", planes, w1.astype(np.int64)) & 1
+        ).astype(np.int8)
+        with jax.ensure_compile_time_eval():
+            got = jax.block_until_ready(
+                ghash_level1_pallas(jnp.asarray(data), jnp.asarray(w1))
+            )
+            ok = bool(jnp.array_equal(got, expect))
+        if not ok:  # pragma: no cover - platform-specific
+            raise AssertionError("kernel output diverges from numpy reference")
+    except Exception as exc:  # pragma: no cover - platform-specific
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "Pallas GHASH kernel unavailable on this platform, "
+            "falling back to the XLA level-1 path: %s", exc,
+        )
+        ok = False
+    _PREFLIGHT.append(ok)
+    return ok
+
+
+def use_pallas_ghash(rows: int, k: int) -> bool:
+    """Route level 1 through the kernel on real TPUs for well-tiled shapes.
+
+    TIEREDSTORAGE_TPU_PALLAS_GHASH=0/1 overrides (read at trace time, like
+    the AES gate); K must tile the 128-lane minor dimension and the row
+    count must fill at least one grid step."""
+    import os
+
+    # Shape preconditions hold regardless of forcing: an un-tiled K would
+    # fail Mosaic lowering, so forcing only overrides the platform check
+    # and the preflight, never validity.
+    if k % 128 or rows < ROWS_PER_STEP:
+        return False
+    forced = os.environ.get("TIEREDSTORAGE_TPU_PALLAS_GHASH")
+    if forced is not None:
+        return forced not in ("0", "false", "off")
+    try:
+        if jax.default_backend() not in ("tpu", "axon"):
+            return False
+    except Exception:
+        return False
+    return _preflight_ok()
+
+
+def _ghash_l1_kernel(x_ref, w_ref, o_ref):
+    """x_ref: VMEM uint8[R, K]; w_ref: VMEM int8[8, K, 128];
+    o_ref: VMEM int8[R, 128]."""
+    x = x_ref[:]
+    acc = None
+    for p in range(8):
+        plane = ((x >> p) & 1).astype(jnp.float32)
+        w_p = w_ref[p].astype(jnp.float32)
+        part = jnp.dot(plane, w_p, preferred_element_type=jnp.float32)
+        acc = part if acc is None else acc + part
+    o_ref[:] = (acc.astype(jnp.int32) & 1).astype(jnp.int8)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def ghash_level1_pallas(
+    data: jnp.ndarray, w1: jnp.ndarray, *, interpret: bool = False
+) -> jnp.ndarray:
+    """data uint8[R, K] (R a multiple of ROWS_PER_STEP, K the level-1 group
+    byte width), w1 int8[8, K, 128] -> node bits int8[R, 128].
+
+    Bit-exact drop-in for the XLA plane-stack + dot_general level 1 in
+    `gcm._ghash_grouped`; callers pad R and slice the result."""
+    rows, k = data.shape
+    if rows % ROWS_PER_STEP:
+        raise ValueError(f"rows={rows} not a multiple of {ROWS_PER_STEP}")
+    if w1.shape != (8, k, 128):
+        raise ValueError(f"weights {w1.shape} do not match K={k}")
+    steps = rows // ROWS_PER_STEP
+    return pl.pallas_call(
+        _ghash_l1_kernel,
+        grid=(steps,),
+        in_specs=[
+            pl.BlockSpec((ROWS_PER_STEP, k), lambda s: (s, 0)),
+            pl.BlockSpec((8, k, 128), lambda s: (0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((ROWS_PER_STEP, 128), lambda s: (s, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, 128), jnp.int8),
+        interpret=interpret,
+    )(data, w1)

@@ -59,6 +59,13 @@ def run(total_mib: int, chunk_mib: int = 4) -> dict[str, float]:
     n_blocks = ctx.n_blocks
 
     out = {}
+    # Pin the GHASH gate OFF for the baseline stages so "full"/"ghash"
+    # measure the XLA level-1 path even on chips where the preflight would
+    # enable the kernel; the `(ghpl)` stages then force it ON.
+    import os
+
+    os.environ["TIEREDSTORAGE_TPU_PALLAS_GHASH"] = "0"
+    gcm._gcm_process_batch.clear_cache()
     full = jax.jit(
         lambda r, i, d: gcm._gcm_process_batch(
             r, i, d, lm, fm, cb,
@@ -85,6 +92,34 @@ def run(total_mib: int, chunk_mib: int = 4) -> dict[str, float]:
     if jax.default_backend() != "cpu":  # interpret mode is orders slower; skip
         out["circuit_pl"] = t(aes_encrypt_planes_pallas, rkp, planes)
     out["ghash"] = t(jax.jit(lambda d: gcm._ghash_of_ct(d, lm, fm, cb)), data)
+    if jax.default_backend() != "cpu":
+        from tieredstorage_tpu.ops.ghash_pallas import (
+            ROWS_PER_STEP,
+            ghash_level1_pallas,
+        )
+
+        # Level-1 kernel on the window's real row geometry.
+        k = lm[0].shape[1]
+        g = -(-n_blocks // (k // 16))
+        rows = -(-batch * g // ROWS_PER_STEP) * ROWS_PER_STEP
+        mat = jax.block_until_ready(
+            materialize(jax.device_put(rng.integers(0, 256, (rows, k), np.uint8)))
+        )
+        out["ghash_l1_pl"] = t(ghash_level1_pallas, mat, lm[0])
+        # Full GCM with the Pallas GHASH gate forced on (fresh outer jit so
+        # the trace re-reads the env var).
+        try:
+            os.environ["TIEREDSTORAGE_TPU_PALLAS_GHASH"] = "1"
+            gcm._gcm_process_batch.clear_cache()
+            full_pl = jax.jit(lambda r, i, d: gcm._gcm_process_batch(
+                r, i, d, lm, fm, cb, chunk_bytes=chunk_bytes,
+                n_blocks=n_blocks, decrypt=False))
+            out["full(ghpl)"] = t(full_pl, rk, ivs, data)
+        finally:
+            os.environ.pop("TIEREDSTORAGE_TPU_PALLAS_GHASH", None)
+            gcm._gcm_process_batch.clear_cache()
+        return out
+    os.environ.pop("TIEREDSTORAGE_TPU_PALLAS_GHASH", None)
     return out
 
 
