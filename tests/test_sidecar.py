@@ -29,6 +29,28 @@ from tieredstorage_tpu.sidecar.client import (
 )
 
 
+def spawn_sidecar(config: dict, cfg_path, *extra_args: str):
+    """Launch the real sidecar CLI subprocess and wait for its ready line.
+
+    Returns (proc, port); on a failed boot the assertion carries the child's
+    stderr so startup crashes are debuggable from CI logs."""
+    cfg_path.write_text(json.dumps(config))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tieredstorage_tpu.sidecar",
+         "--config", str(cfg_path), *extra_args],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        cwd=str(pathlib.Path(__file__).resolve().parents[1]),
+    )
+    line = proc.stdout.readline()
+    assert line.startswith("SIDECAR_READY port="), (
+        line,
+        proc.stderr.read() if proc.poll() is not None else "",
+    )
+    return proc, int(line.strip().split("port=")[1])
+
+
 @pytest.fixture(scope="module")
 def sidecar(tmp_path_factory):
     tmp = tmp_path_factory.mktemp("sidecar")
@@ -47,18 +69,7 @@ def sidecar(tmp_path_factory):
         "encryption.key.pairs.k1.private.key.file": str(priv),
         "custom.metadata.fields.include": "REMOTE_SIZE,OBJECT_PREFIX,OBJECT_KEY",
     }
-    cfg_path = tmp / "sidecar.json"
-    cfg_path.write_text(json.dumps(config))
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "tieredstorage_tpu.sidecar", "--config", str(cfg_path)],
-        stdout=subprocess.PIPE,
-        stderr=subprocess.PIPE,
-        text=True,
-        cwd=str(pathlib.Path(__file__).resolve().parents[1]),
-    )
-    line = proc.stdout.readline()
-    assert line.startswith("SIDECAR_READY port="), (line, proc.stderr.read() if proc.poll() is not None else "")
-    port = int(line.strip().split("port=")[1])
+    proc, port = spawn_sidecar(config, tmp / "sidecar.json")
     client = SidecarRsmClient(f"127.0.0.1:{port}", timeout=60)
     yield {"client": client, "storage_root": storage_root, "tmp": tmp, "proc": proc}
     client.close()
@@ -139,3 +150,50 @@ class TestFailover:
         with pytest.raises(SidecarUnavailableError):
             dead.fetch_log_segment(make_segment_metadata(), 0)
         dead.close()
+
+
+class TestDeviceCodecAcrossBoundary:
+    def test_thuff_segments_round_trip_the_process_boundary(
+        self, tmp_path
+    ):
+        """A sidecar configured with the device codec must write
+        tpu-huff-v1 manifests and serve byte-exact ranged reads across the
+        gRPC boundary (codec selection is config-side only; the wire
+        protocol is codec-agnostic)."""
+        storage_root = tmp_path / "remote"
+        storage_root.mkdir()
+        config = {
+            "storage.backend.class":
+                "tieredstorage_tpu.storage.filesystem.FileSystemStorage",
+            "storage.root": str(storage_root),
+            "chunk.size": 4096,
+            "compression.enabled": True,
+            "compression.codec": "tpu-huff-v1",
+        }
+        # --virtual-cpu-devices: the device codec touches JAX, and in this
+        # harness implicit platform acquisition would dial the TPU relay.
+        proc, port = spawn_sidecar(
+            config, tmp_path / "sidecar.json", "--virtual-cpu-devices", "1"
+        )
+        try:
+            client = SidecarRsmClient(f"127.0.0.1:{port}", timeout=60)
+            try:
+                data = make_segment_data(tmp_path, with_txn=False)
+                md = make_segment_metadata()
+                client.copy_log_segment_data(md, data)
+                manifest = json.loads(
+                    next(storage_root.rglob("*.rsm-manifest")).read_text()
+                )
+                assert manifest["compressionCodec"] == "tpu-huff-v1"
+                original = data.log_segment.read_bytes()
+                assert client.fetch_log_segment(md, 0).read() == original
+                assert (
+                    client.fetch_log_segment(md, 5000, 5999).read()
+                    == original[5000:6000]
+                )
+                client.delete_log_segment_data(md)
+            finally:
+                client.close()
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)

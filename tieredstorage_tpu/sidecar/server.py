@@ -176,7 +176,18 @@ def main(argv: Optional[list[str]] = None) -> None:
         help="Serve Prometheus /metrics for the RSM registry on this port "
              "(the compose demo stack's scrape target).",
     )
+    parser.add_argument(
+        "--virtual-cpu-devices", type=int, default=None, metavar="N",
+        help="Pin JAX to the host platform with N virtual CPU devices before "
+             "serving (host-only deployments / environments where the "
+             "accelerator platform would be acquired implicitly).",
+    )
     args = parser.parse_args(argv)
+
+    if args.virtual_cpu_devices is not None:
+        from tieredstorage_tpu.utils.platforms import pin_virtual_cpu
+
+        pin_virtual_cpu(args.virtual_cpu_devices)
 
     from tieredstorage_tpu.rsm import RemoteStorageManager
 

@@ -61,9 +61,28 @@ def run(total_mib: int, chunk_mib: int = 4) -> dict[str, float]:
     out = {}
     # Pin the GHASH gate OFF for the baseline stages so "full"/"ghash"
     # measure the XLA level-1 path even on chips where the preflight would
-    # enable the kernel; the `(ghpl)` stages then force it ON.
+    # enable the kernel; the `(ghpl)` stages then force it ON. The caller's
+    # own gate setting is saved and restored around the whole staged body.
     import os
 
+    saved_gate = os.environ.get("TIEREDSTORAGE_TPU_PALLAS_GHASH")
+    try:
+        return _run_staged(
+            out, os, rk, lm, fm, cb, ivs, data, rng, materialize,
+            chunk_bytes=chunk_bytes, n_blocks=n_blocks, batch=batch,
+        )
+    finally:
+        if saved_gate is None:
+            os.environ.pop("TIEREDSTORAGE_TPU_PALLAS_GHASH", None)
+        else:
+            os.environ["TIEREDSTORAGE_TPU_PALLAS_GHASH"] = saved_gate
+        gcm._gcm_process_batch.clear_cache()
+
+
+def _run_staged(
+    out, os, rk, lm, fm, cb, ivs, data, rng, materialize,
+    *, chunk_bytes, n_blocks, batch,
+):
     os.environ["TIEREDSTORAGE_TPU_PALLAS_GHASH"] = "0"
     gcm._gcm_process_batch.clear_cache()
     full = jax.jit(
@@ -107,19 +126,13 @@ def run(total_mib: int, chunk_mib: int = 4) -> dict[str, float]:
         )
         out["ghash_l1_pl"] = t(ghash_level1_pallas, mat, lm[0])
         # Full GCM with the Pallas GHASH gate forced on (fresh outer jit so
-        # the trace re-reads the env var).
-        try:
-            os.environ["TIEREDSTORAGE_TPU_PALLAS_GHASH"] = "1"
-            gcm._gcm_process_batch.clear_cache()
-            full_pl = jax.jit(lambda r, i, d: gcm._gcm_process_batch(
-                r, i, d, lm, fm, cb, chunk_bytes=chunk_bytes,
-                n_blocks=n_blocks, decrypt=False))
-            out["full(ghpl)"] = t(full_pl, rk, ivs, data)
-        finally:
-            os.environ.pop("TIEREDSTORAGE_TPU_PALLAS_GHASH", None)
-            gcm._gcm_process_batch.clear_cache()
-        return out
-    os.environ.pop("TIEREDSTORAGE_TPU_PALLAS_GHASH", None)
+        # the trace re-reads the env var; run()'s finally restores it).
+        os.environ["TIEREDSTORAGE_TPU_PALLAS_GHASH"] = "1"
+        gcm._gcm_process_batch.clear_cache()
+        full_pl = jax.jit(lambda r, i, d: gcm._gcm_process_batch(
+            r, i, d, lm, fm, cb, chunk_bytes=chunk_bytes,
+            n_blocks=n_blocks, decrypt=False))
+        out["full(ghpl)"] = t(full_pl, rk, ivs, data)
     return out
 
 
