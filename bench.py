@@ -118,14 +118,20 @@ def time_best(fn, *, iters: int, warmup: int) -> float:
     return best
 
 
-def bench_device_resident(chunks, dk, *, window: int) -> float:
-    """Sustained device GCM GiB/s: windows staged in HBM, timed loop of
-    encrypt dispatches, block_until_ready at the end. Outputs stay in HBM —
-    fetching even 16 B of tags costs a ~60 ms relay round-trip per window on
-    this harness and would measure the link, not the chip (PROFILE.md)."""
+def bench_device_resident(chunks, dk, *, window: int) -> tuple[float, float]:
+    """Sustained device GCM GiB/s, both directions: windows staged in HBM,
+    timed loops of encrypt/decrypt dispatches, block_until_ready at the end.
+    Returns (encrypt_s, decrypt_s). Outputs stay in HBM — fetching even 16 B
+    of tags costs a ~60 ms relay round-trip per window on this harness and
+    would measure the link, not the chip (PROFILE.md). Decrypt is the fetch
+    path's prefetch-window half (BASELINE config 4's device side)."""
     import jax
 
-    from tieredstorage_tpu.ops.gcm import gcm_encrypt_chunks, make_context
+    from tieredstorage_tpu.ops.gcm import (
+        gcm_decrypt_chunks,
+        gcm_encrypt_chunks,
+        make_context,
+    )
 
     chunk_bytes = len(chunks[0])
     ctx = make_context(dk.data_key, dk.aad, chunk_bytes)
@@ -147,12 +153,33 @@ def bench_device_resident(chunks, dk, *, window: int) -> float:
     # Warm the jit cache.
     jax.block_until_ready(gcm_encrypt_chunks(ctx, *windows[0]))
 
-    def run():
+    def run_encrypt():
         outs = [gcm_encrypt_chunks(ctx, ivs, data) for ivs, data in windows]
         jax.block_until_ready(outs)
         return outs
 
-    return time_best(run, iters=3, warmup=1)
+    enc_s = time_best(run_encrypt, iters=3, warmup=1)
+    del run_encrypt
+
+    # Device-resident ciphertext windows for the decrypt direction. Consume
+    # the plaintext windows as we go so peak HBM residency stays at one
+    # dataset copy plus one window, not two full copies.
+    ct_windows = []
+    while windows:
+        ivs, data = windows.pop(0)
+        ct_windows.append(
+            (ivs, jax.block_until_ready(gcm_encrypt_chunks(ctx, ivs, data)[0]))
+        )
+        del data
+    jax.block_until_ready(gcm_decrypt_chunks(ctx, *ct_windows[0]))
+
+    def run_decrypt():
+        outs = [gcm_decrypt_chunks(ctx, ivs, ct) for ivs, ct in ct_windows]
+        jax.block_until_ready(outs)
+        return outs
+
+    dec_s = time_best(run_decrypt, iters=3, warmup=1)
+    return enc_s, dec_s
 
 
 def bench_tunnel_roundtrip(total_bytes: int) -> float:
@@ -300,9 +327,14 @@ def run_bench() -> dict:
     extras: dict = {}
 
     # 1. The per-chip number (BASELINE.md north star): device-resident GCM.
-    dev_s = bench_device_resident(chunks, dk, window=window)
+    dev_s, dev_dec_s = bench_device_resident(chunks, dk, window=window)
     extras["device_encrypt_gibs"] = round(gib / dev_s, 3)
+    extras["device_decrypt_gibs"] = round(gib / dev_dec_s, 3)
     _err(f"[bench] device-resident AES-GCM (per-chip): {gib / dev_s:.3f} GiB/s")
+    _err(
+        f"[bench] device-resident AES-GCM decrypt (fetch side): "
+        f"{gib / dev_dec_s:.3f} GiB/s"
+    )
 
     # 2. Zero-compute transfer control (the harness-link speed of light).
     ctrl_s = bench_tunnel_roundtrip(min(total_bytes, 64 << 20))

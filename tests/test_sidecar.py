@@ -44,10 +44,16 @@ def spawn_sidecar(config: dict, cfg_path, *extra_args: str):
         cwd=str(pathlib.Path(__file__).resolve().parents[1]),
     )
     line = proc.stdout.readline()
-    assert line.startswith("SIDECAR_READY port="), (
-        line,
-        proc.stderr.read() if proc.poll() is not None else "",
-    )
+    if not line.startswith("SIDECAR_READY port="):
+        # Kill the child before reading stderr (read() would block on a
+        # live process) so a failed boot neither hangs nor leaks a server.
+        proc.terminate()
+        try:
+            _, stderr = proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            _, stderr = proc.communicate()
+        raise AssertionError(f"sidecar did not become ready: {line!r}\n{stderr}")
     return proc, int(line.strip().split("port=")[1])
 
 

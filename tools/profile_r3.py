@@ -58,7 +58,6 @@ def run(total_mib: int, chunk_mib: int = 4) -> dict[str, float]:
     rk, lm, fm, cb = gcm._device_consts(ctx)
     n_blocks = ctx.n_blocks
 
-    out = {}
     # Pin the GHASH gate OFF for the baseline stages so "full"/"ghash"
     # measure the XLA level-1 path even on chips where the preflight would
     # enable the kernel; the `(ghpl)` stages then force it ON. The caller's
@@ -68,7 +67,7 @@ def run(total_mib: int, chunk_mib: int = 4) -> dict[str, float]:
     saved_gate = os.environ.get("TIEREDSTORAGE_TPU_PALLAS_GHASH")
     try:
         return _run_staged(
-            out, os, rk, lm, fm, cb, ivs, data, rng, materialize,
+            rk, lm, fm, cb, ivs, data, rng, materialize,
             chunk_bytes=chunk_bytes, n_blocks=n_blocks, batch=batch,
         )
     finally:
@@ -80,9 +79,12 @@ def run(total_mib: int, chunk_mib: int = 4) -> dict[str, float]:
 
 
 def _run_staged(
-    out, os, rk, lm, fm, cb, ivs, data, rng, materialize,
+    rk, lm, fm, cb, ivs, data, rng, materialize,
     *, chunk_bytes, n_blocks, batch,
 ):
+    import os
+
+    out = {}
     os.environ["TIEREDSTORAGE_TPU_PALLAS_GHASH"] = "0"
     gcm._gcm_process_batch.clear_cache()
     full = jax.jit(
