@@ -159,7 +159,6 @@ def bench_device_resident(chunks, dk, *, window: int) -> tuple[float, float]:
         return outs
 
     enc_s = time_best(run_encrypt, iters=3, warmup=1)
-    del run_encrypt
 
     # Device-resident ciphertext windows for the decrypt direction. Consume
     # the plaintext windows as we go so peak HBM residency stays at one

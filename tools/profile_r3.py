@@ -12,6 +12,7 @@ Usage: PYTHONPATH=. python tools/profile_r3.py [small_MiB large_MiB [chunk_MiB]]
 
 from __future__ import annotations
 
+import os
 import sys
 import time
 
@@ -62,8 +63,6 @@ def run(total_mib: int, chunk_mib: int = 4) -> dict[str, float]:
     # measure the XLA level-1 path even on chips where the preflight would
     # enable the kernel; the `(ghpl)` stages then force it ON. The caller's
     # own gate setting is saved and restored around the whole staged body.
-    import os
-
     saved_gate = os.environ.get("TIEREDSTORAGE_TPU_PALLAS_GHASH")
     try:
         return _run_staged(
@@ -82,8 +81,6 @@ def _run_staged(
     rk, lm, fm, cb, ivs, data, rng, materialize,
     *, chunk_bytes, n_blocks, batch,
 ):
-    import os
-
     out = {}
     os.environ["TIEREDSTORAGE_TPU_PALLAS_GHASH"] = "0"
     gcm._gcm_process_batch.clear_cache()
