@@ -42,6 +42,20 @@ class TestTables:
             assert lens.max() <= 15
             assert np.all((lens > 0) == (freqs > 0))
 
+    def test_bitrev15_exhaustive(self):
+        """The host bit-reversal must match the definitional reversal for
+        every 15-bit value — a single wrong shift direction corrupts every
+        code longer than the mutated byte lane."""
+        from tieredstorage_tpu.transform.thuff import _bitrev15_np
+
+        v = np.arange(1 << 15, dtype=np.int64)
+        got = _bitrev15_np(v)
+        expected = np.array(
+            [int(format(x, "015b")[::-1], 2) for x in range(1 << 15)],
+            dtype=np.int64,
+        )
+        np.testing.assert_array_equal(got, expected)
+
     def test_matches_unlimited_huffman_cost(self):
         """With a flat-ish distribution the depth limit never binds, so the
         package-merge cost must equal the classic Huffman cost."""
@@ -190,6 +204,14 @@ class TestRoundTrip:
         frames = compress_batch([b"hello world" * 100])
         with pytest.raises(ThuffFormatError, match="exceeds chunk limit"):
             decompress_batch(frames, max_original_chunk_size=10)
+
+    def test_size_guard_boundary_is_inclusive(self):
+        # A frame whose declared size EQUALS the configured chunk limit is
+        # legal (the guard is strictly `>`): rejecting it would fail every
+        # exactly-chunk-sized fetch.
+        data = b"hello world " * 100
+        frames = compress_batch([data])
+        assert decompress_batch(frames, max_original_chunk_size=len(data)) == [data]
 
     def test_corrupt_magic_rejected(self):
         frames = compress_batch([b"data data data"])

@@ -21,7 +21,6 @@ from __future__ import annotations
 import numpy as np
 
 _R = 0xE1000000000000000000000000000000  # reduction constant (reflected P)
-_MASK = (1 << 128) - 1
 
 
 def gcm_mult(x: int, y: int) -> int:

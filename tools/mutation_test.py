@@ -50,6 +50,8 @@ DEFAULT_TARGETS = [
     ("tieredstorage_tpu/kafka_records.py", ["tests/test_object_key_and_metadata.py"]),
     ("tieredstorage_tpu/utils/caching.py", ["tests/test_chunk_cache.py"]),
     ("tieredstorage_tpu/fetch/enumeration.py", ["tests/test_rsm_lifecycle.py"]),
+    ("tieredstorage_tpu/transform/thuff.py", ["tests/test_thuff.py"]),
+    ("tieredstorage_tpu/ops/gf128.py", ["tests/test_ops_gcm.py"]),
 ]
 
 _CMP_SWAP = {
