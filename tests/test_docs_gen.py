@@ -62,3 +62,15 @@ def test_metrics_rst_covers_all_groups():
         "throttling-errors-total",
     ):
         assert f"``{name}``" in rst
+
+
+def test_committed_rst_matches_generators_exactly():
+    """`docs/*.rst` are committed artifacts of the live definitions (the
+    reference commits its generated docs the same way): any divergence —
+    an edited docstring without `make docs`, or a hand-edit of the RST —
+    must fail here, byte for byte."""
+    import pathlib
+
+    docs = pathlib.Path(__file__).resolve().parents[1] / "docs"
+    assert (docs / "configs.rst").read_text() == gen_configs()
+    assert (docs / "metrics.rst").read_text() == gen_metrics()
