@@ -148,6 +148,20 @@ class TestVarint:
             read_unsigned_varint(b"", 0)
 
 
+class TestKafkaUuid:
+    def test_string_round_trip(self):
+        from tieredstorage_tpu.metadata import KafkaUuid
+
+        for _ in range(4):
+            u = KafkaUuid.random()
+            s = str(u)
+            # Kafka renders Uuids as unpadded urlsafe base64: 22 chars for
+            # 16 bytes, so from_string must always re-derive the "==" pad.
+            assert len(s) == 22 and "=" not in s
+            assert KafkaUuid.from_string(s) == u
+        assert KafkaUuid.from_string(str(KafkaUuid.ZERO)) == KafkaUuid.ZERO
+
+
 class TestCustomMetadataSerde:
     def test_round_trip_all_fields(self):
         fields = {0: 123456789, 1: "prefix/", 2: "topic-abc/7/000123-uuid"}

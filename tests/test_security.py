@@ -79,6 +79,21 @@ class TestOaep:
         with pytest.raises(ValueError):
             _oaep_encode(b"x" * 200, 256)  # max = 256 - 130 = 126
 
+    def test_exactly_max_length_accepted(self):
+        # The length guard is strictly greater-than: a message of exactly
+        # max_len (126 for k=256 with SHA3-512) must round-trip.
+        msg = b"x" * 126
+        assert _oaep_decode(_oaep_encode(msg, 256), 256) == msg
+        with pytest.raises(ValueError):
+            _oaep_encode(b"x" * 127, 256)
+
+    def test_wrong_length_em_is_a_clean_decryption_error(self):
+        # Truncated/empty encodings must raise ValueError, never IndexError.
+        em = _oaep_encode(b"secret", 256)
+        for bad in (b"", em[:-1], em + b"\x00"):
+            with pytest.raises(ValueError):
+                _oaep_decode(bad, 256)
+
     def test_corrupted_rejected(self):
         em = bytearray(_oaep_encode(b"secret", 256))
         em[100] ^= 0x01
@@ -123,9 +138,79 @@ class TestEncryptedDataKey:
 
     def test_malformed_rejected(self):
         for bad in ("", "nocolon", ":empty-id", "id:"):
-            with pytest.raises(ValueError):
+            # match pins the PARSE guard specifically: the dataclass's own
+            # validation also raises ValueError, but with other messages.
+            with pytest.raises(ValueError, match="Malformed"):
                 EncryptedDataKey.parse(bad)
 
     def test_key_id_with_colon_rejected(self):
         with pytest.raises(ValueError):
             EncryptedDataKey("a:b", b"\x01")
+
+
+class TestDecryptChunkGuards:
+    def test_empty_plaintext_chunk_round_trips(self):
+        # A chunk of exactly IV+tag (empty message) is valid GCM: the
+        # short-chunk guard is strictly less-than.
+        pair = AesEncryptionProvider.create_data_key_and_aad()
+        enc = AesEncryptionProvider.encrypt_chunk(b"", pair.data_key, pair.aad)
+        assert len(enc) == IV_SIZE + TAG_SIZE
+        assert AesEncryptionProvider.decrypt_chunk(enc, pair.data_key, pair.aad) == b""
+
+    def test_shorter_than_iv_plus_tag_is_value_error(self):
+        pair = AesEncryptionProvider.create_data_key_and_aad()
+        for n in (0, 1, IV_SIZE, IV_SIZE + TAG_SIZE - 1):
+            with pytest.raises(ValueError):
+                AesEncryptionProvider.decrypt_chunk(
+                    b"\x00" * n, pair.data_key, pair.aad
+                )
+
+
+class TestOaepInterop:
+    """Cross-implementation proof of the hand-rolled EME-OAEP: at SHA-256
+    (the hash OpenSSL does support) our encode must decrypt with the
+    `cryptography` library and vice versa — pinning the DB layout, MGF1
+    counters, and mask application against a second implementation. The
+    production SHA3-512 path shares every line but the hash (which is why
+    the implementation exists at all: OpenSSL lacks SHA3 OAEP)."""
+
+    def test_our_encode_decrypts_with_cryptography_oaep(self):
+        import hashlib
+
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.asymmetric import padding, rsa as crypto_rsa
+
+        from tieredstorage_tpu.security.rsa import _oaep_encode
+
+        key = crypto_rsa.generate_private_key(public_exponent=65537, key_size=2048)
+        k = 256
+        msg = b"data-encryption-key-32-bytes...!"
+        em = _oaep_encode(msg, k, hashlib.sha256)
+        # Textbook RSA with the library key's own numbers.
+        n = key.public_key().public_numbers()
+        ct = pow(int.from_bytes(em, "big"), n.e, n.n).to_bytes(k, "big")
+        pad = padding.OAEP(
+            mgf=padding.MGF1(hashes.SHA256()), algorithm=hashes.SHA256(), label=None
+        )
+        assert key.decrypt(ct, pad) == msg
+
+    def test_cryptography_encrypt_decodes_with_our_oaep(self):
+        import hashlib
+
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.asymmetric import padding, rsa as crypto_rsa
+
+        from tieredstorage_tpu.security.rsa import _oaep_decode
+
+        key = crypto_rsa.generate_private_key(public_exponent=65537, key_size=2048)
+        k = 256
+        msg = b"the reference's BouncyCastle peer"
+        pad = padding.OAEP(
+            mgf=padding.MGF1(hashes.SHA256()), algorithm=hashes.SHA256(), label=None
+        )
+        ct = key.public_key().encrypt(msg, pad)
+        priv = key.private_numbers()
+        em = pow(int.from_bytes(ct, "big"), priv.d, priv.public_numbers.n).to_bytes(
+            k, "big"
+        )
+        assert _oaep_decode(em, k, hashlib.sha256) == msg

@@ -26,7 +26,6 @@ from cryptography.hazmat.primitives.asymmetric import rsa
 from tieredstorage_tpu.security.keys import EncryptedDataKey
 
 _HASH = hashlib.sha3_512
-_H_LEN = 64
 
 
 @dataclasses.dataclass(frozen=True)
@@ -56,10 +55,11 @@ class RsaKeyReader:
 
 # --- RFC 8017 EME-OAEP with SHA3-512 ---
 
-def _mgf1(seed: bytes, length: int) -> bytes:
+def _mgf1(seed: bytes, length: int, hash_fn=_HASH) -> bytes:
+    h_len = hash_fn(b"").digest_size
     out = bytearray()
-    for counter in range(-(-length // _H_LEN)):
-        out += _HASH(seed + counter.to_bytes(4, "big")).digest()
+    for counter in range(-(-length // h_len)):
+        out += hash_fn(seed + counter.to_bytes(4, "big")).digest()
     return bytes(out[:length])
 
 
@@ -67,30 +67,58 @@ def _xor(a: bytes, b: bytes) -> bytes:
     return bytes(x ^ y for x, y in zip(a, b))
 
 
-def _oaep_encode(message: bytes, k: int) -> bytes:
-    max_len = k - 2 * _H_LEN - 2
+# `hash_fn` defaults to the production SHA3-512; tests inject SHA-256 to
+# cross-verify the EME-OAEP structure byte-for-byte against the
+# `cryptography` library (whose OpenSSL backend lacks SHA3 OAEP — the very
+# reason this implementation exists).
+
+def _oaep_encode(message: bytes, k: int, hash_fn=_HASH) -> bytes:
+    h_len = hash_fn(b"").digest_size
+    max_len = k - 2 * h_len - 2
     if len(message) > max_len:
         raise ValueError(f"Message too long for OAEP: {len(message)} > {max_len}")
-    l_hash = _HASH(b"").digest()
-    ps = b"\x00" * (k - len(message) - 2 * _H_LEN - 2)
+    l_hash = hash_fn(b"").digest()
+    ps = b"\x00" * (k - len(message) - 2 * h_len - 2)
     db = l_hash + ps + b"\x01" + message
-    seed = os.urandom(_H_LEN)
-    masked_db = _xor(db, _mgf1(seed, k - _H_LEN - 1))
-    masked_seed = _xor(seed, _mgf1(masked_db, _H_LEN))
+    seed = os.urandom(h_len)
+    masked_db = _xor(db, _mgf1(seed, k - h_len - 1, hash_fn))
+    masked_seed = _xor(seed, _mgf1(masked_db, h_len, hash_fn))
     return b"\x00" + masked_seed + masked_db
 
 
-def _oaep_decode(em: bytes, k: int) -> bytes:
-    if len(em) != k or k < 2 * _H_LEN + 2:
+def _oaep_decode(em: bytes, k: int, hash_fn=_HASH) -> bytes:
+    """EME-OAEP decode with a single failure exit.
+
+    All padding checks are evaluated unconditionally and OR-folded into one
+    error (RFC 8017 §9.1.1.3 / Manger: distinct early exits on y, lHash,
+    and the PS scan would leak which check failed through timing); only the
+    public length precondition fails fast. lHash uses a constant-time
+    compare."""
+    import hmac
+
+    h_len = hash_fn(b"").digest_size
+    if len(em) != k or k < 2 * h_len + 2:
         raise ValueError("Decryption error")
-    y, masked_seed, masked_db = em[0], em[1 : 1 + _H_LEN], em[1 + _H_LEN :]
-    seed = _xor(masked_seed, _mgf1(masked_db, _H_LEN))
-    db = _xor(masked_db, _mgf1(seed, k - _H_LEN - 1))
-    l_hash = _HASH(b"").digest()
-    if y != 0 or db[:_H_LEN] != l_hash:
-        raise ValueError("Decryption error")
-    sep = db.find(b"\x01", _H_LEN)
-    if sep < 0 or any(db[_H_LEN:sep]):
+    y, masked_seed, masked_db = em[0], em[1 : 1 + h_len], em[1 + h_len :]
+    seed = _xor(masked_seed, _mgf1(masked_db, h_len, hash_fn))
+    db = _xor(masked_db, _mgf1(seed, k - h_len - 1, hash_fn))
+    l_hash = hash_fn(b"").digest()
+    bad = y != 0
+    bad |= not hmac.compare_digest(db[:h_len], l_hash)
+    # Scan the whole post-lHash region without early exit: PS must be all
+    # zero up to a mandatory 0x01 separator.
+    sep = -1
+    seen_nonzero_before_sep = False
+    for i in range(h_len, len(db)):
+        b = db[i]
+        if sep < 0:
+            if b == 1:
+                sep = i
+            elif b != 0:
+                seen_nonzero_before_sep = True
+    bad |= sep < 0
+    bad |= seen_nonzero_before_sep
+    if bad:
         raise ValueError("Decryption error")
     return db[sep + 1 :]
 

@@ -52,6 +52,10 @@ DEFAULT_TARGETS = [
     ("tieredstorage_tpu/fetch/enumeration.py", ["tests/test_rsm_lifecycle.py"]),
     ("tieredstorage_tpu/transform/thuff.py", ["tests/test_thuff.py"]),
     ("tieredstorage_tpu/ops/gf128.py", ["tests/test_ops_gcm.py"]),
+    ("tieredstorage_tpu/security/aes.py", ["tests/test_security.py"]),
+    ("tieredstorage_tpu/security/rsa.py", ["tests/test_security.py"]),
+    ("tieredstorage_tpu/security/keys.py", ["tests/test_security.py"]),
+    ("tieredstorage_tpu/metadata.py", ["tests/test_object_key_and_metadata.py"]),
 ]
 
 _CMP_SWAP = {
