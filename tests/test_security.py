@@ -214,3 +214,26 @@ class TestOaepInterop:
             k, "big"
         )
         assert _oaep_decode(em, k, hashlib.sha256) == msg
+
+
+class TestRsaKeyReader:
+    def test_non_rsa_key_rejected(self, tmp_path):
+        # A pair where EITHER half is not RSA must be rejected — e.g. an
+        # EC private key alongside an RSA public key.
+        from cryptography.hazmat.primitives import serialization
+        from cryptography.hazmat.primitives.asymmetric import ec
+
+        from tieredstorage_tpu.security.rsa import RsaKeyReader
+
+        pub, _priv = generate_key_pair_pem_files(tmp_path, prefix="rsa")
+        ec_key = ec.generate_private_key(ec.SECP256R1())
+        ec_pem = tmp_path / "ec.pem"
+        ec_pem.write_bytes(
+            ec_key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.PKCS8,
+                serialization.NoEncryption(),
+            )
+        )
+        with pytest.raises(ValueError, match="must contain RSA"):
+            RsaKeyReader.read(pub, ec_pem)
