@@ -8,8 +8,11 @@ Kafka v2 record batches, and the RemoteStorageManager with compression +
 envelope encryption, then walks the full lifecycle and prints what happened.
 
     python demo/run_demo.py --backend s3        # in-process MinIO stand-in
+    python demo/run_demo.py --backend gcs       # in-process fake-gcs-server
+    python demo/run_demo.py --backend azure     # in-process Azurite stand-in
     python demo/run_demo.py --backend filesystem
     python demo/run_demo.py --backend s3 --transform native
+    python demo/run_demo.py --codec tpu-huff-v1 # the device codec (JAX)
 """
 
 from __future__ import annotations
@@ -26,13 +29,41 @@ sys.path.insert(0, str(REPO_ROOT))
 
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--backend", choices=["s3", "filesystem"], default="s3")
+    parser.add_argument(
+        "--backend", choices=["s3", "gcs", "azure", "filesystem"], default="s3"
+    )
     parser.add_argument(
         "--transform", choices=["cpu", "native", "tpu"], default="cpu",
         help="transform.backend.class to use (tpu needs a JAX device)",
     )
+    parser.add_argument(
+        "--codec", choices=["zstd", "tpu-huff-v1"], default="zstd",
+        help="compression.codec (tpu-huff-v1 runs the device codec kernels)",
+    )
     parser.add_argument("--records", type=int, default=3000)
+    parser.add_argument(
+        "--virtual-cpu-devices", type=int, default=None, metavar="N",
+        help="Pin JAX to the host platform with N virtual devices first "
+             "(for --codec tpu-huff-v1 / --transform tpu on machines where "
+             "implicit platform acquisition would grab an accelerator)",
+    )
     args = parser.parse_args()
+
+    needs_jax = args.codec == "tpu-huff-v1" or args.transform == "tpu"
+    if args.virtual_cpu_devices is not None:
+        from tieredstorage_tpu.utils.platforms import pin_virtual_cpu
+
+        pin_virtual_cpu(args.virtual_cpu_devices)
+    elif needs_jax and args.transform != "tpu":
+        # The device codec needs JAX but not an accelerator: pin the host
+        # platform so implicit acquisition can't block the demo on machines
+        # where the accelerator platform hangs (pass --virtual-cpu-devices
+        # to control the count, or --transform tpu to use a real device).
+        from tieredstorage_tpu.utils.platforms import pin_virtual_cpu
+
+        print("· pinning JAX to the host platform for the device codec "
+              "(override with --virtual-cpu-devices / --transform tpu)")
+        pin_virtual_cpu(1)
 
     from tests.e2e.broker import BrokerSim
     from tieredstorage_tpu.rsm import RemoteStorageManager
@@ -54,6 +85,29 @@ def main() -> None:
             "storage.aws.secret.access.key": "demo-secret",
         }
         print(f"· S3 emulator listening at {emulator.endpoint}")
+    elif args.backend == "gcs":
+        from tests.emulators.gcs_emulator import GcsEmulator
+
+        emulator = GcsEmulator().start()
+        storage_configs = {
+            "storage.backend.class": "tieredstorage_tpu.storage.gcs:GcsStorage",
+            "storage.gcs.bucket.name": "demo-bucket",
+            "storage.gcs.endpoint.url": emulator.endpoint,
+        }
+        print(f"· GCS emulator listening at {emulator.endpoint}")
+    elif args.backend == "azure":
+        from tests.emulators.azure_emulator import AzureEmulator
+
+        account, account_key = "demoaccount", "ZGVtby1rZXktZGVtby1rZXktZGVtby1rZXkh"
+        emulator = AzureEmulator(account=account, account_key=account_key).start()
+        storage_configs = {
+            "storage.backend.class": "tieredstorage_tpu.storage.azure:AzureBlobStorage",
+            "storage.azure.container.name": "demo-container",
+            "storage.azure.account.name": account,
+            "storage.azure.account.key": account_key,
+            "storage.azure.endpoint.url": emulator.endpoint,
+        }
+        print(f"· Azure emulator listening at {emulator.endpoint}")
     else:
         root = tmp / "remote"
         root.mkdir()
@@ -76,6 +130,7 @@ def main() -> None:
             "chunk.size": 4096,
             "key.prefix": "demo/",
             "compression.enabled": True,
+            "compression.codec": args.codec,
             "encryption.enabled": True,
             "encryption.key.pair.id": "demo-key",
             "encryption.key.pairs": ["demo-key"],
@@ -88,7 +143,7 @@ def main() -> None:
         }
     )
     print(f"· RemoteStorageManager up (transform backend: {args.transform}, "
-          "zstd + AES-256-GCM envelope encryption)")
+          f"{args.codec} + AES-256-GCM envelope encryption)")
 
     broker = BrokerSim(tmp / "logs", rsm, segment_bytes=64 * 1024 + 123)
     broker.create_topic("demo-topic", 1)
@@ -136,7 +191,10 @@ def main() -> None:
     rsm.close()
     if emulator is not None:
         with emulator.state.lock:
-            assert not emulator.state.objects
+            stored = getattr(emulator.state, "objects", None)
+            if stored is None:
+                stored = emulator.state.blobs  # Azure naming
+            assert not stored, f"objects left behind after topic delete: {list(stored)}"
         emulator.stop()
     print("✓ demo complete")
 
