@@ -325,6 +325,27 @@ def run_bench() -> dict:
     window = max(1, int(os.environ.get("BENCH_WINDOW_CHUNKS", 16)))
     extras: dict = {}
 
+    # Record whether the Pallas kernels engage on this platform (preflight
+    # verdicts) — BENCH artifacts must show which program was measured.
+    # Probe with the SAME shapes the measured windows produce, or a shrunken
+    # workload could record a kernel the run never used.
+    try:
+        from tieredstorage_tpu.ops.aes_bitsliced import _use_pallas_circuit
+        from tieredstorage_tpu.ops.ghash_pallas import use_pallas_ghash
+
+        m_blocks = -(-chunk_bytes // 16)
+        aes_words = window * (-(-(m_blocks + 1) // 32))
+        k1 = min(128, m_blocks)
+        ghash_rows = window * (-(-m_blocks // k1))
+        extras["pallas_aes"] = bool(_use_pallas_circuit(aes_words))
+        extras["pallas_ghash"] = bool(use_pallas_ghash(ghash_rows, k1 * 16))
+        _err(
+            f"[bench] pallas kernels at the measured shapes: "
+            f"aes={extras['pallas_aes']} ghash={extras['pallas_ghash']}"
+        )
+    except Exception as exc:  # never cost the artifact
+        extras["pallas_gate_error"] = f"{type(exc).__name__}: {exc}"
+
     # 1. The per-chip number (BASELINE.md north star): device-resident GCM.
     dev_s, dev_dec_s = bench_device_resident(chunks, dk, window=window)
     extras["device_encrypt_gibs"] = round(gib / dev_s, 3)
