@@ -333,9 +333,17 @@ def run_bench() -> dict:
         from tieredstorage_tpu.ops.aes_bitsliced import _use_pallas_circuit
         from tieredstorage_tpu.ops.ghash_pallas import use_pallas_ghash
 
-        m_blocks = -(-chunk_bytes // 16)
+        from tieredstorage_tpu.ops.gcm import make_context
+
+        # Derive the level-1 grouping from the real context rather than
+        # re-implementing ghash_agg_plan's max_k math: agg_mats[0] is the
+        # int8[8, k1*16, 128] operand _ghash_grouped actually contracts, so
+        # the recorded verdict tracks the measured program even if the plan
+        # changes.
+        ctx = make_context(dk.data_key, dk.aad, chunk_bytes)
+        m_blocks = ctx.n_blocks
         aes_words = window * (-(-(m_blocks + 1) // 32))
-        k1 = min(128, m_blocks)
+        k1 = ctx.agg_mats[0].shape[1] // 16
         ghash_rows = window * (-(-m_blocks // k1))
         extras["pallas_aes"] = bool(_use_pallas_circuit(aes_words))
         extras["pallas_ghash"] = bool(use_pallas_ghash(ghash_rows, k1 * 16))

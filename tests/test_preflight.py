@@ -1,0 +1,125 @@
+"""The shared preflight-retry machinery behind both Pallas kernel gates
+(ops/_preflight.py): lowering failures pin False immediately, transient
+relay failures are retried in place before the verdict is memoized."""
+
+from __future__ import annotations
+
+import logging
+
+import pytest
+
+from tieredstorage_tpu.ops._preflight import is_lowering_failure, run_preflight
+
+LOG = logging.getLogger("test_preflight")
+
+
+class Flaky:
+    """Raises `failures` times, then returns True."""
+
+    def __init__(self, failures, exc_factory):
+        self.failures = failures
+        self.calls = 0
+        self.exc_factory = exc_factory
+
+    def __call__(self):
+        self.calls += 1
+        if self.calls <= self.failures:
+            raise self.exc_factory()
+        return True
+
+
+def test_lowering_failure_pins_false_without_retry():
+    attempt = Flaky(99, lambda: RuntimeError("Mosaic lowering failed"))
+    memo = []
+    assert run_preflight(memo, attempt, LOG, "down: %s", delay_s=0) is False
+    assert attempt.calls == 1  # no retry for a deterministic failure
+    assert memo == [False]
+    # Memoized: a later consult must not re-attempt.
+    assert run_preflight(memo, attempt, LOG, "down: %s", delay_s=0) is False
+    assert attempt.calls == 1
+
+
+def test_transient_failure_retried_in_place_then_true():
+    """The gate is read at trace time and the jit cache pins the first
+    trace's verdict per shape — so one relay blip must be retried inside
+    the consult, not deferred to a 'next consult' that never comes."""
+    attempt = Flaky(1, lambda: ConnectionError("relay RPC deadline"))
+    memo = []
+    assert run_preflight(memo, attempt, LOG, "down: %s", delay_s=0) is True
+    assert attempt.calls == 2
+    assert memo == [True]
+
+
+def test_transient_budget_exhausted_pins_false():
+    attempt = Flaky(99, lambda: ConnectionError("transport reset"))
+    memo = []
+    assert run_preflight(memo, attempt, LOG, "down: %s", retries=2, delay_s=0) is False
+    assert attempt.calls == 3  # initial try + 2 retries
+    assert memo == [False]
+    run_preflight(memo, attempt, LOG, "down: %s", retries=2, delay_s=0)
+    assert attempt.calls == 3  # final verdict memoized
+
+
+def test_divergence_is_a_permanent_failure():
+    # ghash_pallas raises AssertionError("unsupported: ...") on an output
+    # mismatch — deterministic, must not burn the transient budget.
+    assert is_lowering_failure(
+        AssertionError("unsupported: kernel output diverges from numpy reference")
+    )
+
+
+@pytest.mark.parametrize(
+    "exc,expected",
+    [
+        (RuntimeError("Mosaic verification error"), True),
+        (NotImplementedError("no pallas on cpu"), True),
+        (RuntimeError("Unsupported primitive"), True),
+        # Deterministic by TYPE even without a lowering mark in the text:
+        (ImportError("No module named 'jax.experimental.pallas'"), True),
+        (AssertionError("outputs differ"), True),
+        (RuntimeError("TracerBoolConversionError leaked"), True),
+        (ConnectionResetError("peer reset"), False),
+        (TimeoutError("deadline exceeded"), False),
+    ],
+)
+def test_lowering_classifier(exc, expected):
+    assert is_lowering_failure(exc) is expected
+
+
+def test_interpret_off_device_degrades_on_probe_failure(monkeypatch):
+    """A forced kernel path must not abort the caller's trace when backend
+    acquisition raises — it falls back to interpret mode with a warning."""
+    import jax
+
+    from tieredstorage_tpu.ops import _preflight
+
+    monkeypatch.setattr(
+        jax, "default_backend", lambda: (_ for _ in ()).throw(RuntimeError("relay down"))
+    )
+    assert _preflight.interpret_off_device(LOG, "test kernel") is True
+
+
+def test_forced_paths_use_guarded_probe():
+    """Both forced-kernel call sites must route the backend probe through
+    interpret_off_device (round-4 review: the gcm.py site was guarded but
+    the ctr_keystream_batch site was not)."""
+    import inspect
+
+    from tieredstorage_tpu.ops import aes_bitsliced, gcm
+
+    assert "interpret_off_device" in inspect.getsource(
+        aes_bitsliced.ctr_keystream_batch
+    )
+    assert "interpret_off_device" in inspect.getsource(gcm._ghash_grouped)
+
+
+def test_gate_modules_share_the_machinery():
+    """Both kernel gates must route through run_preflight so the retry
+    contract can't silently diverge again (round-3 review found the fix
+    applied to one gate only)."""
+    import inspect
+
+    from tieredstorage_tpu.ops import aes_bitsliced, ghash_pallas
+
+    for fn in (aes_bitsliced._pallas_preflight_ok, ghash_pallas._preflight_ok):
+        assert "run_preflight" in inspect.getsource(fn)

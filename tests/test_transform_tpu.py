@@ -87,6 +87,19 @@ class TestTagVerification:
         with pytest.raises(ValueError, match="shorter"):
             tpu.detransform([b"\x00" * 10], DetransformOptions(encryption=key_pair))
 
+    def test_tag_compare_is_constant_time(self):
+        """The device path must verify tags with hmac.compare_digest, not
+        bytes !=: a revert is behaviorally invisible (same accept/reject
+        decision) but reopens the remote timing side channel the CPU path's
+        `cryptography` verify closes, so pin it at the source level."""
+        import inspect
+
+        from tieredstorage_tpu.transform import tpu as tpu_mod
+
+        src = inspect.getsource(tpu_mod.TpuTransformBackend._decrypt_batch)
+        assert "hmac.compare_digest" in src
+        assert "!= received_tags" not in src
+
 
 class TestMeshSharding:
     def test_sharded_batch_matches_unsharded(self, key_pair):

@@ -1,0 +1,114 @@
+"""Shared preflight-and-fallback machinery for the Pallas kernel gates.
+
+Both kernels (ops/aes_pallas.py, ops/ghash_pallas.py) are guarded by a
+first-use preflight that compiles and runs the kernel on a minimal tile and
+cross-checks it against an exact reference. The verdict is memoized per
+process so an unattended round-end benchmark can't lose its artifact to a
+kernel regression — but the memo must distinguish two failure classes:
+
+- **Lowering failures** (Mosaic can't compile the kernel here): deterministic,
+  retrying cannot help, memoize False immediately.
+- **Transient failures** (relay RPC deadline, transport reset — the
+  documented axon outage modes): retried a bounded number of times *inside
+  the consult*, because the gate is read at trace time and the caller's jit
+  cache pins whichever verdict the first trace saw; a verdict returned
+  without retrying would silently pin that shape to the slow XLA path for
+  the life of the process.
+
+Only the final verdict is memoized, so the answer the bench's eager gate
+probe records is the same answer every traced shape saw.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable
+
+#: Marks of a deterministic compile/lowering failure.
+_LOWERING_MARKS = (
+    "mosaic",
+    "lowering",
+    "unsupported",
+    "notimplemented",
+    "cannot lower",
+    "unimplemented",
+    "tracerbool",       # omnistaging leak: retrying the same trace can't help
+    "concretization",
+)
+
+#: Exception types that are deterministic regardless of message text:
+#: a missing module, a failed cross-check assertion, or an unimplemented
+#: path will fail identically on every retry.
+_DETERMINISTIC_TYPES = (ImportError, AssertionError, NotImplementedError)
+
+#: Transient retry budget per preflight run, and the pause between tries.
+TRANSIENT_RETRIES = 2
+RETRY_DELAY_S = 1.0
+
+
+def is_lowering_failure(exc: BaseException) -> bool:
+    if isinstance(exc, _DETERMINISTIC_TYPES):
+        return True
+    text = f"{type(exc).__name__}: {exc}".lower()
+    return any(mark in text for mark in _LOWERING_MARKS)
+
+
+def run_preflight(
+    memo: list,
+    attempt: Callable[[], bool],
+    logger: logging.Logger,
+    unavailable_msg: str,
+    *,
+    retries: int = TRANSIENT_RETRIES,
+    delay_s: float = RETRY_DELAY_S,
+) -> bool:
+    """Run `attempt` with bounded in-place retries for transient failures,
+    memoizing the final verdict into `memo` (a module-level list; tests clear
+    it to re-arm the gate). `attempt` returns whether the kernel's output
+    matched the reference; any exception it raises is classified by
+    `is_lowering_failure`."""
+    if memo:
+        return memo[0]
+    transient_tries = 0
+    while True:
+        try:
+            ok = bool(attempt())
+            break
+        except Exception as exc:
+            if not is_lowering_failure(exc) and transient_tries < retries:
+                transient_tries += 1
+                logger.warning(
+                    "Pallas preflight failed transiently (retry %d/%d in "
+                    "%.1fs): %s",
+                    transient_tries,
+                    retries,
+                    delay_s,
+                    exc,
+                )
+                time.sleep(delay_s)
+                continue
+            logger.warning(unavailable_msg, exc)
+            ok = False
+            break
+    memo.append(ok)
+    return ok
+
+
+def interpret_off_device(logger: logging.Logger, what: str) -> bool:
+    """True when the backend is not a real TPU, so a *forced* kernel path
+    should run in Mosaic interpret mode. The probe itself can raise during
+    backend acquisition (the documented relay outage mode); degrade to
+    interpret with a warning rather than aborting the caller's trace."""
+    import jax
+
+    try:
+        return jax.default_backend() not in ("tpu", "axon")
+    except Exception as exc:
+        logger.warning(
+            "Backend probe failed; running the forced %s in interpret mode "
+            "(orders slower): %s",
+            what,
+            exc,
+        )
+        return True

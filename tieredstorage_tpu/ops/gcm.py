@@ -147,9 +147,19 @@ def _ghash_grouped(data_flat: jnp.ndarray, agg_mats: tuple) -> jnp.ndarray:
         padded = _ceil_div(rows, ghash_pallas.ROWS_PER_STEP) * ghash_pallas.ROWS_PER_STEP
         if padded != rows:
             mat = jnp.pad(mat, ((0, padded - rows), (0, 0)))
-        # interpret off-TPU lets the forced path run (slowly) anywhere.
+        # interpret off-TPU lets the forced path run (slowly) anywhere; the
+        # backend probe can raise (like in the gates) and degrades to
+        # interpret rather than aborting the trace (ops/_preflight.py).
+        import logging
+
+        from tieredstorage_tpu.ops._preflight import interpret_off_device
+
         x = ghash_pallas.ghash_level1_pallas(
-            mat, w1, interpret=jax.default_backend() not in ("tpu", "axon")
+            mat,
+            w1,
+            interpret=interpret_off_device(
+                logging.getLogger(__name__), "Pallas GHASH level 1"
+            ),
         )[:rows].reshape(batch, g, 128)
     else:
         planes = jnp.stack(

@@ -87,13 +87,19 @@ def _oaep_encode(message: bytes, k: int, hash_fn=_HASH) -> bytes:
 
 
 def _oaep_decode(em: bytes, k: int, hash_fn=_HASH) -> bytes:
-    """EME-OAEP decode with a single failure exit.
+    """EME-OAEP decode, single-exit with a reduced (not eliminated) timing
+    signal.
 
     All padding checks are evaluated unconditionally and OR-folded into one
     error (RFC 8017 §9.1.1.3 / Manger: distinct early exits on y, lHash,
     and the PS scan would leak which check failed through timing); only the
-    public length precondition fails fast. lHash uses a constant-time
-    compare."""
+    public length precondition fails fast, and the lHash compare itself is
+    constant-time. This is NOT fully constant-time: the per-byte Python
+    loop, _xor, and _mgf1 are variable-time in CPython, so a residual
+    data-dependent signal remains — the single-exit structure narrows the
+    Manger oracle rather than closing it. Keys here wrap data keys inside a
+    trusted broker process (no network-facing decryption oracle), which is
+    why the remaining leak is accepted rather than rebuilt branchless."""
     import hmac
 
     h_len = hash_fn(b"").digest_size

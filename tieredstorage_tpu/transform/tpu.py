@@ -13,6 +13,7 @@ IV || ciphertext || tag.
 from __future__ import annotations
 
 import functools
+import hmac
 import os
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional, Sequence
@@ -304,7 +305,9 @@ class TpuTransformBackend(TransformBackend):
         bad = [
             i
             for i in range(len(chunks))
-            if expected_tags[i].tobytes() != received_tags[i].tobytes()
+            if not hmac.compare_digest(
+                expected_tags[i].tobytes(), received_tags[i].tobytes()
+            )
         ]
         if bad:
             raise AuthenticationError(f"GCM tag mismatch on chunks {bad}")
