@@ -108,7 +108,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(404, b'{"error": "no such session"}')
                 return
             m = re.fullmatch(r"bytes (\d+)-(\d+)/(\d+|\*)", content_range)
-            empty = re.fullmatch(r"bytes \*/(\d+)", content_range)
+            empty = re.fullmatch(r"bytes \*/(\d+|\*)", content_range)
             if m:
                 start = int(m.group(1))
                 if start > int(m.group(2)):
@@ -137,8 +137,17 @@ class _Handler(BaseHTTPRequestHandler):
                     self._reply(400, b'{"error": "size mismatch"}')
                     return
             elif empty:
-                if int(empty.group(1)) != len(session["data"]):
-                    self._reply(400, b'{"error": "size mismatch"}')
+                total = empty.group(1)
+                # Status probe ('bytes */*', or 'bytes */N' with fewer than N
+                # bytes committed): reply 308 with the committed Range —
+                # Google's documented resume protocol; a 308 with no Range
+                # header means nothing persisted.
+                if total == "*" or int(total) != len(session["data"]):
+                    committed = len(session["data"])
+                    if committed:
+                        self._reply(308, headers={"Range": f"bytes=0-{committed - 1}"})
+                    else:
+                        self._reply(308)
                     return
             else:
                 self._reply(400, b'{"error": "bad Content-Range"}')

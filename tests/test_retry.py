@@ -1,0 +1,405 @@
+"""Storage transport retry/backoff (VERDICT r3 item 4).
+
+The reference inherits retry behavior from its vendor SDKs (AWS SDK v2
+standard mode — the per-attempt timeout key in S3StorageConfig.java:65-68
+exists *because* the SDK retries; GCS/Azure SDK policies likewise). These
+tests pin the hand-rolled transport's equivalent: exponential backoff with
+full jitter on 5xx/429/transport failures for replay-safe requests, the
+Retry-After floor, the total-deadline bound, the
+s3.api.call.{timeout,attempt.timeout} wiring, and fault-injection sequences
+(emulators returning 500/429/503 runs) against all three cloud backends.
+"""
+
+from __future__ import annotations
+
+import io
+import threading
+
+import pytest
+
+from tests.emulators.azure_emulator import AzureEmulator
+from tests.emulators.gcs_emulator import GcsEmulator
+from tests.emulators.s3_emulator import S3Emulator
+from tieredstorage_tpu.metrics.core import MetricName
+from tieredstorage_tpu.storage.core import ObjectKey
+from tieredstorage_tpu.storage.httpclient import (
+    NO_RETRY,
+    HttpClient,
+    HttpError,
+    RetryPolicy,
+    _parse_retry_after,
+)
+
+FAST = RetryPolicy(base_delay_s=0.001, max_delay_s=0.002)
+
+
+class TestRetryPolicyMath:
+    def test_backoff_jitter_bounded_by_exponential_cap(self):
+        policy = RetryPolicy(base_delay_s=0.1, max_delay_s=5.0)
+        for n, cap in [(0, 0.1), (1, 0.2), (2, 0.4), (10, 5.0)]:
+            for _ in range(20):
+                d = policy.backoff_s(n)
+                assert 0.0 <= d <= cap
+
+    def test_retry_after_is_a_floor_capped_at_max_delay(self):
+        policy = RetryPolicy(base_delay_s=0.001, max_delay_s=2.0)
+        assert policy.backoff_s(0, retry_after_s=1.5) >= 1.5
+        # A server asking for minutes must not stall the fetch path.
+        assert policy.backoff_s(0, retry_after_s=600.0) <= 2.0
+
+    def test_parse_retry_after(self):
+        assert _parse_retry_after("2") == 2.0
+        assert _parse_retry_after("0.5") == 0.5
+        assert _parse_retry_after("") is None
+        assert _parse_retry_after("Wed, 21 Oct 2015 07:28:00 GMT") is None
+
+
+class _SeqHandler:
+    """Connection factory yielding scripted (status, headers) responses."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.requests = []
+
+    def __call__(self):
+        handler = self
+
+        class Resp:
+            def __init__(self, status, headers):
+                self.status = status
+                self._headers = headers
+
+            def read(self, *a):
+                return b"body"
+
+            def getheaders(self):
+                return list(self._headers.items())
+
+            def close(self):
+                pass
+
+        class Conn:
+            def request(self, method, path, body=None, headers=None):
+                handler.requests.append((method, path))
+
+            def getresponse(self):
+                status, headers = handler.script.pop(0)
+                if status is None:  # scripted transport failure
+                    raise OSError("connection reset by peer")
+                return Resp(status, headers)
+
+            def close(self):
+                pass
+
+        return Conn()
+
+
+def _client(script, policy=FAST) -> tuple[HttpClient, _SeqHandler]:
+    client = HttpClient("http://test.invalid", retry=policy)
+    handler = _SeqHandler(script)
+    client._new_connection = handler  # type: ignore[method-assign]
+    return client, handler
+
+
+class TestHttpClientRetry:
+    def test_get_retries_5xx_until_success(self):
+        client, handler = _client([(500, {}), (502, {}), (200, {})])
+        assert client.request("GET", "/k").status == 200
+        assert len(handler.requests) == 3
+
+    def test_get_gives_up_after_max_attempts(self):
+        client, handler = _client([(503, {})] * 5)
+        assert client.request("GET", "/k").status == 503
+        assert len(handler.requests) == 3  # default max_attempts
+
+    def test_429_honors_retry_after_floor(self):
+        import time
+
+        # max_delay_s must exceed the Retry-After for the floor to bite
+        # (the policy caps a server's Retry-After at max_delay_s).
+        policy = RetryPolicy(base_delay_s=0.001, max_delay_s=1.0)
+        client, handler = _client([(429, {"Retry-After": "0.05"}), (200, {})], policy)
+        t0 = time.monotonic()
+        assert client.request("GET", "/k").status == 200
+        assert time.monotonic() - t0 >= 0.05
+        assert len(handler.requests) == 2
+
+    def test_non_idempotent_post_not_retried_on_5xx(self):
+        client, handler = _client([(500, {}), (200, {})])
+        assert client.request("POST", "/complete").status == 500
+        assert len(handler.requests) == 1
+
+    def test_post_with_idempotent_override_is_retried(self):
+        client, handler = _client([(500, {}), (200, {})])
+        assert client.request("POST", "/?delete", idempotent=True).status == 200
+        assert len(handler.requests) == 2
+
+    def test_transport_failure_on_idempotent_request_retried(self):
+        # A fresh-connection failure is not the stale-keepalive case the
+        # inner _roundtrip replays; the policy loop owns this retry.
+        client, handler = _client([(None, {}), (200, {})])
+        assert client.request("GET", "/k").status == 200
+        assert len(handler.requests) == 2
+
+    def test_transport_failure_on_non_idempotent_request_raises(self):
+        client, handler = _client([(None, {}), (200, {})])
+        with pytest.raises(HttpError):
+            client.request("POST", "/complete")
+        assert len(handler.requests) == 1
+
+    def test_total_deadline_bounds_the_retry_loop(self):
+        policy = RetryPolicy(
+            max_attempts=10, base_delay_s=0.2, max_delay_s=0.2, total_deadline_s=0.05
+        )
+        # backoff (>=0..0.2 jittered) may fit once, but a scripted run of
+        # 503s must stop LONG before 10 attempts.
+        client, handler = _client([(503, {"Retry-After": "0.2"})] * 10, policy)
+        assert client.request("GET", "/k").status == 503
+        assert len(handler.requests) < 4
+
+    def test_no_retry_policy_single_attempt(self):
+        client, handler = _client([(500, {}), (200, {})], NO_RETRY)
+        assert client.request("GET", "/k").status == 500
+        assert len(handler.requests) == 1
+
+    def test_stream_retries_initial_exchange(self):
+        client, handler = _client([(503, {}), (None, {}), (200, {})])
+        status, hdrs, stream = client.request_stream("GET", "/k")
+        assert status == 200
+        assert len(handler.requests) == 3
+        stream.close()
+
+
+class TestS3FaultInjection:
+    @pytest.fixture(scope="class")
+    def emulator(self):
+        emu = S3Emulator().start()
+        yield emu
+        emu.stop()
+
+    @pytest.fixture
+    def backend(self, emulator):
+        from tieredstorage_tpu.storage.s3 import S3Storage
+
+        with emulator.state.lock:
+            emulator.state.objects.clear()
+            emulator.state.fail_next.clear()
+        b = S3Storage()
+        b.configure(
+            {
+                "s3.bucket.name": "bkt",
+                "s3.endpoint.url": emulator.endpoint,
+                "s3.path.style.access.enabled": True,
+                "aws.access.key.id": "a",
+                "aws.secret.access.key": "s",
+            }
+        )
+        # Test-speed backoff; policy shape (attempts, statuses) unchanged.
+        b.client.http.retry = RetryPolicy(base_delay_s=0.001, max_delay_s=0.002)
+        return b
+
+    def test_put_survives_500_500_sequence(self, emulator, backend):
+        emulator.inject_error(500, "InternalError", when=lambda m, p: m == "PUT")
+        emulator.inject_error(500, "InternalError", when=lambda m, p: m == "PUT")
+        key = ObjectKey("retry/put.log")
+        assert backend.upload(io.BytesIO(b"x" * 64), key) == 64
+        with backend.fetch(key) as s:
+            assert s.read() == b"x" * 64
+        with emulator.state.lock:
+            assert not emulator.state.fail_next  # both injections consumed
+
+    def test_fetch_survives_429_throttle_and_counts_it(self, emulator, backend):
+        from tieredstorage_tpu.storage.s3.metrics import GROUP
+
+        key = ObjectKey("retry/get.log")
+        backend.upload(io.BytesIO(b"data"), key)
+        emulator.inject_error(429, "SlowDown", when=lambda m, p: m == "GET")
+        with backend.fetch(key) as s:
+            assert s.read() == b"data"
+        reg = backend.metrics.registry
+        assert reg.value(MetricName.of("throttling-errors-total", GROUP)) == 1.0
+
+    def test_bulk_delete_post_survives_500(self, emulator, backend):
+        key = ObjectKey("retry/delete.log")
+        backend.upload(io.BytesIO(b"x"), key)
+        emulator.inject_error(500, "InternalError", when=lambda m, p: m == "POST")
+        backend.delete_all([key])  # DeleteObjects POST is replay-safe
+        with pytest.raises(Exception):
+            backend.fetch(key).read()
+
+    def test_exhausted_retries_surface_the_error(self, emulator, backend):
+        from tieredstorage_tpu.storage.core import StorageBackendException
+
+        for _ in range(3):
+            emulator.inject_error(500, "InternalError", when=lambda m, p: m == "PUT")
+        with pytest.raises(StorageBackendException):
+            backend.upload(io.BytesIO(b"x"), ObjectKey("retry/doomed.log"))
+
+
+class TestGcsFaultInjection:
+    @pytest.fixture(scope="class")
+    def emulator(self):
+        emu = GcsEmulator().start()
+        yield emu
+        emu.stop()
+
+    @pytest.fixture
+    def backend(self, emulator):
+        from tieredstorage_tpu.storage.gcs import GcsStorage
+
+        with emulator.state.lock:
+            emulator.state.objects.clear()
+            emulator.state.fail_next.clear()
+        b = GcsStorage()
+        b.configure({"gcs.bucket.name": "bkt", "gcs.endpoint.url": emulator.endpoint})
+        b.http.retry = RetryPolicy(base_delay_s=0.001, max_delay_s=0.002)
+        return b
+
+    def test_resumable_chunk_survives_503_sequence(self, emulator, backend):
+        backend.chunk_size = 256 * 1024
+        emulator.inject_error(503, when=lambda m, p: m == "PUT" and "upload_id" in p)
+        emulator.inject_error(503, when=lambda m, p: m == "PUT" and "upload_id" in p)
+        data = bytes(600 * 1024)
+        key = ObjectKey("retry/resumable.log")
+        assert backend.upload(io.BytesIO(data), key) == len(data)
+        with backend.fetch(key) as s:
+            assert s.read() == data
+
+    def test_fetch_survives_500(self, emulator, backend):
+        key = ObjectKey("retry/fetch.log")
+        backend.upload(io.BytesIO(b"payload"), key)
+        emulator.inject_error(500, when=lambda m, p: m == "GET")
+        with backend.fetch(key) as s:
+            assert s.read() == b"payload"
+
+
+class TestAzureFaultInjection:
+    ACCOUNT = "devaccount"
+
+    @pytest.fixture(scope="class")
+    def emulator(self):
+        import base64
+
+        key = base64.b64encode(b"a-thirty-two-byte-secret-key!!!!").decode()
+        emu = AzureEmulator(account=self.ACCOUNT, account_key=key).start()
+        emu.account_key_b64 = key
+        yield emu
+        emu.stop()
+
+    @pytest.fixture
+    def backend(self, emulator):
+        from tieredstorage_tpu.storage.azure import AzureBlobStorage
+
+        with emulator.state.lock:
+            emulator.state.blobs.clear()
+            emulator.state.fail_next.clear()
+        b = AzureBlobStorage()
+        b.configure(
+            {
+                "azure.account.name": self.ACCOUNT,
+                "azure.account.key": emulator.account_key_b64,
+                "azure.container.name": "cont",
+                "azure.endpoint.url": emulator.endpoint,
+            }
+        )
+        b.http.retry = RetryPolicy(base_delay_s=0.001, max_delay_s=0.002)
+        return b
+
+    def test_put_blob_survives_503(self, emulator, backend):
+        emulator.inject_error(503, when=lambda m, p: m == "PUT")
+        key = ObjectKey("retry/blob.log")
+        assert backend.upload(io.BytesIO(b"z" * 32), key) == 32
+        with backend.fetch(key) as s:
+            assert s.read() == b"z" * 32
+
+    def test_fetch_survives_500_then_429(self, emulator, backend):
+        key = ObjectKey("retry/blob2.log")
+        backend.upload(io.BytesIO(b"abc"), key)
+        emulator.inject_error(500, when=lambda m, p: m == "GET")
+        emulator.inject_error(429, when=lambda m, p: m == "GET")
+        with backend.fetch(key) as s:
+            assert s.read() == b"abc"
+
+
+class TestS3TimeoutWiring:
+    """s3.api.call.attempt.timeout must reach the per-attempt socket timeout
+    and s3.api.call.timeout the retry deadline (VERDICT r3 weak 4: the
+    attempt key was documented, validated, and wired to nothing)."""
+
+    def _backend(self, **extra):
+        from tieredstorage_tpu.storage.s3 import S3Storage
+
+        b = S3Storage()
+        b.configure(
+            {
+                "s3.bucket.name": "bkt",
+                "s3.endpoint.url": "http://localhost:1",
+                **extra,
+            }
+        )
+        return b
+
+    def test_both_keys_wired(self):
+        b = self._backend(
+            **{"s3.api.call.timeout": 30000, "s3.api.call.attempt.timeout": 5000}
+        )
+        assert b.client.http.timeout == 5.0
+        assert b.client.http.retry.total_deadline_s == 30.0
+
+    def test_call_timeout_alone_bounds_attempts_too(self):
+        b = self._backend(**{"s3.api.call.timeout": 30000})
+        assert b.client.http.timeout == 30.0
+        assert b.client.http.retry.total_deadline_s == 30.0
+
+    def test_neither_key_means_no_deadline(self):
+        b = self._backend()
+        assert b.client.http.timeout is None
+        assert b.client.http.retry.total_deadline_s is None
+        assert b.client.http.retry.max_attempts == 3
+
+
+def test_concurrent_retries_are_thread_independent():
+    """Per-thread pooled connections + the retry loop must not interleave
+    state across threads (the chunk cache fetches in a pool)."""
+    client = HttpClient("http://test.invalid", retry=FAST)
+    local = threading.local()
+
+    class Resp:
+        def __init__(self, status):
+            self.status = status
+
+        def read(self, *a):
+            return b""
+
+        def getheaders(self):
+            return []
+
+    def new_conn():
+        class Conn:
+            def request(self, *a, **k):
+                pass
+
+            def getresponse(self):
+                # Each thread: one 500 then 200s.
+                if not getattr(local, "failed", False):
+                    local.failed = True
+                    return Resp(500)
+                return Resp(200)
+
+            def close(self):
+                pass
+
+        return Conn()
+
+    client._new_connection = new_conn  # type: ignore[method-assign]
+    results = []
+
+    def worker():
+        results.append(client.request("GET", "/k").status)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert results == [200] * 8

@@ -97,11 +97,21 @@ class TestGcsResumableUpload:
 
         backend = make_backend(emulator)
         backend.chunk_size = 256 * 1024
-        emulator.inject_error(
-            500, when=lambda m, p: m == "PUT" and "upload_id" in p
-        )
+        # Speed up the recovery backoff sleeps for the doomed upload.
+        from tieredstorage_tpu.storage.httpclient import RetryPolicy
+
+        backend.http.retry = RetryPolicy(base_delay_s=0.001, max_delay_s=0.002)
+        # Chunk PUTs recover via committed-offset probes (and the probes
+        # themselves ride transport retries), so a run of injected 500s must
+        # be long enough to exhaust every layer before the error surfaces.
+        for _ in range(20):
+            emulator.inject_error(
+                500, when=lambda m, p: m == "PUT" and "upload_id" in p
+            )
         with pytest.raises(StorageBackendException):
             backend.upload(io.BytesIO(bytes(600 * 1024)), ObjectKey("fail.log"))
+        with emulator.state.lock:
+            emulator.state.fail_next.clear()
 
 
 class TestGcsCredentialConfig:

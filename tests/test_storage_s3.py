@@ -73,9 +73,13 @@ class TestS3Multipart:
 
         # Create and part 1 succeed; part 2 fails → abort must run so no
         # multipart state dangles (reference: S3MultiPartOutputStream abort).
-        emulator.inject_error(
-            500, "InternalError", when=lambda m, p: m == "PUT" and "partNumber=2" in p
-        )
+        # Inject enough 500s to exhaust the transport's retry budget — a
+        # single one would be retried away (which is the point of the
+        # policy; TestRetryPolicy in test_retry.py covers that side).
+        for _ in range(3):
+            emulator.inject_error(
+                500, "InternalError", when=lambda m, p: m == "PUT" and "partNumber=2" in p
+            )
         with pytest.raises(StorageBackendException):
             backend.upload(io.BytesIO(bytes(5000)), key)
         with emulator.state.lock:
@@ -114,8 +118,8 @@ class TestS3Metrics:
         with pytest.raises(Exception):
             with backend.fetch(ObjectKey("whatever")) as s:
                 s.read()
-        # 503 is recorded against the throttling class before the status is
-        # surfaced; the fetch also raised (streamed GET has no retry).
+        # The 503 attempt is recorded against the throttling class; the
+        # streamed GET then retries and surfaces the 404 for the missing key.
         assert reg.value(MetricName.of("throttling-errors-total", S3_GROUP)) == 1.0
 
 

@@ -9,6 +9,7 @@ client-side range validation against the blob size.
 
 from __future__ import annotations
 
+import time
 from typing import BinaryIO, Mapping, Optional
 from urllib.parse import quote, urlsplit
 
@@ -27,6 +28,12 @@ from tieredstorage_tpu.storage.httpclient import HttpClient, HttpError
 from tieredstorage_tpu.storage.proxy import ProxyConfig, socks5_socket_factory
 
 _COPY_BUFFER = 1024 * 1024
+
+#: Statuses a resumable chunk PUT recovers from by probing the committed
+#: offset (mirrors the transport RetryPolicy statuses, but the recovery is
+#: protocol-level — see _upload_session).
+_RECOVERABLE_STATUSES = frozenset({429, 500, 502, 503, 504})
+_MAX_CHUNK_RECOVERIES = 3
 
 
 def _committed_bytes(range_header: str) -> int:
@@ -138,18 +145,47 @@ class GcsStorage(StorageBackend):
             return 0
         upcoming = next(chunks, None)
         stalls = 0
+        recoveries = 0
         while current is not None:
             final = upcoming is None
             total = str(offset + len(current)) if final else "*"
             content_range = f"bytes {offset}-{offset + len(current) - 1}/{total}"
-            resp = http.request(
-                "PUT",
-                session_path,
-                headers=self._headers({"Content-Range": content_range}),
-                body=current,
-            )
-            if final and resp.status in (200, 201):
+            # idempotent=False: a resumable chunk PUT is ORDER-STATEFUL — a
+            # blind transport replay after the server committed the bytes
+            # would collide with the advanced session offset. Recovery is
+            # protocol-level instead: probe the committed offset
+            # ('bytes */total', per the resumable spec) and resume from it,
+            # which is what the reference's google-cloud-storage SDK does.
+            try:
+                resp = http.request(
+                    "PUT",
+                    session_path,
+                    headers=self._headers({"Content-Range": content_range}),
+                    body=current,
+                    idempotent=False,
+                )
+                transport_error = None
+            except HttpError as e:
+                resp = None
+                transport_error = e
+            if resp is not None and final and resp.status in (200, 201):
                 return offset + len(current)
+            if resp is None or resp.status in _RECOVERABLE_STATUSES:
+                recoveries += 1
+                if recoveries > _MAX_CHUNK_RECOVERIES:
+                    if transport_error is not None:
+                        raise StorageBackendException(
+                            f"Resumable upload for {key} failed"
+                        ) from transport_error
+                    raise StorageBackendException(
+                        f"Resumable chunk for {key} not accepted after "
+                        f"{recoveries} recoveries: HTTP {resp.status}"
+                    )
+                time.sleep(http.retry.backoff_s(recoveries - 1))
+                resp = self._probe_session(http, session_path, total)
+                if final and resp.status in (200, 201):
+                    # The lost response had finalized the object.
+                    return offset + len(current)
             if resp.status != 308:
                 raise StorageBackendException(
                     f"Resumable {'finalize' if final else 'chunk'} for {key} "
@@ -168,6 +204,7 @@ class GcsStorage(StorageBackend):
                         )
                 else:
                     stalls = 0
+                    recoveries = 0  # forward progress, like the stall counter
                     current = current[committed - offset :]
                     offset = committed
                 continue
@@ -177,9 +214,21 @@ class GcsStorage(StorageBackend):
                     f"(HTTP 308 at committed={committed})"
                 )
             stalls = 0
+            recoveries = 0
             offset += len(current)
             current, upcoming = upcoming, next(chunks, None)
         raise AssertionError("unreachable: final chunk returns inside the loop")
+
+    def _probe_session(self, http: HttpClient, session_path: str, total: str):
+        """Query a resumable session's committed offset: an empty-body PUT
+        with 'Content-Range: bytes */<total>' ('*' when unknown). Replay-safe
+        by construction, so the transport may retry it."""
+        return http.request(
+            "PUT",
+            session_path,
+            headers=self._headers({"Content-Range": f"bytes */{total}"}),
+            idempotent=True,
+        )
 
     # ---------------------------------------------------------------- fetch
     def fetch(self, key: ObjectKey, byte_range: Optional[BytesRange] = None) -> BinaryIO:

@@ -13,17 +13,69 @@ proxying (storage/core/.../proxy/).
 
 from __future__ import annotations
 
+import dataclasses
 import http.client
 import io
+import random
 import socket
 import ssl
 import threading
+import time
 from typing import BinaryIO, Callable, Mapping, Optional
 from urllib.parse import urlsplit
 
 
 class HttpError(Exception):
     """Transport-level failure (connect/read), not an HTTP status."""
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff with full jitter for transient failures.
+
+    The reference inherits retry behavior from the vendor SDKs (AWS SDK v2
+    standard retry mode — storage/s3/.../S3StorageConfig.java:65-68 exposes a
+    per-attempt timeout precisely because the SDK retries; the GCS and Azure
+    SDKs ship equivalent policies). This is the hand-rolled transport's
+    equivalent: replay-safe requests are retried on transport failures and on
+    throttle/server statuses, sleeping full-jitter exponential backoff
+    between attempts and honoring Retry-After within `max_delay_s`.
+
+    `total_deadline_s` bounds the whole call including backoff sleeps (the
+    reference's `api.call.timeout` semantics: "including all retries"); the
+    per-attempt socket timeout lives on the HttpClient itself
+    (`api.call.attempt.timeout`)."""
+
+    max_attempts: int = 3
+    base_delay_s: float = 0.1
+    max_delay_s: float = 5.0
+    total_deadline_s: Optional[float] = None
+    retry_statuses: frozenset = frozenset({429, 500, 502, 503, 504})
+
+    def backoff_s(self, retry_number: int, retry_after_s: Optional[float] = None) -> float:
+        """Sleep before retry `retry_number` (0-based): U(0, min(max, base*2^n)),
+        raised to the server's Retry-After when given (capped at max_delay_s —
+        a server asking for minutes should surface the error, not block the
+        fetch path)."""
+        cap = min(self.max_delay_s, self.base_delay_s * (2**retry_number))
+        delay = random.uniform(0.0, cap)
+        if retry_after_s is not None:
+            delay = max(delay, min(retry_after_s, self.max_delay_s))
+        return delay
+
+
+#: Disables retries entirely (single attempt) — for tests and callers that
+#: layer their own replay logic.
+NO_RETRY = RetryPolicy(max_attempts=1)
+
+
+def _parse_retry_after(value: str) -> Optional[float]:
+    """Seconds form only ('Retry-After: 2'); HTTP-date form is rare from
+    object stores and not worth a date parser on this path."""
+    try:
+        return max(0.0, float(value))
+    except (TypeError, ValueError):
+        return None
 
 
 class HttpResponse:
@@ -118,6 +170,7 @@ class HttpClient:
         verify_tls: bool = True,
         socket_factory: Optional[SocketFactory] = None,
         observer: Optional[Observer] = None,
+        retry: Optional[RetryPolicy] = None,
     ) -> None:
         parts = urlsplit(base_url)
         if parts.scheme not in ("http", "https"):
@@ -132,6 +185,7 @@ class HttpClient:
         self.timeout = timeout
         self.socket_factory = socket_factory
         self.observer = observer
+        self.retry = retry if retry is not None else RetryPolicy()
         self._local = threading.local()
         if self.scheme == "https":
             self._ssl_context = ssl.create_default_context()
@@ -175,14 +229,57 @@ class HttpClient:
         body: bytes = b"",
         idempotent: Optional[bool] = None,
     ) -> HttpResponse:
-        """Issue a request and read the full response body.
+        """Issue a request and read the full response body, retrying
+        replay-safe requests per the client's RetryPolicy.
 
         `idempotent` overrides the method-based replay classification for
         calls the caller KNOWS are safe to replay (e.g. S3 DeleteObjects is
-        a POST, but deleting already-deleted keys is a no-op)."""
-        import time as _time
+        a POST, but deleting already-deleted keys is a no-op). Non-replay-
+        safe requests get exactly one attempt (plus `_roundtrip`'s
+        stale-keepalive replay when the failure happened before the request
+        was fully sent)."""
+        policy = self.retry
+        replay_safe = (
+            idempotent if idempotent is not None else method in self._IDEMPOTENT
+        )
+        deadline = (
+            time.monotonic() + policy.total_deadline_s
+            if policy.total_deadline_s is not None
+            else None
+        )
+        retry_number = 0
+        while True:
+            try:
+                resp = self._request_once(method, path_and_query, headers, body, idempotent)
+            except HttpError:
+                if not replay_safe or retry_number >= policy.max_attempts - 1:
+                    raise
+                delay = policy.backoff_s(retry_number)
+                if deadline is not None and time.monotonic() + delay > deadline:
+                    raise
+                time.sleep(delay)
+                retry_number += 1
+                continue
+            if (
+                replay_safe
+                and resp.status in policy.retry_statuses
+                and retry_number < policy.max_attempts - 1
+            ):
+                delay = policy.backoff_s(
+                    retry_number, _parse_retry_after(resp.header("retry-after"))
+                )
+                if deadline is None or time.monotonic() + delay <= deadline:
+                    time.sleep(delay)
+                    retry_number += 1
+                    continue
+            return resp
 
-        t0 = _time.perf_counter()
+    def _request_once(
+        self, method, path_and_query, headers, body, idempotent
+    ) -> HttpResponse:
+        """One attempt (the retry loop's unit); the observer sees every
+        attempt, so per-attempt rates/errors match what went on the wire."""
+        t0 = time.perf_counter()
         err: Optional[BaseException] = None
         status = 0
         try:
@@ -196,7 +293,7 @@ class HttpClient:
             raise HttpError(f"{method} {path_and_query} failed: {e}") from e
         finally:
             if self.observer is not None:
-                self.observer(method, path_and_query, status, _time.perf_counter() - t0, err)
+                self.observer(method, path_and_query, status, time.perf_counter() - t0, err)
 
     def request_stream(
         self,
@@ -205,10 +302,45 @@ class HttpClient:
         *,
         headers: Optional[Mapping[str, str]] = None,
     ) -> tuple[int, Mapping[str, str], BinaryIO]:
-        """Issue a request on a dedicated connection; the returned stream owns it."""
-        import time as _time
+        """Issue a request on a dedicated connection; the returned stream
+        owns it. The initial exchange retries per the policy for idempotent
+        methods only (a streamed POST must not be blindly replayed); once
+        the stream is handed out, a mid-body failure surfaces to the caller
+        (the fetch path re-requests with an adjusted Range rather than
+        replaying a partially consumed body)."""
+        policy = self.retry if method in self._IDEMPOTENT else NO_RETRY
+        deadline = (
+            time.monotonic() + policy.total_deadline_s
+            if policy.total_deadline_s is not None
+            else None
+        )
+        retry_number = 0
+        while True:
+            try:
+                status, hdrs, stream = self._stream_once(method, path_and_query, headers)
+            except HttpError:
+                if retry_number >= policy.max_attempts - 1:
+                    raise
+                delay = policy.backoff_s(retry_number)
+                if deadline is not None and time.monotonic() + delay > deadline:
+                    raise
+                time.sleep(delay)
+                retry_number += 1
+                continue
+            if status in policy.retry_statuses and retry_number < policy.max_attempts - 1:
+                retry_after = _parse_retry_after(hdrs.get("retry-after", ""))
+                delay = policy.backoff_s(retry_number, retry_after)
+                if deadline is None or time.monotonic() + delay <= deadline:
+                    stream.close()
+                    time.sleep(delay)
+                    retry_number += 1
+                    continue
+            return status, hdrs, stream
 
-        t0 = _time.perf_counter()
+    def _stream_once(
+        self, method, path_and_query, headers
+    ) -> tuple[int, Mapping[str, str], BinaryIO]:
+        t0 = time.perf_counter()
         conn = self._new_connection()
         try:
             conn.request(method, path_and_query, body=None, headers=dict(headers or {}))
@@ -216,10 +348,10 @@ class HttpClient:
         except (OSError, http.client.HTTPException) as e:
             conn.close()
             if self.observer is not None:
-                self.observer(method, path_and_query, 0, _time.perf_counter() - t0, e)
+                self.observer(method, path_and_query, 0, time.perf_counter() - t0, e)
             raise HttpError(f"{method} {path_and_query} failed: {e}") from e
         if self.observer is not None:
-            self.observer(method, path_and_query, resp.status, _time.perf_counter() - t0, None)
+            self.observer(method, path_and_query, resp.status, time.perf_counter() - t0, None)
         hdrs = {k.lower(): v for k, v in resp.getheaders()}
         return resp.status, hdrs, _StreamedBody(resp, conn)
 

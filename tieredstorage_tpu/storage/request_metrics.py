@@ -4,9 +4,11 @@ Each cloud backend taps the HttpClient observer hook with a collector that
 only differs in its metric group and request classifier — the analogue of
 the reference's per-SDK MetricCollectors (S3 MetricPublisher, GCS transport
 wrapper, Azure pipeline policy — SURVEY §2.9). Sensors per operation:
-requests (rate+total), time (avg+max); error classes: throttling (503),
-server (5xx), io (transport failures) — names after
-storage/s3/.../MetricRegistry.java:26-70.
+requests (rate+total), time (avg+max); error classes: throttling (429/503),
+server (other 5xx), io (transport failures) — names after
+storage/s3/.../MetricRegistry.java:26-70. The HttpClient observer fires per
+ATTEMPT, so retried throttles/errors are each counted like the reference's
+per-attempt SDK metrics.
 """
 
 from __future__ import annotations
@@ -84,7 +86,7 @@ class RequestMetricCollector:
         self._time_sensor(op).record(elapsed_s * 1000.0)
         if error is not None:
             self._error_sensor("io").record(1.0)
-        elif status == 503:
+        elif status in (429, 503):
             self._error_sensor("throttling").record(1.0)
         elif status >= 500:
             self._error_sensor("server").record(1.0)

@@ -15,7 +15,13 @@ import xml.etree.ElementTree as ET
 from typing import BinaryIO, Mapping, Optional
 from urllib.parse import quote
 
-from tieredstorage_tpu.storage.httpclient import HttpClient, HttpResponse, Observer, SocketFactory
+from tieredstorage_tpu.storage.httpclient import (
+    HttpClient,
+    HttpResponse,
+    Observer,
+    RetryPolicy,
+    SocketFactory,
+)
 from tieredstorage_tpu.storage.s3.signer import SigV4Signer
 
 
@@ -53,6 +59,7 @@ class S3Client:
         checksum_check: bool = False,
         socket_factory: Optional[SocketFactory] = None,
         observer: Optional[Observer] = None,
+        retry: Optional[RetryPolicy] = None,
     ) -> None:
         self.bucket = bucket
         self.checksum_check = checksum_check
@@ -72,6 +79,7 @@ class S3Client:
             verify_tls=verify_tls,
             socket_factory=socket_factory,
             observer=observer,
+            retry=retry,
         )
         self.signer = (
             SigV4Signer(access_key, secret_key, region)
@@ -198,7 +206,11 @@ class S3Client:
             )
 
     def create_multipart_upload(self, key: str) -> str:
-        resp = self._call("POST", key, query={"uploads": ""})
+        # Replay-safe despite being a POST: a duplicate CreateMultipartUpload
+        # just opens a second upload id whose parts are never completed, and
+        # the abort-on-error path (multipart.py) cleans the one we keep a
+        # handle to; the AWS SDK retries this call for the same reason.
+        resp = self._call("POST", key, query={"uploads": ""}, idempotent=True)
         root = ET.fromstring(resp.body)
         ns = root.tag.partition("}")[0] + "}" if root.tag.startswith("{") else ""
         upload_id = root.findtext(f"{ns}UploadId")

@@ -179,6 +179,10 @@ class S3StorageConfig:
         return self._values.get("s3.api.call.timeout")
 
     @property
+    def api_call_attempt_timeout_ms(self) -> Optional[int]:
+        return self._values.get("s3.api.call.attempt.timeout")
+
+    @property
     def access_key_id(self) -> Optional[str]:
         return self._values.get("aws.access.key.id")
 

@@ -17,7 +17,7 @@ from tieredstorage_tpu.storage.core import (
     StorageBackend,
     StorageBackendException,
 )
-from tieredstorage_tpu.storage.httpclient import HttpError
+from tieredstorage_tpu.storage.httpclient import HttpError, RetryPolicy
 from tieredstorage_tpu.storage.proxy import ProxyConfig, socks5_socket_factory
 from tieredstorage_tpu.storage.s3.client import S3ApiError, S3Client
 from tieredstorage_tpu.storage.s3.config import S3StorageConfig
@@ -38,11 +38,22 @@ class S3Storage(StorageBackend):
         from tieredstorage_tpu.storage.s3.metrics import S3MetricCollector
 
         self._metric_collector = S3MetricCollector()
-        timeout = (
+        # Reference semantics (S3StorageConfig.java:65-68 / AWS SDK): the
+        # call timeout covers the whole call INCLUDING retries, the attempt
+        # timeout covers one attempt. Map the former onto the retry policy's
+        # total deadline and the latter onto the per-attempt socket timeout
+        # (falling back to the call timeout when only that one is set).
+        call_timeout_s = (
             config.api_call_timeout_ms / 1000.0
             if config.api_call_timeout_ms is not None
             else None
         )
+        attempt_timeout_s = (
+            config.api_call_attempt_timeout_ms / 1000.0
+            if config.api_call_attempt_timeout_ms is not None
+            else call_timeout_s
+        )
+        retry = RetryPolicy(total_deadline_s=call_timeout_s)
         self.part_size = config.part_size
         self.client = S3Client(
             config.bucket_name,
@@ -51,11 +62,12 @@ class S3Storage(StorageBackend):
             path_style=config.path_style_access,
             access_key=config.access_key_id,
             secret_key=config.secret_access_key,
-            timeout=timeout,
+            timeout=attempt_timeout_s,
             verify_tls=config.certificate_check_enabled,
             checksum_check=config.checksum_check_enabled,
             socket_factory=socks5_socket_factory(proxy),
             observer=self._metric_collector.observe,
+            retry=retry,
         )
 
     def _require_client(self) -> S3Client:
