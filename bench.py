@@ -429,6 +429,26 @@ def run_bench() -> dict:
     except Exception as exc:
         extras["thuff_error"] = f"{type(exc).__name__}: {exc}"
         _err(f"[bench] tpu-huff-v1 codec failed: {extras['thuff_error']}")
+
+    # Device LZ codec (tpu-lzhuff-v1): match-finding + Huffman on-chip,
+    # sequence serialization host-side, incl transfers. Same guard.
+    try:
+        from tieredstorage_tpu.transform import lzhuff as lzhuff_codec
+
+        lzhuff_codec.compress_batch(chunks)  # warm jit at the timed shape
+        t0 = time.perf_counter()
+        lframes = lzhuff_codec.compress_batch(chunks)
+        lzhuff_s = time.perf_counter() - t0
+        lratio = sum(len(c) for c in lframes) / total_bytes
+        extras["lzhuff_compress_gibs"] = round(gib / lzhuff_s, 3)
+        extras["lzhuff_ratio"] = round(lratio, 3)
+        _err(
+            f"[bench] tpu-lzhuff-v1 device codec (incl tunnel): "
+            f"{gib / lzhuff_s:.3f} GiB/s, ratio {lratio:.3f}"
+        )
+    except Exception as exc:
+        extras["lzhuff_error"] = f"{type(exc).__name__}: {exc}"
+        _err(f"[bench] tpu-lzhuff-v1 codec failed: {extras['lzhuff_error']}")
     for name, agg in sorted(tpu.tracer.summary().items()):
         _err(
             f"[bench]   span {name}: n={agg['count']} "

@@ -297,4 +297,44 @@ int ts_aes_gcm_decrypt_batch(const uint8_t *key, const uint8_t *aad, uint64_t aa
   return err.load();
 }
 
+// Expand one tpu-lzhuff-v1 sequence stream (transform/lzhuff.py): n_seq
+// records of <lit_len u16, match_len u16, offset u16>, literals consumed
+// from `lits`. Offset 0 on a match repeats the previous match's offset
+// (the rep-offset sentinel); offsets may be smaller than the match length
+// (overlapped copy — how runs encode). Returns 0 on success; 1 = literal
+// overflow, 2 = match outside the decoded prefix, 3 = totals mismatch.
+// The role the reference's zstd-jni native decode path plays, for this
+// build's codec.
+int ts_lz_expand(const uint16_t* seqs, int n_seq,
+                 const uint8_t* lits, uint64_t lit_total,
+                 uint8_t* out, uint64_t out_len) {
+  uint64_t o = 0, lp = 0, last_d = 0;
+  for (int i = 0; i < n_seq; ++i) {
+    const uint64_t lit = seqs[3 * i];
+    const uint64_t m = seqs[3 * i + 1];
+    uint64_t d = seqs[3 * i + 2];
+    if (lit) {
+      if (lp + lit > lit_total || o + lit > out_len) return 1;
+      std::memcpy(out + o, lits + lp, lit);
+      o += lit;
+      lp += lit;
+    }
+    if (m) {
+      if (d == 0) d = last_d;  // repeat-offset sentinel
+      last_d = d;
+      if (d < 1 || d > o || o + m > out_len) return 2;
+      if (d >= m) {
+        std::memcpy(out + o, out + o - d, m);
+      } else {
+        uint8_t* dst = out + o;
+        const uint8_t* src = out + o - d;
+        for (uint64_t j = 0; j < m; ++j) dst[j] = src[j];
+      }
+      o += m;
+    }
+  }
+  if (o != out_len || lp != lit_total) return 3;
+  return 0;
+}
+
 }  // extern "C"

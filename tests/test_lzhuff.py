@@ -1,0 +1,250 @@
+"""tpu-lzhuff-v1: the LZ match layer over the device Huffman codec
+(VERDICT r3 item 3 — the reference's zstd analogue,
+core/.../transform/CompressionChunkEnumeration.java:50-63).
+
+Covers round trips across data classes, the RAW fallback, u16 splits and
+the same-distance merge, the rep-offset sentinel and offset dictionary,
+native/numpy expander equivalence, malformed-frame rejection, and the
+transform-backend dispatch."""
+
+from __future__ import annotations
+
+import random
+import struct
+
+import numpy as np
+import pytest
+
+from tieredstorage_tpu.transform import lzhuff
+from tieredstorage_tpu.transform.lzhuff import (
+    LzhuffFormatError,
+    _BODY,
+    _HEADER,
+    compress_batch,
+    decompress_batch,
+)
+
+
+def logs_corpus(n_records: int = 2000) -> bytes:
+    recs = []
+    for i in range(n_records):
+        recs.append(
+            (
+                '{"ts":"2026-07-30T12:%02d:%02d","level":"INFO",'
+                '"msg":"fetch follower %d partition topic-%d-%d offset %d"}\n'
+                % (i // 60 % 60, i % 60, i % 5, i % 20, i % 8, 1000000 + i * 17)
+            ).encode()
+        )
+    return b"".join(recs)
+
+
+def text_corpus() -> bytes:
+    import glob
+
+    files = sorted(glob.glob("/root/repo/tieredstorage_tpu/*.py"))
+    return b"".join(open(f, "rb").read() for f in files)[:120_000]
+
+
+class TestRoundTrip:
+    @pytest.mark.parametrize(
+        "name,data",
+        [
+            ("logs", logs_corpus()[:100_000]),
+            ("zeros", b"\x00" * 100_000),  # >u16 match: split + merge path
+            ("runs", b"ab" * 40_000),
+            ("tiny", b"hello world, hello world, hello world!"),
+            ("sub-min", b"xy"),
+            ("empty", b""),
+            ("single", b"\x42"),
+        ],
+    )
+    def test_single_chunk(self, name, data):
+        frames = compress_batch([data])
+        assert decompress_batch(frames) == [data]
+
+    def test_random_falls_back_to_raw(self):
+        rng = random.Random(0)
+        data = bytes(rng.getrandbits(8) for _ in range(50_000))
+        frames = compress_batch([data])
+        assert len(frames[0]) == _HEADER.size + len(data)  # RAW, header only
+        assert decompress_batch(frames) == [data]
+
+    def test_mixed_batch(self):
+        rng = random.Random(1)
+        chunks = [
+            logs_corpus()[:80_000],
+            b"",
+            bytes(rng.getrandbits(8) for _ in range(10_000)),
+            b"\x00" * 30_000,
+            text_corpus()[:40_000],
+        ]
+        frames = compress_batch(chunks)
+        assert decompress_batch(frames) == chunks
+
+    def test_compresses_repetitive_data_well(self):
+        data = logs_corpus()[:100_000]
+        frames = compress_batch([data])
+        ratio = len(frames[0]) / len(data)
+        assert ratio < 0.25, f"LZ layer missing its point: ratio {ratio:.3f}"
+        from tieredstorage_tpu.transform import thuff
+
+        order0 = len(thuff.compress_batch([data])[0]) / len(data)
+        assert ratio < order0 / 2, "LZ should at least halve order-0 Huffman"
+
+
+class TestFormatInternals:
+    def test_offset_dictionary_engages_on_structured_data(self):
+        data = logs_corpus()[:100_000]
+        frame = compress_batch([data])[0]
+        _, _, flags, _ = _HEADER.unpack_from(frame)
+        assert not flags & 0x01  # coded, not RAW
+        n_dict = _BODY.unpack_from(frame[_HEADER.size :])[2]
+        assert 0 < n_dict <= 255
+
+    def test_wide_offsets_disable_the_dictionary(self):
+        # A chunk whose matches land at many distinct distances: random
+        # blocks repeated once each at spread-out positions.
+        rng = random.Random(2)
+        blocks = [
+            bytes(rng.getrandbits(8) for _ in range(64)) for _ in range(400)
+        ]
+        data = b"".join(
+            blocks[i] + blocks[rng.randrange(max(1, i))] for i in range(400)
+        )
+        frame = compress_batch([data])[0]
+        _, _, flags, _ = _HEADER.unpack_from(frame)
+        if not flags & 0x01:
+            n_dict = _BODY.unpack_from(frame[_HEADER.size :])[2]
+            # Either dict mode with many entries or disabled — both legal;
+            # pin only that decode agrees.
+            assert n_dict <= 255
+        assert decompress_batch([frame]) == [data]
+
+    def test_sequences_split_long_literals_and_matches(self):
+        from tieredstorage_tpu.transform.lzhuff import _sequences
+
+        n = 200_000
+        sel = np.zeros(n, bool)
+        lens = np.zeros(n, np.int32)
+        dists = np.zeros(n, np.int32)
+        sel[0] = True  # literal run of 70_000 (> u16)
+        sel[70_000] = True
+        lens[70_000] = 60_000  # merged long match carried over records
+        dists[70_000] = 70_000
+        # The parse walks: 0 -> 70_000 -> 130_000 (literal tail to n).
+        sel[130_000] = True
+        records, lit_slices = _sequences(sel, lens, dists, n)
+        assert (records[:, 0] <= 0xFFFF).all() and (records[:, 1] <= 0xFFFF).all()
+        assert records[:, 0].sum() == 70_000 + (n - 130_000)
+        assert records[:, 1].sum() == 60_000
+        assert lit_slices == [(0, 70_000), (130_000, n)]
+
+    def test_numpy_and_native_expanders_agree(self):
+        from tieredstorage_tpu import native
+
+        if native.load() is None or not hasattr(native.load(), "ts_lz_expand"):
+            pytest.skip("native library unavailable")
+        data = logs_corpus()[:60_000] + b"\x00" * 10_000
+        frames = compress_batch([data])
+        # Native path (default)
+        assert decompress_batch(frames) == [data]
+        # Forced numpy path
+        import unittest.mock as mock
+
+        with mock.patch.object(native, "lz_expand", return_value=None):
+            assert decompress_batch(frames) == [data]
+
+    def test_rep_sentinel_round_trips(self):
+        # Periodic data (one dominant distance): sentinel-heavy stream.
+        data = (b"0123456789abcdef" * 4096)[:50_000]
+        frames = compress_batch([data])
+        assert decompress_batch(frames) == [data]
+
+
+class TestMalformedFrames:
+    def frame(self, data=b"payload " * 8000):
+        return compress_batch([data])[0], data
+
+    def test_bad_magic(self):
+        f, _ = self.frame()
+        with pytest.raises(LzhuffFormatError, match="magic"):
+            decompress_batch([b"XX" + f[2:]])
+
+    def test_short_frame(self):
+        with pytest.raises(LzhuffFormatError, match="shorter"):
+            decompress_batch([b"TL"])
+
+    def test_raw_length_mismatch(self):
+        raw = _HEADER.pack(b"TL", 1, 0x01, 10) + b"short"
+        with pytest.raises(LzhuffFormatError, match="raw frame length"):
+            decompress_batch([raw])
+
+    def test_declared_size_over_limit(self):
+        f, _ = self.frame()
+        with pytest.raises(LzhuffFormatError, match="chunk limit"):
+            decompress_batch([f], max_original_chunk_size=16)
+
+    def test_truncated_directory(self):
+        f, _ = self.frame()
+        if len(f) < _HEADER.size + _BODY.size:
+            pytest.skip("frame fell back to RAW")
+        with pytest.raises(LzhuffFormatError):
+            decompress_batch([f[: _HEADER.size + _BODY.size - 2]])
+
+    def test_directory_not_covering_body(self):
+        f, _ = self.frame()
+        with pytest.raises(LzhuffFormatError, match="directory"):
+            decompress_batch([f + b"\x00"])
+
+    def test_implausible_sequence_count(self):
+        f, _ = self.frame()
+        hdr, body = f[: _HEADER.size], bytearray(f[_HEADER.size :])
+        struct.pack_into("<I", body, 0, 1 << 30)
+        with pytest.raises(LzhuffFormatError):
+            decompress_batch([bytes(hdr) + bytes(body)])
+
+    def test_oversized_dictionary_rejected(self):
+        f, _ = self.frame()
+        hdr, body = f[: _HEADER.size], bytearray(f[_HEADER.size :])
+        struct.pack_into("<I", body, 8, 1000)  # n_dict field
+        with pytest.raises(LzhuffFormatError, match="dictionary"):
+            decompress_batch([bytes(hdr) + bytes(body)])
+
+    def test_oversized_chunk_rejected_on_compress(self):
+        with pytest.raises(LzhuffFormatError, match="frame limit"):
+            compress_batch([b"\x00" * (lzhuff.MAX_CHUNK_BYTES + 1)])
+
+
+class TestBackendDispatch:
+    def test_cpu_and_tpu_backends_round_trip(self):
+        from tieredstorage_tpu.security.aes import AesEncryptionProvider
+        from tieredstorage_tpu.transform.api import (
+            TLZHUFF,
+            DetransformOptions,
+            TransformOptions,
+        )
+        from tieredstorage_tpu.transform.cpu import CpuTransformBackend
+        from tieredstorage_tpu.transform.tpu import TpuTransformBackend
+
+        dk = AesEncryptionProvider.create_data_key_and_aad()
+        chunks = [logs_corpus()[:50_000], b"\x00" * 9_000, b"plain tail"]
+        opts = TransformOptions(
+            compression=True, compression_codec=TLZHUFF, encryption=dk
+        )
+        d_opts = DetransformOptions(
+            compression=True,
+            compression_codec=TLZHUFF,
+            encryption=dk,
+            max_original_chunk_size=64_000,
+        )
+        cpu, tpu = CpuTransformBackend(), TpuTransformBackend()
+        assert tpu.detransform(cpu.transform(chunks, opts), d_opts) == chunks
+        assert cpu.detransform(tpu.transform(chunks, opts), d_opts) == chunks
+
+    def test_config_accepts_the_codec_id(self):
+        from tieredstorage_tpu.config.configdef import ConfigException
+        from tieredstorage_tpu.config.rsm_config import _codec_id
+
+        _codec_id("compression.codec", "tpu-lzhuff-v1")
+        with pytest.raises(ConfigException):
+            _codec_id("compression.codec", "tpu-lzhuff-v2")

@@ -45,16 +45,16 @@ _valid_recording_level.description = "[INFO, DEBUG]"
 
 
 def _codec_id(name: str, value) -> None:
-    from tieredstorage_tpu.transform.api import THUFF, ZSTD
+    from tieredstorage_tpu.transform.api import THUFF, TLZHUFF, ZSTD
 
-    if value not in (ZSTD, THUFF):
+    if value not in (ZSTD, THUFF, TLZHUFF):
         raise ConfigException(
             f"Invalid value {value!r} for configuration {name}: "
-            f"must be one of [{ZSTD!r}, {THUFF!r}]"
+            f"must be one of [{ZSTD!r}, {THUFF!r}, {TLZHUFF!r}]"
         )
 
 
-_codec_id.description = "[zstd, tpu-huff-v1]"
+_codec_id.description = "[zstd, tpu-huff-v1, tpu-lzhuff-v1]"
 
 
 def _base_def() -> ConfigDef:
@@ -96,7 +96,8 @@ def _base_def() -> ConfigDef:
         "compression.codec", "string", default="zstd", importance="medium",
         validator=_codec_id,
         doc="Compression codec id recorded in the manifest: 'zstd' "
-            "(reference-compatible) or 'tpu-huff-v1' (device codec).",
+            "(reference-compatible), 'tpu-huff-v1' (order-0 device codec), "
+            "or 'tpu-lzhuff-v1' (device LZ + Huffman).",
     ))
     d.define(ConfigKey(
         "tracing.enabled", "bool", default=False, importance="low",

@@ -73,6 +73,16 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         _u8p, _u64p, _u64p, ctypes.c_int,
         _u8p, ctypes.c_uint64, _u64p, ctypes.c_int,
     ]
+    # Optional symbol: a prebuilt .so from before the LZ layer keeps working
+    # (lz_expand then returns None and callers take the numpy path).
+    try:
+        lib.ts_lz_expand.restype = ctypes.c_int
+        lib.ts_lz_expand.argtypes = [
+            ctypes.POINTER(ctypes.c_uint16), ctypes.c_int,
+            _u8p, ctypes.c_uint64, _u8p, ctypes.c_uint64,
+        ]
+    except AttributeError:
+        pass
     return lib
 
 
@@ -248,6 +258,41 @@ def aes_gcm_encrypt_batch(
         out[i * stride : i * stride + int(out_sizes[i])].tobytes()
         for i in range(len(chunks))
     ]
+
+
+def lz_expand(orig_len: int, seq_stream: bytes, lit_stream: bytes) -> Optional[bytes]:
+    """Expand a tpu-lzhuff-v1 sequence stream (transform/lzhuff.py format).
+
+    Returns None when the native library (or this symbol, for a prebuilt
+    older .so) is unavailable — callers fall back to the numpy expander.
+    Raises NativeTransformError on a malformed stream."""
+    lib = load()
+    if lib is None or not hasattr(lib, "ts_lz_expand"):
+        return None
+    seqs = np.frombuffer(seq_stream, dtype="<u2")
+    if len(seqs) % 3:
+        raise NativeTransformError("sequence stream not a multiple of 6 bytes")
+    lits = (
+        np.frombuffer(lit_stream, dtype=np.uint8)
+        if lit_stream
+        else np.zeros(0, np.uint8)
+    )
+    out = np.empty(max(orig_len, 1), dtype=np.uint8)
+    rc = lib.ts_lz_expand(
+        np.ascontiguousarray(seqs).ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+        len(seqs) // 3,
+        _as_u8p(lits),
+        len(lits),
+        _as_u8p(out),
+        orig_len,
+    )
+    if rc != 0:
+        reasons = {1: "literal overflow", 2: "match outside decoded prefix",
+                   3: "totals mismatch"}
+        raise NativeTransformError(
+            f"LZ expand failed: {reasons.get(rc, f'code {rc}')}"
+        )
+    return out[:orig_len].tobytes()
 
 
 def aes_gcm_decrypt_batch(

@@ -1,0 +1,229 @@
+"""Batched LZ match-finding on device — the match layer of `tpu-lzhuff-v1`.
+
+The reference's codec is zstd: sequential hash-chain match-finding plus
+entropy coding, on the JVM heap (core/.../transform/
+CompressionChunkEnumeration.java:50-63). A TPU has no sequential match
+finder, so this module re-states LZ77 as three data-parallel passes over a
+whole window of chunks at once:
+
+1. **Candidates** — a rolling 4-byte gram is hashed at every position; a
+   per-row hash table is built block-by-block under `lax.scan` (the only
+   sequential axis, n/SCAN_BLOCK steps): each step gathers the previous
+   blocks' last-position-per-hash as the candidate set for its block, then
+   scatter-**max**es its own positions in (positions grow monotonically, so
+   max == last-wins without ordered-scatter semantics).
+2. **Match lengths** — for each position, the candidate (and a distance-1
+   probe that catches runs, which block-stepping can't see) is extended by
+   comparing 4-byte grams word-at-a-time, MATCH_WORDS words deep; the first
+   differing word's leading equal bytes come from its XOR's high bytes.
+   Everything is gathers + elementwise ops; no scan.
+3. **Parse** — greedy token selection (`next[i] = i + max(len[i], 1)`) is a
+   path through the position graph; the path is materialized in O(log n)
+   rounds of pointer doubling (gather ptr[ptr] + scatter-max of the
+   reachability mask), not an O(n) walk.
+
+Per-position lengths are capped at MAX_MATCH; the host serializer merges
+adjacent same-distance tokens back into arbitrarily long matches, so runs
+cost one sequence, as they do in zstd. Entropy coding of the resulting
+streams is the existing device Huffman stage (ops/huffman.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+HASH_BITS = 16
+TABLE_SIZE = 1 << HASH_BITS
+#: Below this a match loses to the 6-byte sequence record it would emit
+#: (transform/lzhuff.py), even before the Huffman stage shrinks the record.
+MIN_MATCH = 6
+#: Per-position cap; the serializer's same-distance merge rebuilds longer
+#: matches, so this bounds device compare work, not the format.
+MATCH_WORDS = 16
+MAX_MATCH = MATCH_WORDS * 4
+#: Table-update granularity: candidates for a block come from strictly
+#: earlier blocks, so in-block-only repeats shorter than this are invisible
+#: to the hash probe (the distance-1 probe still catches runs).
+SCAN_BLOCK = 512
+#: Match offsets are u16 in the sequence record.
+MAX_DIST = 65535
+#: Per-row dominant distances probed in the second pass (see
+#: lz_analyze_batch); more buys little once the offset alphabet collapses.
+TOP_DISTANCES = 4
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def lz_shape(n: int) -> int:
+    """Static row width for a batch whose longest chunk is n bytes."""
+    return max(SCAN_BLOCK, _ceil_div(n, SCAN_BLOCK) * SCAN_BLOCK)
+
+
+def _grams(data: jnp.ndarray, n: int) -> jnp.ndarray:
+    """uint32[B, n]: big-endian 4-byte gram starting at every position
+    (zero-padded past the row end, so tail grams are well-defined)."""
+    batch = data.shape[0]
+    d = jnp.concatenate(
+        [data, jnp.zeros((batch, 3), jnp.uint8)], axis=1
+    ).astype(jnp.uint32)
+    return (
+        (d[:, :n] << 24) | (d[:, 1 : n + 1] << 16) | (d[:, 2 : n + 2] << 8) | d[:, 3 : n + 3]
+    )
+
+
+def _match_lengths(g: jnp.ndarray, cand: jnp.ndarray, valid: jnp.ndarray, n: int):
+    """Equal-byte run length between each position and its candidate,
+    capped at MAX_MATCH, via word-granular compares (no [n, MAX_MATCH]
+    byte tensor in HBM)."""
+    idx = jnp.arange(n, dtype=jnp.int32)[None, :]
+    lens = jnp.zeros(cand.shape, jnp.int32)
+    alive = valid
+    c = jnp.clip(cand, 0, n - 1)
+    for t in range(MATCH_WORDS):
+        gi = jnp.take_along_axis(g, jnp.minimum(idx + 4 * t, n - 1), axis=1)
+        gc = jnp.take_along_axis(g, jnp.minimum(c + 4 * t, n - 1), axis=1)
+        x = gi ^ gc
+        eq_word = x == 0
+        # Grams are big-endian, so the first differing byte is the highest
+        # non-zero byte of the XOR.
+        b0 = (x >> 24) == 0
+        b1 = b0 & (((x >> 16) & 0xFF) == 0)
+        b2 = b1 & (((x >> 8) & 0xFF) == 0)
+        partial = b0.astype(jnp.int32) + b1.astype(jnp.int32) + b2.astype(jnp.int32)
+        lens = lens + jnp.where(alive, jnp.where(eq_word, 4, partial), 0)
+        alive = alive & eq_word
+    return lens
+
+
+@functools.partial(jax.jit, static_argnames=("n_max",))
+def lz_analyze_batch(data: jnp.ndarray, n_sym: jnp.ndarray, *, n_max: int):
+    """data uint8[B, n_max] (n_max % SCAN_BLOCK == 0, zero-padded past each
+    row's n_sym) -> (lens int32[B, n_max], dists int32[B, n_max],
+    sel bool[B, n_max]).
+
+    lens[i] > 0 marks a usable match of that many bytes at distance
+    dists[i] (always in [1, MAX_DIST], source strictly earlier in the same
+    chunk); sel marks the greedy parse's token starts. Padding rows/tails
+    carry garbage — the serializer slices to n_sym."""
+    if n_max % SCAN_BLOCK:
+        raise ValueError(f"n_max={n_max} not a multiple of {SCAN_BLOCK}")
+    batch = data.shape[0]
+    n = n_max
+    rows = jnp.arange(batch, dtype=jnp.int32)[:, None]
+    idx = jnp.arange(n, dtype=jnp.int32)[None, :]
+
+    g = _grams(data, n)
+    # Two candidate tables, zstd-double-fast style: the 4-byte gram finds
+    # short/nearby repeats but its most-recent hit is often an unrelated
+    # common gram (`":"…`), truncating the match; the 8-byte gram is
+    # selective enough that its hit is usually the true long repeat
+    # (the previous record in log-structured data).
+    h4 = ((g * jnp.uint32(2654435761)) >> jnp.uint32(32 - HASH_BITS)).astype(jnp.int32)
+    g_next = jnp.concatenate([g[:, 4:], jnp.zeros((batch, 4), jnp.uint32)], axis=1)
+    h8 = (
+        ((g * jnp.uint32(2654435761)) ^ (g_next * jnp.uint32(2246822519)))
+        >> jnp.uint32(32 - HASH_BITS)
+    ).astype(jnp.int32)
+
+    n_blocks = n // SCAN_BLOCK
+    h4s = h4.reshape(batch, n_blocks, SCAN_BLOCK).transpose(1, 0, 2)  # [nb, B, S]
+    h8s = h8.reshape(batch, n_blocks, SCAN_BLOCK).transpose(1, 0, 2)
+    pos = jnp.arange(n, dtype=jnp.int32).reshape(n_blocks, 1, SCAN_BLOCK)
+
+    def step(tables, xs):
+        t4, t8 = tables
+        hk4, hk8, pk = xs  # [B, S] hashes, [1, S] positions
+        p = jnp.broadcast_to(pk, hk4.shape)
+        c4 = jnp.take_along_axis(t4, hk4, axis=1)
+        c8 = jnp.take_along_axis(t8, hk8, axis=1)
+        t4 = t4.at[rows, hk4].max(p)
+        t8 = t8.at[rows, hk8].max(p)
+        return (t4, t8), (c4, c8)
+
+    table0 = jnp.full((batch, TABLE_SIZE), -1, jnp.int32)
+    _, (c4s, c8s) = jax.lax.scan(step, (table0, table0), (h4s, h8s, pos))
+    cand4 = c4s.transpose(1, 0, 2).reshape(batch, n)
+    cand8 = c8s.transpose(1, 0, 2).reshape(batch, n)
+
+    len4 = _match_lengths(g, cand4, (cand4 >= 0) & (idx - cand4 <= MAX_DIST), n)
+    len8 = _match_lengths(g, cand8, (cand8 >= 0) & (idx - cand8 <= MAX_DIST), n)
+    len_run = _match_lengths(g, idx - 1, idx >= 1, n)
+
+    # Longest wins; ties prefer the shorter distance (run, then 4-gram —
+    # its most-recent hit is at most as far as the 8-gram table's).
+    lens = len_run
+    dists = jnp.ones_like(lens)
+    use4 = len4 > lens
+    lens = jnp.where(use4, len4, lens)
+    dists = jnp.where(use4, idx - cand4, dists)
+    use8 = len8 > lens
+    lens = jnp.where(use8, len8, lens)
+    dists = jnp.where(use8, idx - cand8, dists)
+    tail = n_sym[:, None] - idx
+
+    def clamp(lens):
+        lens = jnp.minimum(lens, jnp.maximum(tail, 0))
+        return jnp.where(lens >= MIN_MATCH, lens, 0)
+
+    def parse(lens):
+        # Greedy parse via pointer doubling: ptr[i] = next token start
+        # after i; the parse is the set of positions reachable from 0.
+        nxt = jnp.minimum(idx + jnp.where(lens > 0, lens, 1), n)
+        ptr = jnp.concatenate([nxt, jnp.full((batch, 1), n, jnp.int32)], axis=1)
+        reach = jnp.zeros((batch, n + 1), jnp.bool_).at[:, 0].set(True)
+
+        def double(carry, _):
+            reach, ptr = carry
+            reach = reach.at[rows, ptr].max(reach)
+            ptr = jnp.take_along_axis(ptr, ptr, axis=1)
+            return (reach, ptr), None
+
+        rounds = max(1, n.bit_length())
+        (reach, _), _ = jax.lax.scan(double, (reach, ptr), None, length=rounds)
+        return reach[:, :n]
+
+    lens = clamp(lens)
+    sel = parse(lens)
+
+    # Dominant-distance pass — zstd's rep-offset insight restated for a
+    # parallel matcher. Sequential rep codes (repeat the PREVIOUS match's
+    # offset) assume consecutive matches share a distance; in
+    # multi-field structured data they instead cycle through several
+    # periodicities, so the parallel equivalent is GLOBAL: histogram the
+    # parse-1 match distances per row, take the top-K, probe those
+    # distances at every position, and prefer them on near-ties (up to 1
+    # byte shorter still wins — collapsing the offset alphabet to a few
+    # values is worth more than the lost byte). The serializer's
+    # same-offset sentinel plus the per-field Huffman then make the
+    # dominant offsets nearly free. Re-parse with the adjusted matches.
+    sel_match = sel & (lens > 0)
+    hist = jnp.zeros((batch, MAX_DIST + 1), jnp.int32).at[
+        rows, jnp.where(sel_match, dists, 0)
+    ].add(jnp.where(sel_match, 1, 0))
+    hist = hist.at[:, 0].set(0)
+    # Pick the best of the top-K by STRICT length first (so a rarer later
+    # distance can't steal near-ties from a more dominant earlier one and
+    # chain length degradation), then apply the 1-byte near-tie preference
+    # once, against the pass-1 candidate.
+    top_len = jnp.zeros_like(lens)
+    top_dist = jnp.zeros_like(dists)
+    for _ in range(TOP_DISTANCES):
+        top = jnp.argmax(hist, axis=1).astype(jnp.int32)  # [B]
+        hist = hist.at[rows[:, 0], top].set(0)
+        pk = top[:, None]
+        len_k = clamp(
+            _match_lengths(g, idx - pk, (pk >= 1) & (idx - pk >= 0), n)
+        )
+        better = len_k > top_len
+        top_len = jnp.where(better, len_k, top_len)
+        top_dist = jnp.where(better, pk, top_dist)
+    use_top = (top_len > 0) & (top_len + 1 >= lens)
+    lens = jnp.where(use_top, top_len, lens)
+    dists = jnp.where(use_top, top_dist, dists)
+    sel = parse(lens)
+    return lens, dists, sel

@@ -19,10 +19,14 @@ from tieredstorage_tpu.utils.tracing import NOOP_TRACER
 #: Compression codec ids recordable in the manifest. "zstd" is the
 #: reference-compatible default (zstd frame with content size, one frame per
 #: chunk — CompressionChunkEnumeration.java:50-63). "tpu-huff-v1" is the
-#: device codec: chunk-batched canonical Huffman encoded/decoded on the TPU
-#: (transform/thuff.py), recorded in the manifest's compressionCodec field.
+#: order-0 device codec: chunk-batched canonical Huffman encoded/decoded on
+#: the TPU (transform/thuff.py). "tpu-lzhuff-v1" layers device LZ
+#: match-finding under the same Huffman stage (ops/lz.py +
+#: transform/lzhuff.py) — the device codec to use on repetitive segment
+#: data. All are recorded in the manifest's compressionCodec field.
 ZSTD = "zstd"
 THUFF = "tpu-huff-v1"
+TLZHUFF = "tpu-lzhuff-v1"
 
 
 class AuthenticationError(ValueError):

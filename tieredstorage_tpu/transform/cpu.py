@@ -18,6 +18,7 @@ from cryptography.exceptions import InvalidTag
 from tieredstorage_tpu.security.aes import AesEncryptionProvider
 from tieredstorage_tpu.transform.api import (
     THUFF,
+    TLZHUFF,
     ZSTD,
     AuthenticationError,
     DetransformOptions,
@@ -31,15 +32,19 @@ class CpuTransformBackend(TransformBackend):
         out = list(chunks)
         if opts.compression:
             if opts.compression_codec == THUFF:
-                # tpu-huff-v1 segments stay readable/writable on hosts (the
-                # codec is plain jnp; on the CPU backend it runs on XLA-CPU).
+                # Device-codec segments stay readable/writable on hosts (the
+                # codecs are plain jnp; on the CPU backend they run on XLA-CPU).
                 from tieredstorage_tpu.transform import thuff
 
                 out = thuff.compress_batch(out)
+            elif opts.compression_codec == TLZHUFF:
+                from tieredstorage_tpu.transform import lzhuff
+
+                out = lzhuff.compress_batch(out)
             elif opts.compression_codec != ZSTD:
                 raise ValueError(
-                    f"CPU backend supports only {ZSTD!r}/{THUFF!r} codecs, "
-                    f"got {opts.compression_codec!r}"
+                    f"CPU backend supports only {ZSTD!r}/{THUFF!r}/{TLZHUFF!r} "
+                    f"codecs, got {opts.compression_codec!r}"
                 )
             else:
                 # A compressor per chunk size keeps the pledged-src-size
@@ -81,10 +86,14 @@ class CpuTransformBackend(TransformBackend):
                 from tieredstorage_tpu.transform import thuff
 
                 out = thuff.decompress_batch(out, opts.max_original_chunk_size)
+            elif opts.compression_codec == TLZHUFF:
+                from tieredstorage_tpu.transform import lzhuff
+
+                out = lzhuff.decompress_batch(out, opts.max_original_chunk_size)
             elif opts.compression_codec != ZSTD:
                 raise ValueError(
-                    f"CPU backend supports only {ZSTD!r}/{THUFF!r} codecs, "
-                    f"got {opts.compression_codec!r}"
+                    f"CPU backend supports only {ZSTD!r}/{THUFF!r}/{TLZHUFF!r} "
+                    f"codecs, got {opts.compression_codec!r}"
                 )
             else:
                 from tieredstorage_tpu.native import checked_frame_content_sizes

@@ -35,6 +35,7 @@ from tieredstorage_tpu.parallel.mesh import data_mesh, pad_batch, shard_rows
 from tieredstorage_tpu.security.aes import IV_SIZE, TAG_SIZE
 from tieredstorage_tpu.transform.api import (
     THUFF,
+    TLZHUFF,
     ZSTD,
     AuthenticationError,
     DetransformOptions,
@@ -153,6 +154,10 @@ class TpuTransformBackend(TransformBackend):
             from tieredstorage_tpu.transform import thuff
 
             return thuff.compress_batch(chunks)
+        if opts.compression_codec == TLZHUFF:
+            from tieredstorage_tpu.transform import lzhuff
+
+            return lzhuff.compress_batch(chunks)
         if opts.compression_codec != ZSTD:
             raise ValueError(f"Codec {opts.compression_codec!r} not implemented")
         level = opts.compression_level
@@ -250,6 +255,10 @@ class TpuTransformBackend(TransformBackend):
                 from tieredstorage_tpu.transform import thuff
 
                 return thuff.decompress_batch(out, opts.max_original_chunk_size)
+            if opts.compression_codec == TLZHUFF:
+                from tieredstorage_tpu.transform import lzhuff
+
+                return lzhuff.decompress_batch(out, opts.max_original_chunk_size)
             if opts.compression_codec != ZSTD:
                 raise ValueError(f"Codec {opts.compression_codec!r} not implemented")
             if self._use_native():
