@@ -1,0 +1,20 @@
+// Compile-time stub; see compile-stubs/README.md.
+package org.apache.kafka.common;
+
+public class TopicIdPartition {
+    private final Uuid topicId;
+    private final TopicPartition topicPartition;
+
+    public TopicIdPartition(final Uuid topicId, final TopicPartition topicPartition) {
+        this.topicId = topicId;
+        this.topicPartition = topicPartition;
+    }
+
+    public Uuid topicId() {
+        return topicId;
+    }
+
+    public TopicPartition topicPartition() {
+        return topicPartition;
+    }
+}

@@ -1,0 +1,20 @@
+// Compile-time stub; see compile-stubs/README.md.
+package org.apache.kafka.common;
+
+public class TopicPartition {
+    private final String topic;
+    private final int partition;
+
+    public TopicPartition(final String topic, final int partition) {
+        this.topic = topic;
+        this.partition = partition;
+    }
+
+    public String topic() {
+        return topic;
+    }
+
+    public int partition() {
+        return partition;
+    }
+}

@@ -1,0 +1,20 @@
+// Compile-time stub; see compile-stubs/README.md.
+package org.apache.kafka.common;
+
+public class Uuid {
+    private final long msb;
+    private final long lsb;
+
+    public Uuid(final long mostSigBits, final long leastSigBits) {
+        this.msb = mostSigBits;
+        this.lsb = leastSigBits;
+    }
+
+    public long getMostSignificantBits() {
+        return msb;
+    }
+
+    public long getLeastSignificantBits() {
+        return lsb;
+    }
+}

@@ -1,0 +1,53 @@
+// Compile-time stub; see compile-stubs/README.md.
+package org.apache.kafka.server.log.remote.storage;
+
+import java.nio.ByteBuffer;
+import java.nio.file.Path;
+import java.util.Optional;
+
+public class LogSegmentData {
+    private final Path logSegment;
+    private final Path offsetIndex;
+    private final Path timeIndex;
+    private final Optional<Path> transactionIndex;
+    private final Path producerSnapshotIndex;
+    private final ByteBuffer leaderEpochIndex;
+
+    public LogSegmentData(final Path logSegment,
+                          final Path offsetIndex,
+                          final Path timeIndex,
+                          final Optional<Path> transactionIndex,
+                          final Path producerSnapshotIndex,
+                          final ByteBuffer leaderEpochIndex) {
+        this.logSegment = logSegment;
+        this.offsetIndex = offsetIndex;
+        this.timeIndex = timeIndex;
+        this.transactionIndex = transactionIndex;
+        this.producerSnapshotIndex = producerSnapshotIndex;
+        this.leaderEpochIndex = leaderEpochIndex;
+    }
+
+    public Path logSegment() {
+        return logSegment;
+    }
+
+    public Path offsetIndex() {
+        return offsetIndex;
+    }
+
+    public Path timeIndex() {
+        return timeIndex;
+    }
+
+    public Optional<Path> transactionIndex() {
+        return transactionIndex;
+    }
+
+    public Path producerSnapshotIndex() {
+        return producerSnapshotIndex;
+    }
+
+    public ByteBuffer leaderEpochIndex() {
+        return leaderEpochIndex;
+    }
+}

@@ -1,0 +1,23 @@
+// Compile-time stub; see compile-stubs/README.md.
+package org.apache.kafka.server.log.remote.storage;
+
+import org.apache.kafka.common.TopicIdPartition;
+import org.apache.kafka.common.Uuid;
+
+public class RemoteLogSegmentId {
+    private final TopicIdPartition topicIdPartition;
+    private final Uuid id;
+
+    public RemoteLogSegmentId(final TopicIdPartition topicIdPartition, final Uuid id) {
+        this.topicIdPartition = topicIdPartition;
+        this.id = id;
+    }
+
+    public TopicIdPartition topicIdPartition() {
+        return topicIdPartition;
+    }
+
+    public Uuid id() {
+        return id;
+    }
+}

@@ -1,0 +1,86 @@
+// Compile-time stub; see compile-stubs/README.md.
+package org.apache.kafka.server.log.remote.storage;
+
+import java.util.Map;
+import java.util.Optional;
+
+public class RemoteLogSegmentMetadata {
+
+    public static class CustomMetadata {
+        private final byte[] value;
+
+        public CustomMetadata(final byte[] value) {
+            this.value = value;
+        }
+
+        public byte[] value() {
+            return value;
+        }
+    }
+
+    private final RemoteLogSegmentId remoteLogSegmentId;
+    private final long startOffset;
+    private final long endOffset;
+    private final long maxTimestampMs;
+    private final int brokerId;
+    private final long eventTimestampMs;
+    private final Map<Integer, Long> segmentLeaderEpochs;
+    private final int segmentSizeInBytes;
+    private final Optional<CustomMetadata> customMetadata;
+
+    public RemoteLogSegmentMetadata(final RemoteLogSegmentId remoteLogSegmentId,
+                                    final long startOffset,
+                                    final long endOffset,
+                                    final long maxTimestampMs,
+                                    final int brokerId,
+                                    final long eventTimestampMs,
+                                    final int segmentSizeInBytes,
+                                    final Optional<CustomMetadata> customMetadata,
+                                    final Map<Integer, Long> segmentLeaderEpochs) {
+        this.remoteLogSegmentId = remoteLogSegmentId;
+        this.startOffset = startOffset;
+        this.endOffset = endOffset;
+        this.maxTimestampMs = maxTimestampMs;
+        this.brokerId = brokerId;
+        this.eventTimestampMs = eventTimestampMs;
+        this.segmentSizeInBytes = segmentSizeInBytes;
+        this.customMetadata = customMetadata;
+        this.segmentLeaderEpochs = segmentLeaderEpochs;
+    }
+
+    public RemoteLogSegmentId remoteLogSegmentId() {
+        return remoteLogSegmentId;
+    }
+
+    public long startOffset() {
+        return startOffset;
+    }
+
+    public long endOffset() {
+        return endOffset;
+    }
+
+    public long maxTimestampMs() {
+        return maxTimestampMs;
+    }
+
+    public int brokerId() {
+        return brokerId;
+    }
+
+    public long eventTimestampMs() {
+        return eventTimestampMs;
+    }
+
+    public Map<Integer, Long> segmentLeaderEpochs() {
+        return segmentLeaderEpochs;
+    }
+
+    public int segmentSizeInBytes() {
+        return segmentSizeInBytes;
+    }
+
+    public Optional<CustomMetadata> customMetadata() {
+        return customMetadata;
+    }
+}

@@ -1,0 +1,8 @@
+// Compile-time stub; see compile-stubs/README.md.
+package org.apache.kafka.server.log.remote.storage;
+
+public class RemoteResourceNotFoundException extends RemoteStorageException {
+    public RemoteResourceNotFoundException(final String message) {
+        super(message);
+    }
+}

@@ -1,0 +1,12 @@
+// Compile-time stub; see compile-stubs/README.md.
+package org.apache.kafka.server.log.remote.storage;
+
+public class RemoteStorageException extends Exception {
+    public RemoteStorageException(final String message) {
+        super(message);
+    }
+
+    public RemoteStorageException(final String message, final Throwable cause) {
+        super(message, cause);
+    }
+}

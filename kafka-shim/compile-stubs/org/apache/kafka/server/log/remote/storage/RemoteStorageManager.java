@@ -1,0 +1,45 @@
+// Compile-time stub; see compile-stubs/README.md. Mirrors the KIP-405 SPI
+// (the interface the reference implements at
+// core/.../RemoteStorageManager.java:106).
+package org.apache.kafka.server.log.remote.storage;
+
+import java.io.Closeable;
+import java.io.InputStream;
+import java.util.Map;
+import java.util.Optional;
+
+public interface RemoteStorageManager extends Closeable {
+
+    enum IndexType {
+        OFFSET,
+        TIMESTAMP,
+        PRODUCER_SNAPSHOT,
+        LEADER_EPOCH,
+        TRANSACTION,
+    }
+
+    void configure(Map<String, ?> configs);
+
+    Optional<RemoteLogSegmentMetadata.CustomMetadata> copyLogSegmentData(
+        RemoteLogSegmentMetadata remoteLogSegmentMetadata,
+        LogSegmentData logSegmentData) throws RemoteStorageException;
+
+    InputStream fetchLogSegment(
+        RemoteLogSegmentMetadata remoteLogSegmentMetadata,
+        int startPosition) throws RemoteStorageException;
+
+    InputStream fetchLogSegment(
+        RemoteLogSegmentMetadata remoteLogSegmentMetadata,
+        int startPosition,
+        int endPosition) throws RemoteStorageException;
+
+    InputStream fetchIndex(
+        RemoteLogSegmentMetadata remoteLogSegmentMetadata,
+        IndexType indexType) throws RemoteStorageException;
+
+    void deleteLogSegmentData(
+        RemoteLogSegmentMetadata remoteLogSegmentMetadata) throws RemoteStorageException;
+
+    @Override
+    void close();
+}

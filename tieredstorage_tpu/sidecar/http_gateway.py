@@ -1,0 +1,241 @@
+"""HTTP/1.1 gateway for the broker-side JVM shim (shim wire format v1).
+
+Serves the same five operations as the gRPC service (sidecar/server.py)
+against the same RemoteStorageManager, over the dependency-free framing in
+sidecar/shimwire.py, so the Java shim (`kafka-shim/`) needs nothing but the
+JDK. Runs inside the sidecar process; `python -m tieredstorage_tpu.sidecar
+--http-port N` starts it next to the gRPC listener.
+
+Error mapping (the shim translates back to KIP-405 exception types):
+404 RemoteResourceNotFoundException, 400 invalid argument, 500 the rest.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import pathlib
+import tempfile
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from tieredstorage_tpu.errors import RemoteResourceNotFoundException
+from tieredstorage_tpu.manifest.segment_indexes import IndexType
+from tieredstorage_tpu.metadata import LogSegmentData
+from tieredstorage_tpu.sidecar import shimwire
+
+_STREAM_BLOCK = 1 << 20
+#: Spool request bodies to disk past this (copy uploads are whole segments).
+_SPOOL_BYTES = 64 << 20
+#: Reject request bodies past this — matches the gRPC boundary's
+#: max-message ceiling so a runaway client can't OOM the sidecar.
+MAX_BODY_BYTES = 2 << 30
+
+
+class _BodyTooLarge(Exception):
+    pass
+
+
+class _StreamAborted(Exception):
+    """A fetch stream failed after the 200 was committed: the chunked
+    framing is unrecoverable, so the connection is aborted instead of a
+    second response being written into the body."""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    rsm = None  # set per-server subclass
+
+    def log_message(self, fmt, *args):  # quiet; the RSM has its own tracing
+        pass
+
+    # ------------------------------------------------------------- plumbing
+    def _body(self):
+        """Request body as a seekable file, disk-spooled past _SPOOL_BYTES
+        and capped at MAX_BODY_BYTES (a copy request holds a whole segment,
+        which must not be required to fit in sidecar RAM)."""
+        out = tempfile.SpooledTemporaryFile(max_size=_SPOOL_BYTES)
+        total = 0
+
+        def take(n: int) -> None:
+            nonlocal total
+            remaining = n
+            while remaining:
+                block = self.rfile.read(min(remaining, _STREAM_BLOCK))
+                if not block:
+                    raise shimwire.ShimWireError("request body truncated")
+                total += len(block)
+                if total > MAX_BODY_BYTES:
+                    raise _BodyTooLarge()
+                out.write(block)
+                remaining -= len(block)
+
+        if self.headers.get("Transfer-Encoding", "").lower() == "chunked":
+            # java.net.http streams unknown-length bodies (the shim's copy
+            # path wraps file streams) as chunked; BaseHTTPRequestHandler
+            # doesn't decode it, so do it here.
+            while True:
+                size_line = self.rfile.readline(64).strip()
+                try:
+                    size = int(size_line.split(b";")[0], 16)
+                except ValueError:
+                    raise shimwire.ShimWireError(
+                        f"bad chunk size line {size_line!r}"
+                    ) from None
+                if size == 0:
+                    # Consume the trailer section up to the final CRLF.
+                    while self.rfile.readline(1024).strip():
+                        pass
+                    break
+                take(size)
+                self.rfile.read(2)  # chunk-terminating CRLF
+        else:
+            length = int(self.headers.get("Content-Length", "0"))
+            if length > MAX_BODY_BYTES:
+                raise _BodyTooLarge()
+            take(length)
+        out.seek(0)
+        return out
+
+    def _reply(self, status: int, body: bytes = b"") -> None:
+        self.send_response(status)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
+
+    def _reply_stream(self, stream) -> None:
+        """200 + chunked transfer of a file-like's contents.
+
+        The RSM's fetch streams are lazy: the manifest fetch (and its 404)
+        happens on the first read. Pull that block BEFORE committing the
+        status line so not-found maps to a clean 404 instead of a
+        truncated 200. A failure later mid-stream can only abort the
+        connection (the shim surfaces that as a transport error, the same
+        way a gRPC mid-stream abort lands)."""
+        with contextlib.closing(stream):
+            first = stream.read(_STREAM_BLOCK)
+            self.send_response(200)
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            try:
+                block = first
+                while block:
+                    self.wfile.write(b"%x\r\n" % len(block) + block + b"\r\n")
+                    block = stream.read(_STREAM_BLOCK)
+                self.wfile.write(b"0\r\n\r\n")
+            except Exception as exc:
+                raise _StreamAborted() from exc
+
+    def _fail(self, exc: Exception) -> None:
+        if isinstance(exc, RemoteResourceNotFoundException):
+            status = 404
+        elif isinstance(exc, (ValueError, KeyError)):
+            status = 400
+        else:
+            status = 500
+        self._reply(status, f"{type(exc).__name__}: {exc}".encode("utf-8"))
+
+    # ------------------------------------------------------------- handlers
+    def do_GET(self) -> None:
+        if self.path == "/v1/health":
+            self._reply(200)
+        else:
+            self._reply(404, b"no such endpoint")
+
+    def do_POST(self) -> None:
+        routes = {
+            "/v1/copy": self._copy,
+            "/v1/fetch": self._fetch,
+            "/v1/fetch-index": self._fetch_index,
+            "/v1/delete": self._delete,
+        }
+        handler = routes.get(self.path)
+        if handler is None:
+            self._reply(404, b"no such endpoint")
+            return
+        try:
+            with contextlib.closing(self._body()) as body:
+                handler(body)
+        except _StreamAborted:
+            # Response already committed; the only safe move is dropping
+            # the connection so the client sees a truncated stream (the
+            # shim maps that to RemoteStorageException).
+            self.close_connection = True
+        except _BodyTooLarge:
+            self._reply(413, b"request body exceeds MAX_BODY_BYTES")
+            self.close_connection = True  # unread body left on the socket
+        except Exception as exc:  # noqa: BLE001 — boundary translation
+            self._fail(exc)
+
+    def _copy(self, body) -> None:
+        md = shimwire.decode_metadata(body)
+        with tempfile.TemporaryDirectory(prefix="sidecar-http-copy-") as tmp:
+            # Sections stream straight to files — a multi-GiB segment never
+            # has to fit in sidecar RAM on top of the spooled request body.
+            paths = shimwire.decode_sections_to_dir(body, tmp)
+            for required in ("log_segment", "offset_index", "time_index",
+                             "leader_epoch_index"):
+                if paths[required] is None:
+                    raise shimwire.ShimWireError(
+                        f"missing required section {required}"
+                    )
+            if paths["producer_snapshot"] is None:
+                # KIP-405 requires the snapshot; tolerate shims for older
+                # brokers by materializing an empty one, like the reference
+                # e2e fixtures do.
+                p = pathlib.Path(tmp) / "producer_snapshot"
+                p.write_bytes(b"")
+                paths["producer_snapshot"] = p
+            data = LogSegmentData(
+                log_segment=paths["log_segment"],
+                offset_index=paths["offset_index"],
+                time_index=paths["time_index"],
+                producer_snapshot_index=paths["producer_snapshot"],
+                transaction_index=paths["transaction_index"],
+                leader_epoch_index=paths["leader_epoch_index"].read_bytes(),
+            )
+            custom = self.rsm.copy_log_segment_data(md, data)
+        if custom:
+            self._reply(200, bytes(custom))
+        else:
+            self._reply(204)
+
+    def _fetch(self, body) -> None:
+        md = shimwire.decode_metadata(body)
+        start, end = shimwire.decode_fetch_tail(body)
+        self._reply_stream(self.rsm.fetch_log_segment(md, start, end))
+
+    def _fetch_index(self, body) -> None:
+        md = shimwire.decode_metadata(body)
+        name = shimwire.decode_index_type(body)
+        try:
+            index_type = IndexType[name]
+        except KeyError:
+            raise shimwire.ShimWireError(f"unknown index type {name!r}") from None
+        self._reply_stream(self.rsm.fetch_index(md, index_type))
+
+    def _delete(self, body) -> None:
+        self.rsm.delete_log_segment_data(shimwire.decode_metadata(body))
+        self._reply(204)
+
+
+class SidecarHttpGateway:
+    def __init__(self, rsm, *, port: int = 0, host: str = "127.0.0.1"):
+        handler = type("BoundHandler", (_Handler,), {"rsm": rsm})
+        self._server = ThreadingHTTPServer((host, port), handler)
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "SidecarHttpGateway":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="sidecar-http", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -177,6 +177,12 @@ def main(argv: Optional[list[str]] = None) -> None:
              "(the compose demo stack's scrape target).",
     )
     parser.add_argument(
+        "--http-port", type=int, default=None,
+        help="Also serve the shim-wire HTTP gateway (the boundary the "
+             "dependency-free JVM broker shim in kafka-shim/ speaks) on "
+             "this port; 0 picks a free port.",
+    )
+    parser.add_argument(
         "--virtual-cpu-devices", type=int, default=None, metavar="N",
         help="Pin JAX to the host platform with N virtual CPU devices before "
              "serving (host-only deployments / environments where the "
@@ -202,10 +208,16 @@ def main(argv: Optional[list[str]] = None) -> None:
         exporter = PrometheusExporter(
             [rsm.metrics.registry], port=args.metrics_port, host=args.host
         ).start()
+    gateway = None
+    if args.http_port is not None:
+        from tieredstorage_tpu.sidecar.http_gateway import SidecarHttpGateway
+
+        gateway = SidecarHttpGateway(rsm, port=args.http_port, host=args.host).start()
     server = SidecarServer(rsm, port=args.port, host=args.host).start()
     print(
         f"SIDECAR_READY port={server.port}"
-        + (f" metrics_port={exporter.port}" if exporter else ""),
+        + (f" metrics_port={exporter.port}" if exporter else "")
+        + (f" http_port={gateway.port}" if gateway else ""),
         flush=True,
     )
 
@@ -215,5 +227,7 @@ def main(argv: Optional[list[str]] = None) -> None:
     stop.wait()
     if exporter is not None:
         exporter.stop()
+    if gateway is not None:
+        gateway.stop()
     server.stop()
     sys.exit(0)
