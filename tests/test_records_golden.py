@@ -1,0 +1,92 @@
+"""Golden-byte differential test for the e2e v2 record-batch codec
+(VERDICT r3 item 8: the broker sim's foundation must not be self-certified).
+
+The fixtures below were derived INDEPENDENTLY of tests/e2e/records.py, by a
+separate spec-level construction (manual zigzag varlongs, manual header
+packing, the bitwise CRC32C) of Kafka's magic=2 on-disk record-batch format:
+baseOffset(8) batchLength(4) partitionLeaderEpoch(4) magic(1) crc32c(4,
+over attributes..end) attributes(2) lastOffsetDelta(4) baseTimestamp(8)
+maxTimestamp(8) producerId(8) producerEpoch(2) baseSequence(4)
+recordCount(4), then length-prefixed records of
+attributes(1) timestampDelta(varlong) offsetDelta(varlong)
+key(varlong len + bytes, -1 = null) value(varlong len + bytes)
+headerCount(uvarint). The bytes are frozen here as hex literals; the codec
+must reproduce them exactly and read them back exactly."""
+
+from __future__ import annotations
+
+import struct
+
+from tests.e2e.records import Record, decode_batches, encode_batch
+from tieredstorage_tpu.ops.crc32c import crc32c_reference
+
+#: base_offset=100, records (ts=1000, key=b"k1", value=b"value-1") and
+#: (ts=1003, key=None, value=b"v2").
+GOLDEN_TWO_RECORDS = bytes.fromhex(
+    "00000000000000640000004a0000000002eb4b11cf0000000000010000000000"
+    "0003e800000000000003ebffffffffffffffffffffffffffff000000021e0000"
+    "00046b310e76616c75652d3100100006020104763200"
+)
+
+#: base_offset=102, one record with a >32-bit timestamp, a UTF-8 key and a
+#: binary value (ts=5_000_000_000, key="key-é", value=b"\x00\x01\x02payload").
+GOLDEN_ONE_RECORD = bytes.fromhex(
+    "0000000000000066000000480000000002fb54de4a000000000000000000012a"
+    "05f200000000012a05f200ffffffffffffffffffffffffffff000000012c0000"
+    "000c6b65792dc3a9140001027061796c6f616400"
+)
+
+
+class TestEncodeMatchesGolden:
+    def test_two_record_batch_byte_identical(self):
+        got = encode_batch(
+            100, [(1000, b"k1", b"value-1"), (1003, None, b"v2")]
+        )
+        assert got == GOLDEN_TWO_RECORDS
+
+    def test_one_record_batch_byte_identical(self):
+        got = encode_batch(
+            102, [(5_000_000_000, "key-é".encode(), b"\x00\x01\x02payload")]
+        )
+        assert got == GOLDEN_ONE_RECORD
+
+
+class TestDecodeGolden:
+    def test_decodes_both_batches_from_a_segment(self):
+        records = decode_batches(GOLDEN_TWO_RECORDS + GOLDEN_ONE_RECORD)
+        assert records == [
+            Record(offset=100, timestamp=1000, key=b"k1", value=b"value-1"),
+            Record(offset=101, timestamp=1003, key=None, value=b"v2"),
+            Record(
+                offset=102,
+                timestamp=5_000_000_000,
+                key="key-é".encode(),
+                value=b"\x00\x01\x02payload",
+            ),
+        ]
+
+    def test_trailing_partial_batch_ignored(self):
+        # A ranged fetch can cut mid-batch; decode must stop cleanly.
+        records = decode_batches(GOLDEN_TWO_RECORDS + GOLDEN_ONE_RECORD[:30])
+        assert len(records) == 2
+
+
+class TestCrcFieldIsRealCrc32c:
+    """Kafka's batch CRC is CRC32C over attributes..end — pin the field in
+    the golden bytes against the independent bitwise implementation, so a
+    regression to zlib.crc32 (what the sim used before round 4) fails."""
+
+    def test_golden_crc_fields(self):
+        for blob in (GOLDEN_TWO_RECORDS, GOLDEN_ONE_RECORD):
+            (crc,) = struct.unpack_from(">I", blob, 17)
+            assert crc == crc32c_reference(blob[21:])
+
+    def test_freshly_encoded_crc(self):
+        blob = encode_batch(7, [(1, b"a", b"b"), (2, b"c", b"d")])
+        (crc,) = struct.unpack_from(">I", blob, 17)
+        assert crc == crc32c_reference(blob[21:])
+
+    def test_batch_length_field_covers_epoch_to_end(self):
+        for blob in (GOLDEN_TWO_RECORDS, GOLDEN_ONE_RECORD):
+            base_offset, batch_length = struct.unpack_from(">qi", blob, 0)
+            assert 12 + batch_length == len(blob)

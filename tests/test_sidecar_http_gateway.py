@@ -310,6 +310,34 @@ class TestWireSymmetry:
         assert md.segment_size_in_bytes == 999
         assert md.custom_metadata == b"cm"
 
+    def test_python_side_encoders_match_java_mirror(self):
+        """Every shimwire encoder must emit the same bytes as the Java
+        mirror, so the Python-side client surface can't drift from the wire
+        the gateway actually decodes."""
+        from tieredstorage_tpu.sidecar import shimwire
+
+        assert shimwire.encode_fetch_tail(5, 99) == JavaShimEncoder.fetch_tail(5, 99)
+        assert shimwire.encode_fetch_tail(5, None) == JavaShimEncoder.fetch_tail(5)
+        assert shimwire.encode_index_type("OFFSET") == JavaShimEncoder.index_tail(
+            "OFFSET"
+        )
+        sections = {
+            "log_segment": b"LOG",
+            "offset_index": b"OI",
+            "time_index": b"TI",
+            "producer_snapshot": None,
+            "transaction_index": None,
+            "leader_epoch_index": b"LE",
+        }
+        assert shimwire.encode_sections(sections) == (
+            JavaShimEncoder.section(b"LOG")
+            + JavaShimEncoder.section(b"OI")
+            + JavaShimEncoder.section(b"TI")
+            + JavaShimEncoder.section(None)
+            + JavaShimEncoder.section(None)
+            + JavaShimEncoder.section(b"LE")
+        )
+
     def test_python_encoder_byte_identical_to_java_mirror(self):
         from tieredstorage_tpu.sidecar import shimwire
 

@@ -40,6 +40,29 @@ def _crc0(data: bytes) -> int:
     return crc32c_reference(data, init=0, xorout=0)
 
 
+_HOST_TABLE: list | None = None
+
+
+def crc32c_host(data: bytes) -> int:
+    """Table-driven host CRC32C — the fast path for host-side framing (the
+    e2e broker sim's v2 record batches use it; Kafka's batch CRC is CRC32C).
+    The bitwise `crc32c_reference` above stays the independent oracle."""
+    global _HOST_TABLE
+    if _HOST_TABLE is None:
+        table = []
+        for i in range(256):
+            crc = i
+            for _ in range(8):
+                crc = (crc >> 1) ^ (_POLY_REFLECTED if crc & 1 else 0)
+            table.append(crc)
+        _HOST_TABLE = table
+    crc = 0xFFFFFFFF
+    table = _HOST_TABLE
+    for byte in data:
+        crc = table[(crc ^ byte) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
 def _bits32(v: int) -> np.ndarray:
     return np.frombuffer(v.to_bytes(4, "big"), dtype=np.uint8)[:, None] >> np.arange(
         7, -1, -1, dtype=np.uint8

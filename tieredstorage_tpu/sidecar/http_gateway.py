@@ -53,7 +53,13 @@ class _Handler(BaseHTTPRequestHandler):
     def _body(self):
         """Request body as a seekable file, disk-spooled past _SPOOL_BYTES
         and capped at MAX_BODY_BYTES (a copy request holds a whole segment,
-        which must not be required to fit in sidecar RAM)."""
+        which must not be required to fit in sidecar RAM).
+
+        Copy uploads touch disk twice (spooled body, then the decoded
+        section files) — accepted: decoding straight off the socket would
+        tie chunked-transfer framing into the section parser, and a segment
+        copy is a once-per-segment operation whose cost is dominated by the
+        transform, not local disk."""
         out = tempfile.SpooledTemporaryFile(max_size=_SPOOL_BYTES)
         total = 0
 
@@ -75,7 +81,12 @@ class _Handler(BaseHTTPRequestHandler):
             # path wraps file streams) as chunked; BaseHTTPRequestHandler
             # doesn't decode it, so do it here.
             while True:
-                size_line = self.rfile.readline(64).strip()
+                raw_line = self.rfile.readline(1024)
+                if not raw_line.endswith(b"\n"):
+                    # Truncation here would silently shift the remainder of
+                    # the size line into the chunk data.
+                    raise shimwire.ShimWireError("chunk size line too long")
+                size_line = raw_line.strip()
                 try:
                     size = int(size_line.split(b";")[0], 16)
                 except ValueError:

@@ -155,7 +155,9 @@ def decode_metadata(buf: BinaryIO) -> RemoteLogSegmentMetadata:
 
 
 def encode_sections(sections: dict) -> bytes:
-    """COPY_SECTIONS name -> Optional[bytes], in wire order."""
+    """COPY_SECTIONS name -> Optional[bytes], in wire order (the Python-side
+    encoder mirror of the Java shim's copyBody; symmetry-pinned against the
+    independent test encoder in tests/test_sidecar_http_gateway.py)."""
     out = io.BytesIO()
     for name in COPY_SECTIONS:
         blob = sections.get(name)
@@ -165,20 +167,6 @@ def encode_sections(sections: dict) -> bytes:
             out.write(struct.pack(">BQ", 1, len(blob)))
             out.write(blob)
     return out.getvalue()
-
-
-def decode_sections(buf: BinaryIO, *, max_section: int = 2 << 30) -> dict:
-    sections = {}
-    for name in COPY_SECTIONS:
-        (present,) = struct.unpack(">B", _read(buf, 1))
-        if not present:
-            sections[name] = None
-            continue
-        (length,) = struct.unpack(">Q", _read(buf, 8))
-        if length > max_section:
-            raise ShimWireError(f"section {name} of {length} bytes over the cap")
-        sections[name] = _read(buf, length)
-    return sections
 
 
 def decode_sections_to_dir(
