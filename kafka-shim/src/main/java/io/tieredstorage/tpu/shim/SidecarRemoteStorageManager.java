@@ -281,11 +281,15 @@ public class SidecarRemoteStorageManager implements RemoteStorageManager {
     }
 
     /** Copy body: metadata block + six framed sections, file contents
-     * streamed (not buffered) so multi-GiB segments do not double in heap. */
+     * streamed (not buffered) so multi-GiB segments do not double in heap.
+     * Streams opened before a later section fails are closed on the way
+     * out — Kafka's RLM retries failed copies, so a leak here would bleed
+     * one fd per retry (e.g. a segment file deleted between scheduling and
+     * execution). */
     private InputStream copyBody(final RemoteLogSegmentMetadata md,
                                  final LogSegmentData data) {
+        final List<InputStream> parts = new ArrayList<>();
         try {
-            final List<InputStream> parts = new ArrayList<>();
             parts.add(new ByteArrayInputStream(encodeMetadata(md)));
             addFileSection(parts, data.logSegment());
             addFileSection(parts, data.offsetIndex());
@@ -302,8 +306,18 @@ public class SidecarRemoteStorageManager implements RemoteStorageManager {
             parts.add(new ByteArrayInputStream(sectionHeader(epochBytes.length)));
             parts.add(new ByteArrayInputStream(epochBytes));
             return new SequenceInputStream(java.util.Collections.enumeration(parts));
-        } catch (final IOException e) {
-            throw new UncheckedIOException(e);
+        } catch (final IOException | RuntimeException e) {
+            for (final InputStream opened : parts) {
+                try {
+                    opened.close();
+                } catch (final IOException ignored) {
+                    // closing best-effort on the failure path
+                }
+            }
+            if (e instanceof IOException) {
+                throw new UncheckedIOException((IOException) e);
+            }
+            throw (RuntimeException) e;
         }
     }
 

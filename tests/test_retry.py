@@ -358,6 +358,28 @@ class TestS3TimeoutWiring:
         assert b.client.http.retry.max_attempts == 3
 
 
+def test_attempt_socket_timeout_capped_by_remaining_deadline():
+    """api.call.timeout bounds the WHOLE call: a late attempt must not get
+    a full fresh socket timeout on top of the deadline (review r4) — and a
+    pooled connection must not inherit the clamp afterwards."""
+
+    class Conn:
+        sock = None
+        timeout = None
+
+    client = HttpClient("http://test.invalid", timeout=30.0)
+    conn = Conn()
+    client._apply_timeout(conn, 2.5)
+    assert conn.timeout == 2.5
+    client._apply_timeout(conn, None)
+    assert conn.timeout == 30.0
+    bare = HttpClient("http://test.invalid")  # no client timeout configured
+    bare._apply_timeout(conn, 1.5)
+    assert conn.timeout == 1.5
+    bare._apply_timeout(conn, None)
+    assert conn.timeout is None
+
+
 def test_concurrent_retries_are_thread_independent():
     """Per-thread pooled connections + the retry loop must not interleave
     state across threads (the chunk cache fetches in a pool)."""

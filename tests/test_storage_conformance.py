@@ -1,0 +1,312 @@
+"""Protocol conformance fixtures for the GCS and Azure clients
+(VERDICT r3 item 9: validation independent of the in-repo emulators).
+
+SigV4 got published AWS vectors in round 2; Google and Microsoft publish
+protocol *documents* rather than test vectors, so these fixtures pin the
+clients to frozen golden transcripts derived by hand from those documents:
+
+- a scripted recording server (no emulator logic — canned responses only)
+  captures every request the client sends, and the test asserts the
+  sequence byte-for-byte against literals mirroring the documented
+  protocol (resumable-session POST/PUT/308 flow; PutBlock/PutBlockList);
+- the Azure SharedKey Authorization header is pinned to a literal computed
+  by an out-of-band, hand-assembled string-to-sign following Microsoft's
+  documented 2015+ layout (see the derivation note at the fixture).
+
+A drift in request shaping, canonicalization, header spelling, or body
+framing breaks a literal here even if the in-repo emulators drift the same
+way."""
+
+from __future__ import annotations
+
+import base64
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from tieredstorage_tpu.storage.core import BytesRange, ObjectKey
+
+
+class RecordedRequest:
+    def __init__(self, method, target, headers, body):
+        self.method = method
+        self.target = target
+        self.headers = {k.lower(): v for k, v in headers.items()}
+        self.body = body
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"<{self.method} {self.target} {len(self.body)}B>"
+
+
+class ScriptServer:
+    """Serves a fixed script of (status, headers, body) responses in order,
+    recording raw requests. Deliberately *no* protocol logic."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.requests: list[RecordedRequest] = []
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _serve(self):
+                length = int(self.headers.get("Content-Length", "0"))
+                body = self.rfile.read(length) if length else b""
+                outer.requests.append(
+                    RecordedRequest(self.command, self.path, self.headers, body)
+                )
+                if not outer.script:
+                    status, headers, payload = 500, {}, b"script exhausted"
+                else:
+                    status, headers, payload = outer.script.pop(0)
+                self.send_response(status)
+                for k, v in headers.items():
+                    self.send_header(k, v.replace("{port}", str(outer.port)))
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                if payload:
+                    self.wfile.write(payload)
+
+            do_GET = do_PUT = do_POST = do_DELETE = _serve
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+# --------------------------------------------------------------------- GCS
+def _gcs_backend(port, chunk_size=256 * 1024):
+    from tieredstorage_tpu.storage.gcs import GcsStorage
+
+    b = GcsStorage()
+    b.configure(
+        {"gcs.bucket.name": "bkt", "gcs.endpoint.url": f"http://127.0.0.1:{port}"}
+    )
+    b.chunk_size = chunk_size
+    return b
+
+
+SESSION = "/upload/storage/v1/b/bkt/o?uploadType=resumable&upload_id=fixture1"
+
+
+class TestGcsResumableConformance:
+    """The documented resumable flow: initiate POST -> session URI; chunk
+    PUTs with 'Content-Range: bytes S-E/*' -> 308 + 'Range: bytes=0-N';
+    final PUT carries the total; status probe is 'bytes */<total|*>'."""
+
+    def test_two_chunk_upload_transcript(self):
+        data = bytes(range(256)) * 1536  # 384 KiB -> 256 KiB + 128 KiB
+        script = [
+            (200, {"Location": "http://127.0.0.1:{port}" + SESSION}, b"{}"),
+            (308, {"Range": "bytes=0-262143"}, b""),
+            (200, {}, b"{}"),
+        ]
+        with ScriptServer(script) as srv:
+            backend = _gcs_backend(srv.port)
+            import io
+
+            assert backend.upload(io.BytesIO(data), ObjectKey("a/b.log")) == len(data)
+        init, chunk1, final = srv.requests
+        assert init.method == "POST"
+        # Object name rides the query, URL-encoded as one path element.
+        assert init.target == (
+            "/upload/storage/v1/b/bkt/o?uploadType=resumable&name=a%2Fb.log"
+        )
+        assert init.headers["content-type"] == "application/json"
+        assert chunk1.method == "PUT" and chunk1.target == SESSION
+        assert chunk1.headers["content-range"] == "bytes 0-262143/*"
+        assert chunk1.body == data[: 256 * 1024]
+        assert final.headers["content-range"] == "bytes 262144-393215/393216"
+        assert final.body == data[256 * 1024 :]
+
+    def test_recovery_probe_transcript(self):
+        """A 503 on a chunk triggers the documented status probe
+        ('bytes */*') and a resume from the server's committed offset."""
+        data = bytes(range(256)) * 1024  # 256 KiB: one non-final + finalize
+        script = [
+            (200, {"Location": "http://127.0.0.1:{port}" + SESSION}, b"{}"),
+            (503, {}, b"upstream hiccup"),           # chunk PUT fails
+            (308, {"Range": "bytes=0-131071"}, b""),  # probe: half committed
+            (200, {}, b"{}"),                         # resumed final PUT
+        ]
+        with ScriptServer(script) as srv:
+            backend = _gcs_backend(srv.port, chunk_size=256 * 1024)
+            backend.http.retry = _fast_retry()
+            import io
+
+            assert backend.upload(io.BytesIO(data), ObjectKey("r.log")) == len(data)
+        _, failed, probe, resumed = srv.requests
+        assert failed.headers["content-range"] == "bytes 0-262143/262144"
+        assert probe.method == "PUT" and probe.body == b""
+        assert probe.headers["content-range"] == "bytes */262144"
+        assert resumed.headers["content-range"] == "bytes 131072-262143/262144"
+        assert resumed.body == data[131072:]
+
+    def test_media_get_transcript(self):
+        script = [(206, {}, b"abcdefgh")]
+        with ScriptServer(script) as srv:
+            backend = _gcs_backend(srv.port)
+            with backend.fetch(ObjectKey("x/y.log"), BytesRange.of(8, 15)) as s:
+                assert s.read() == b"abcdefgh"
+        (req,) = srv.requests
+        assert req.target == "/storage/v1/b/bkt/o/x%2Fy.log?alt=media"
+        assert req.headers["range"] == "bytes=8-15"
+
+
+# ------------------------------------------------------------------- Azure
+ACCOUNT = "fixtureaccount"
+KEY_B64 = base64.b64encode(b"0123456789abcdef0123456789abcdef").decode()
+#: Frozen out-of-band: HMAC-SHA256 over the hand-assembled 2015+
+#: string-to-sign for [PUT, CL=11, x-ms-date=Tue, 30 Jul 2026 12:00:00 GMT,
+#: x-ms-version=2021-08-06, /fixtureaccount/cont/seg/00001.log,
+#: blockid:Zml4ZWQtMDAwMDAw, comp:block] with the key above — derived in a
+#: separate script following Microsoft's documented canonicalization, not
+#: by calling SharedKeyAuth.
+GOLDEN_SHAREDKEY_SIGNATURE = "UgEGqeMmpd3j7bC0mApwkTK2z84eP4OQh+NiVlQy2VE="
+FIXED_DATE = "Tue, 30 Jul 2026 12:00:00 GMT"
+
+
+def _fast_retry():
+    from tieredstorage_tpu.storage.httpclient import RetryPolicy
+
+    return RetryPolicy(base_delay_s=0.001, max_delay_s=0.002)
+
+
+def _azure_backend(port, *, block_size=100 * 1024, sas=None):
+    from tieredstorage_tpu.storage.azure import AzureBlobStorage
+
+    b = AzureBlobStorage()
+    configs = {
+        "azure.account.name": ACCOUNT,
+        "azure.container.name": "cont",
+        "azure.endpoint.url": f"http://127.0.0.1:{port}",
+        "azure.upload.block.size": block_size,
+    }
+    if sas is None:
+        configs["azure.account.key"] = KEY_B64
+    else:
+        configs["azure.sas.token"] = sas
+    b.configure(configs)
+    return b
+
+
+class TestAzureSharedKeyConformance:
+    def test_authorization_header_matches_frozen_signature(self):
+        from tieredstorage_tpu.storage.azure.auth import SharedKeyAuth
+
+        headers = SharedKeyAuth(ACCOUNT, KEY_B64).sign(
+            "PUT",
+            "/cont/seg/00001.log",
+            {"comp": "block", "blockid": "Zml4ZWQtMDAwMDAw"},
+            {
+                "Host": "ignored:1",
+                "x-ms-date": FIXED_DATE,
+                "x-ms-version": "2021-08-06",
+                "Content-Length": "11",
+            },
+            11,
+        )
+        assert headers["Authorization"] == (
+            f"SharedKey {ACCOUNT}:{GOLDEN_SHAREDKEY_SIGNATURE}"
+        )
+
+
+class TestAzureBlockUploadConformance:
+    def test_block_upload_transcript(self, monkeypatch):
+        """PutBlock x3 + PutBlockList, with deterministic block ids and the
+        committed block-list XML pinned literally (ordering is what the
+        service honors — a reorder would corrupt the blob)."""
+        import io
+        import secrets as secrets_mod
+
+        monkeypatch.setattr(secrets_mod, "token_hex", lambda n=16: "deadbeefcafef00d")
+        data = bytes(range(256)) * 1024  # 256 KiB -> 100+100+56
+        script = [(201, {}, b"")] * 4
+        with ScriptServer(script) as srv:
+            backend = _azure_backend(srv.port)
+            assert backend.upload(io.BytesIO(data), ObjectKey("seg/00001.log")) == len(
+                data
+            )
+        b0, b1, b2, commit = srv.requests
+        ids = [
+            base64.b64encode(f"deadbeefcafef00d-{i:06d}".encode()).decode()
+            for i in range(3)
+        ]
+        for i, req in enumerate((b0, b1, b2)):
+            assert req.method == "PUT"
+            assert req.target == (
+                "/cont/seg/00001.log?comp=block&blockid="
+                + ids[i].replace("=", "%3D")
+            )
+            assert req.headers["x-ms-version"] == "2021-08-06"
+            assert "authorization" in req.headers
+        assert b0.body == data[: 100 * 1024]
+        assert b2.body == data[200 * 1024 :]
+        assert commit.target == "/cont/seg/00001.log?comp=blocklist"
+        assert commit.headers["content-type"] == "application/xml"
+        expected_xml = (
+            "<?xml version='1.0' encoding='utf-8'?>\n<BlockList>"
+            + "".join(f"<Latest>{i}</Latest>" for i in ids)
+            + "</BlockList>"
+        ).encode()
+        assert commit.body == expected_xml
+
+    def test_single_block_uses_put_blob(self):
+        import io
+
+        script = [(201, {}, b"")]
+        with ScriptServer(script) as srv:
+            backend = _azure_backend(srv.port)
+            backend.upload(io.BytesIO(b"small"), ObjectKey("s.log"))
+        (req,) = srv.requests
+        assert req.target == "/cont/s.log"
+        assert req.headers["x-ms-blob-type"] == "BlockBlob"
+        assert req.body == b"small"
+
+    def test_ranged_get_uses_x_ms_range(self):
+        script = [(206, {}, b"0123")]
+        with ScriptServer(script) as srv:
+            backend = _azure_backend(srv.port)
+            with backend.fetch(ObjectKey("s.log"), BytesRange.of(4, 7)) as s:
+                assert s.read() == b"0123"
+        (req,) = srv.requests
+        assert req.headers["x-ms-range"] == "bytes=4-7"
+
+    def test_sas_mode_appends_token_and_skips_authorization(self):
+        import io
+
+        script = [(201, {}, b"")]
+        sas = "sv=2021-08-06&ss=b&sig=FIXEDSIG"
+        with ScriptServer(script) as srv:
+            backend = _azure_backend(srv.port, sas=sas)
+            backend.upload(io.BytesIO(b"x"), ObjectKey("s.log"))
+        (req,) = srv.requests
+        assert "authorization" not in req.headers
+        assert "sig=FIXEDSIG" in req.target and "sv=2021-08-06" in req.target
+
+
+class TestTranscriptIndependence:
+    def test_script_server_has_no_protocol_logic(self):
+        """Guard the fixture methodology: the recording server must stay a
+        dumb scripted responder (no emulator-style state), or the
+        independence from tests/emulators/ is lost."""
+        import inspect
+
+        src = inspect.getsource(ScriptServer)
+        for banned in ("sessions", "blocks[", "state.objects", "parse_qs"):
+            assert banned not in src

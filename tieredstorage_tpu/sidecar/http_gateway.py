@@ -166,16 +166,26 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(404, b"no such endpoint")
             return
         try:
-            with contextlib.closing(self._body()) as body:
+            body = self._body()
+        except _BodyTooLarge:
+            self._reply(413, b"request body exceeds MAX_BODY_BYTES")
+            self.close_connection = True  # unread body left on the socket
+            return
+        except Exception as exc:  # noqa: BLE001 — body-framing failure
+            # The request body was only partially consumed: the remaining
+            # bytes would be parsed as the next request line, desyncing the
+            # keep-alive connection. Answer, then drop the connection.
+            self._fail(exc)
+            self.close_connection = True
+            return
+        try:
+            with contextlib.closing(body):
                 handler(body)
         except _StreamAborted:
             # Response already committed; the only safe move is dropping
             # the connection so the client sees a truncated stream (the
             # shim maps that to RemoteStorageException).
             self.close_connection = True
-        except _BodyTooLarge:
-            self._reply(413, b"request body exceeds MAX_BODY_BYTES")
-            self.close_connection = True  # unread body left on the socket
         except Exception as exc:  # noqa: BLE001 — boundary translation
             self._fail(exc)
 

@@ -250,7 +250,10 @@ class HttpClient:
         retry_number = 0
         while True:
             try:
-                resp = self._request_once(method, path_and_query, headers, body, idempotent)
+                resp = self._request_once(
+                    method, path_and_query, headers, body, idempotent,
+                    budget=None if deadline is None else deadline - time.monotonic(),
+                )
             except HttpError:
                 if not replay_safe or retry_number >= policy.max_attempts - 1:
                     raise
@@ -275,15 +278,24 @@ class HttpClient:
             return resp
 
     def _request_once(
-        self, method, path_and_query, headers, body, idempotent
+        self, method, path_and_query, headers, body, idempotent, budget=None
     ) -> HttpResponse:
         """One attempt (the retry loop's unit); the observer sees every
-        attempt, so per-attempt rates/errors match what went on the wire."""
+        attempt, so per-attempt rates/errors match what went on the wire.
+
+        `budget` is the remaining total-deadline seconds: the attempt's
+        socket timeout is capped to it so the CALL honors the deadline
+        (reference semantics: api.call.timeout includes all retries — a
+        late attempt must not get a full fresh socket timeout)."""
         t0 = time.perf_counter()
         err: Optional[BaseException] = None
         status = 0
         try:
-            resp = self._roundtrip(method, path_and_query, headers, body, idempotent)
+            if budget is not None and budget <= 0:
+                raise TimeoutError("api call deadline exceeded before attempt")
+            resp = self._roundtrip(
+                method, path_and_query, headers, body, idempotent, budget=budget
+            )
             status = resp.status
             data = resp.read()
             return HttpResponse(status, dict(resp.getheaders()), data)
@@ -317,7 +329,10 @@ class HttpClient:
         retry_number = 0
         while True:
             try:
-                status, hdrs, stream = self._stream_once(method, path_and_query, headers)
+                status, hdrs, stream = self._stream_once(
+                    method, path_and_query, headers,
+                    budget=None if deadline is None else deadline - time.monotonic(),
+                )
             except HttpError:
                 if retry_number >= policy.max_attempts - 1:
                     raise
@@ -338,10 +353,11 @@ class HttpClient:
             return status, hdrs, stream
 
     def _stream_once(
-        self, method, path_and_query, headers
+        self, method, path_and_query, headers, budget=None
     ) -> tuple[int, Mapping[str, str], BinaryIO]:
         t0 = time.perf_counter()
         conn = self._new_connection()
+        self._apply_timeout(conn, budget)
         try:
             conn.request(method, path_and_query, body=None, headers=dict(headers or {}))
             resp = conn.getresponse()
@@ -357,10 +373,23 @@ class HttpClient:
 
     _IDEMPOTENT = frozenset({"GET", "HEAD", "PUT", "DELETE"})
 
+    def _apply_timeout(self, conn, budget) -> None:
+        """Effective per-attempt socket timeout = min(client timeout,
+        remaining deadline budget). Always (re)applied — a pooled
+        connection must not inherit a clamped timeout from an earlier
+        budgeted call."""
+        candidates = [t for t in (self.timeout, budget) if t is not None]
+        effective = max(0.001, min(candidates)) if candidates else None
+        conn.timeout = effective
+        sock = getattr(conn, "sock", None)  # None before connect (and on fakes)
+        if sock is not None:
+            sock.settimeout(effective)
+
     def _roundtrip(
-        self, method, path_and_query, headers, body, idempotent=None
+        self, method, path_and_query, headers, body, idempotent=None, budget=None
     ) -> http.client.HTTPResponse:
         conn = self._pooled()
+        self._apply_timeout(conn, budget)
         reused = getattr(self._local, "conn_used", False)
         sent = False
         try:
@@ -382,6 +411,7 @@ class HttpClient:
             if not reused or (sent and not replay_safe):
                 raise
             conn = self._pooled()
+            self._apply_timeout(conn, budget)
             conn.request(method, path_and_query, body=body, headers=dict(headers or {}))
             resp = conn.getresponse()
         self._local.conn_used = True
