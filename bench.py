@@ -431,20 +431,30 @@ def run_bench() -> dict:
         _err(f"[bench] tpu-huff-v1 codec failed: {extras['thuff_error']}")
 
     # Device LZ codec (tpu-lzhuff-v1): match-finding + Huffman on-chip,
-    # sequence serialization host-side, incl transfers. Same guard.
+    # sequence serialization host-side, incl transfers. Same guard. On the
+    # CPU fallback the match-finder's scan+doubling passes run ~40 s per
+    # window on one host — sample a slice so the artifact still lands
+    # inside the driver budget (the ratio is per-chunk, unaffected).
     try:
         from tieredstorage_tpu.transform import lzhuff as lzhuff_codec
 
-        lzhuff_codec.compress_batch(chunks)  # warm jit at the timed shape
+        lz_chunks = chunks if platform == "tpu" else chunks[:2]
+        lz_bytes = sum(len(c) for c in lz_chunks)
+        lzhuff_codec.compress_batch(lz_chunks)  # warm jit at the timed shape
         t0 = time.perf_counter()
-        lframes = lzhuff_codec.compress_batch(chunks)
+        lframes = lzhuff_codec.compress_batch(lz_chunks)
         lzhuff_s = time.perf_counter() - t0
-        lratio = sum(len(c) for c in lframes) / total_bytes
-        extras["lzhuff_compress_gibs"] = round(gib / lzhuff_s, 3)
+        lratio = sum(len(c) for c in lframes) / lz_bytes
+        extras["lzhuff_compress_gibs"] = round(lz_bytes / (1 << 30) / lzhuff_s, 3)
         extras["lzhuff_ratio"] = round(lratio, 3)
+        # Record the measured workload: a CPU-fallback artifact must not
+        # read as the same benchmark as a full-window TPU run.
+        extras["lzhuff_chunks"] = len(lz_chunks)
+        extras["lzhuff_bytes"] = lz_bytes
         _err(
-            f"[bench] tpu-lzhuff-v1 device codec (incl tunnel): "
-            f"{gib / lzhuff_s:.3f} GiB/s, ratio {lratio:.3f}"
+            f"[bench] tpu-lzhuff-v1 device codec (incl tunnel, "
+            f"{len(lz_chunks)} chunks): "
+            f"{lz_bytes / (1 << 30) / lzhuff_s:.3f} GiB/s, ratio {lratio:.3f}"
         )
     except Exception as exc:
         extras["lzhuff_error"] = f"{type(exc).__name__}: {exc}"

@@ -13,6 +13,7 @@ envelope encryption, then walks the full lifecycle and prints what happened.
     python demo/run_demo.py --backend filesystem
     python demo/run_demo.py --backend s3 --transform native
     python demo/run_demo.py --codec tpu-huff-v1 # the device codec (JAX)
+    python demo/run_demo.py --codec tpu-lzhuff-v1 # device LZ + Huffman
 """
 
 from __future__ import annotations
@@ -37,8 +38,8 @@ def main() -> None:
         help="transform.backend.class to use (tpu needs a JAX device)",
     )
     parser.add_argument(
-        "--codec", choices=["zstd", "tpu-huff-v1"], default="zstd",
-        help="compression.codec (tpu-huff-v1 runs the device codec kernels)",
+        "--codec", choices=["zstd", "tpu-huff-v1", "tpu-lzhuff-v1"], default="zstd",
+        help="compression.codec (tpu-*-v1 run the device codec kernels)",
     )
     parser.add_argument("--records", type=int, default=3000)
     parser.add_argument(
@@ -49,7 +50,7 @@ def main() -> None:
     )
     args = parser.parse_args()
 
-    needs_jax = args.codec == "tpu-huff-v1" or args.transform == "tpu"
+    needs_jax = args.codec.startswith("tpu-") or args.transform == "tpu"
     if args.virtual_cpu_devices is not None:
         from tieredstorage_tpu.utils.platforms import pin_virtual_cpu
 
