@@ -22,7 +22,6 @@ import jax.numpy as jnp
 from tieredstorage_tpu.ops import aes_pallas
 from tieredstorage_tpu.ops.aes_bitsliced import (
     aes_encrypt_planes,
-    ctr_keystream_batch,
     make_rk_planes,
 )
 

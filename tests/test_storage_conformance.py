@@ -23,7 +23,6 @@ import base64
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-import pytest
 
 from tieredstorage_tpu.storage.core import BytesRange, ObjectKey
 

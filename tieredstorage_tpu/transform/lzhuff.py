@@ -47,12 +47,7 @@ import struct
 
 import numpy as np
 
-from tieredstorage_tpu.ops.lz import (
-    MAX_DIST,
-    MIN_MATCH,
-    lz_analyze_batch,
-    lz_shape,
-)
+from tieredstorage_tpu.ops.lz import MIN_MATCH, lz_analyze_batch, lz_shape
 from tieredstorage_tpu.transform import thuff
 
 CODEC_ID = "tpu-lzhuff-v1"
