@@ -106,7 +106,10 @@ def _backend_setup(kind: str, stops: list):
     return configs, list_keys
 
 
-@pytest.fixture(scope="module", params=["s3", "gcs", "azure", "s3-socks5"])
+@pytest.fixture(
+    scope="module",
+    params=["s3", "gcs", "azure", "s3-socks5", "s3-lzhuff"],
+)
 def env(request):
     stops: list = []
     try:
@@ -122,7 +125,14 @@ def env(request):
 
 
 def _env_impl(request, stops):
-    storage_configs, list_keys = _backend_setup(request.param, stops)
+    # The "-lzhuff" matrix entry replays the whole ordered scenario with the
+    # device LZ codec instead of zstd (same storage backend path).
+    backend_kind = request.param
+    codec = "zstd"
+    if backend_kind.endswith("-lzhuff"):
+        backend_kind = backend_kind[: -len("-lzhuff")]
+        codec = "tpu-lzhuff-v1"
+    storage_configs, list_keys = _backend_setup(backend_kind, stops)
     tmp = pathlib.Path(tempfile.mkdtemp())
     pub, priv = generate_key_pair_pem_files(tmp)
     rsm = RemoteStorageManager()
@@ -133,6 +143,7 @@ def _env_impl(request, stops):
             "chunk.size": CHUNK_SIZE,
             "key.prefix": "e2e/",
             "compression.enabled": True,
+            "compression.codec": codec,
             "encryption.enabled": True,
             "encryption.key.pair.id": "k1",
             "encryption.key.pairs": ["k1"],
