@@ -226,6 +226,23 @@ class TestGatewayContract:
         status, body = _post(gateway, "/v1/fetch", md + JavaShimEncoder.fetch_tail(0))
         assert status == 404, body
 
+    def test_overlong_chunk_size_line_rejected(self, gateway):
+        """A chunk-size line longer than the reader's bound must 400 (and
+        drop the connection) — truncating it would shift the remainder into
+        the chunk data (round-4 review)."""
+        conn = http.client.HTTPConnection("127.0.0.1", gateway.port, timeout=10)
+        try:
+            conn.putrequest("POST", "/v1/delete")
+            conn.putheader("Transfer-Encoding", "chunked")
+            conn.endheaders()
+            conn.send(b"10;ext=" + b"x" * 2000 + b"\r\n" + b"\x00" * 16 + b"\r\n")
+            conn.send(b"0\r\n\r\n")
+            resp = conn.getresponse()
+            assert resp.status == 400
+            assert b"chunk size line" in resp.read()
+        finally:
+            conn.close()
+
     def test_oversized_body_maps_to_413(self, gateway):
         from tieredstorage_tpu.sidecar import http_gateway
 
