@@ -159,13 +159,14 @@ class TestFailover:
 
 
 class TestDeviceCodecAcrossBoundary:
-    def test_thuff_segments_round_trip_the_process_boundary(
-        self, tmp_path
+    @pytest.mark.parametrize("codec", ["tpu-huff-v1", "tpu-lzhuff-v1"])
+    def test_device_codec_segments_round_trip_the_process_boundary(
+        self, tmp_path, codec
     ):
-        """A sidecar configured with the device codec must write
-        tpu-huff-v1 manifests and serve byte-exact ranged reads across the
-        gRPC boundary (codec selection is config-side only; the wire
-        protocol is codec-agnostic)."""
+        """A sidecar configured with a device codec must write its manifest
+        codec id and serve byte-exact ranged reads across the gRPC boundary
+        (codec selection is config-side only; the wire protocol is
+        codec-agnostic)."""
         storage_root = tmp_path / "remote"
         storage_root.mkdir()
         config = {
@@ -174,7 +175,7 @@ class TestDeviceCodecAcrossBoundary:
             "storage.root": str(storage_root),
             "chunk.size": 4096,
             "compression.enabled": True,
-            "compression.codec": "tpu-huff-v1",
+            "compression.codec": codec,
         }
         # --virtual-cpu-devices: the device codec touches JAX, and in this
         # harness implicit platform acquisition would dial the TPU relay.
@@ -190,7 +191,7 @@ class TestDeviceCodecAcrossBoundary:
                 manifest = json.loads(
                     next(storage_root.rglob("*.rsm-manifest")).read_text()
                 )
-                assert manifest["compressionCodec"] == "tpu-huff-v1"
+                assert manifest["compressionCodec"] == codec
                 original = data.log_segment.read_bytes()
                 assert client.fetch_log_segment(md, 0).read() == original
                 assert (
