@@ -133,11 +133,14 @@ class TestFormatInternals:
         dists[70_000] = 70_000
         # The parse walks: 0 -> 70_000 -> 130_000 (literal tail to n).
         sel[130_000] = True
-        records, lit_slices = _sequences(sel, lens, dists, n)
+        records, covered = _sequences(sel, lens, dists, n)
         assert (records[:, 0] <= 0xFFFF).all() and (records[:, 1] <= 0xFFFF).all()
         assert records[:, 0].sum() == 70_000 + (n - 130_000)
         assert records[:, 1].sum() == 60_000
-        assert lit_slices == [(0, 70_000), (130_000, n)]
+        # Coverage mask: exactly the match span is covered.
+        assert not covered[:70_000].any()
+        assert covered[70_000:130_000].all()
+        assert not covered[130_000:].any()
 
     def test_numpy_and_native_expanders_agree(self):
         from tieredstorage_tpu import native
