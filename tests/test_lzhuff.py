@@ -157,6 +157,20 @@ class TestFormatInternals:
         with mock.patch.object(native, "lz_expand", return_value=None):
             assert decompress_batch(frames) == [data]
 
+    def test_expander_checks_each_total_independently(self):
+        """_expand must reject when EITHER total mismatches (an `or->and`
+        mutant that requires both to mismatch survived the round-4 sweep)."""
+        import numpy as np
+
+        from tieredstorage_tpu.transform.lzhuff import _expand
+
+        # Literals under-consumed, output length correct.
+        with pytest.raises(LzhuffFormatError, match="consumed 1/2"):
+            _expand(1, np.array([[1, 0, 0]], np.int64), np.frombuffer(b"ab", np.uint8))
+        # Output short, literals fully consumed.
+        with pytest.raises(LzhuffFormatError, match="produced 2/3"):
+            _expand(3, np.array([[2, 0, 0]], np.int64), np.frombuffer(b"ab", np.uint8))
+
     def test_rep_sentinel_round_trips(self):
         # Periodic data (one dominant distance): sentinel-heavy stream.
         data = (b"0123456789abcdef" * 4096)[:50_000]
