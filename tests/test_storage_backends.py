@@ -55,3 +55,33 @@ class TestFileSystemStorage(StorageContract):
         b.configure({"root": str(tmp_storage_root)})
         with pytest.raises(StorageBackendException):
             b.upload(io.BytesIO(b"x"), ObjectKey("../escape"))
+
+
+class TestIterChunks:
+    """iter_chunks single-sources the accumulate-and-slice EOF handling of
+    the cloud upload paths; pin the partial-tail and exact-multiple cases
+    directly (a round-4 mutation survivor showed this suite never
+    exercised the eof-with-pending arm)."""
+
+    def test_partial_tail_is_yielded(self):
+        import io
+
+        from tieredstorage_tpu.storage.core import iter_chunks
+
+        chunks = list(iter_chunks(io.BytesIO(b"abcdefghij"), 4, read_size=3))
+        assert chunks == [b"abcd", b"efgh", b"ij"]
+
+    def test_exact_multiple_has_no_empty_tail(self):
+        import io
+
+        from tieredstorage_tpu.storage.core import iter_chunks
+
+        chunks = list(iter_chunks(io.BytesIO(b"abcdefgh"), 4, read_size=8))
+        assert chunks == [b"abcd", b"efgh"]
+
+    def test_empty_stream_yields_nothing(self):
+        import io
+
+        from tieredstorage_tpu.storage.core import iter_chunks
+
+        assert list(iter_chunks(io.BytesIO(b""), 4)) == []
