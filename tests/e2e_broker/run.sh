@@ -1,0 +1,132 @@
+#!/usr/bin/env bash
+# Real-broker e2e: replay the reference's ordered scenario (copy → read →
+# manual delete → retention cleanup → topic delete,
+# /root/reference/e2e/src/test/java/.../SingleBrokerTest.java:98-661)
+# against a REAL Apache Kafka 3.7 broker loading the kafka-shim jar, with
+# the tieredstorage_tpu sidecar tiering to MinIO.
+#
+# Usage: tests/e2e_broker/run.sh <path-to-shim-jar>
+# Needs: docker + docker compose. Run by the broker-e2e CI job; it cannot
+# run in the development sandbox (no docker daemon), same as the
+# reference's Testcontainers tier needs containers.
+set -euo pipefail
+
+HERE="$(cd "$(dirname "$0")" && pwd)"
+JAR="${1:?usage: run.sh <shim-jar>}"
+COMPOSE=(docker compose -f "$HERE/compose.yml" -p tse2e)
+TOPIC=tiered-e2e
+RECORDS=10000
+
+mkdir -p "$HERE/jar"
+cp "$JAR" "$HERE/jar/"
+
+cleanup() {
+    code=$?
+    if [ "$code" -ne 0 ]; then
+        echo "==== FAILURE (exit $code) — kafka logs ===="
+        "${COMPOSE[@]}" logs --tail 200 kafka || true
+        echo "==== sidecar logs ===="
+        "${COMPOSE[@]}" logs --tail 200 sidecar || true
+    fi
+    "${COMPOSE[@]}" down -v >/dev/null 2>&1 || true
+    exit "$code"
+}
+trap cleanup EXIT
+
+kexec() { docker exec tse2e-kafka-1 /opt/kafka/bin/"$@"; }
+
+# mc one-shot against the stack's network; prints the remote object count.
+remote_objects() {
+    docker run --rm --network tse2e_default --entrypoint /bin/sh \
+        minio/mc:RELEASE.2024-05-09T17-04-24Z -c "
+        mc alias set local http://minio:9000 minioadmin minioadmin >/dev/null &&
+        mc ls -r local/tiered-segments 2>/dev/null | wc -l" | tr -d '[:space:]'
+}
+
+# wait_for <timeout_s> <description> <command...>  — polls every 5 s.
+wait_for() {
+    local timeout=$1 what=$2; shift 2
+    local deadline=$((SECONDS + timeout))
+    until "$@"; do
+        if [ "$SECONDS" -ge "$deadline" ]; then
+            echo "TIMEOUT after ${timeout}s waiting for: $what"
+            return 1
+        fi
+        sleep 5
+    done
+    echo "ok: $what"
+}
+
+echo "==== boot stack ===="
+"${COMPOSE[@]}" up -d --build
+wait_for 180 "broker answers" kexec kafka-topics.sh --bootstrap-server localhost:9092 --list
+wait_for 60 "sidecar metrics up" curl -fsS -o /dev/null http://127.0.0.1:9404/metrics
+
+echo "==== 1. remoteCopy: create topic + produce ${RECORDS} records ===="
+# Segment size deliberately unaligned to the sidecar's 16 KiB chunk size,
+# like the reference's 256.5 KiB segments (SingleBrokerTest.java:114-126).
+kexec kafka-topics.sh --bootstrap-server localhost:9092 --create --topic "$TOPIC" \
+    --partitions 3 --replication-factor 1 \
+    --config remote.storage.enable=true \
+    --config segment.bytes=262144 \
+    --config local.retention.bytes=1 \
+    --config retention.ms=-1
+kexec kafka-producer-perf-test.sh --topic "$TOPIC" --num-records "$RECORDS" \
+    --record-size 1024 --throughput -1 \
+    --producer-props bootstrap.servers=localhost:9092 batch.size=16384
+
+tiered() { [ "$(remote_objects)" -ge 9 ]; }   # >= 3 segments x (.log + .indexes + .rsm-manifest)
+wait_for 300 "segments tiered to MinIO (>=9 objects)" tiered
+echo "remote objects after copy: $(remote_objects)"
+
+copied() { curl -fsS http://127.0.0.1:9404/metrics | grep -Eq 'object_upload_total(\{[^}]*\})? [1-9]'; }
+wait_for 60 "sidecar upload metrics nonzero" copied
+
+# Let tiering drain completely before taking count snapshots: ~36 segments
+# tier from the 10 MB produce; a snapshot mid-copy would race step 3's
+# shrink assertion.
+stable=0
+settled() {
+    local now; now=$(remote_objects)
+    if [ "$now" = "$stable" ]; then return 0; fi
+    stable=$now; return 1
+}
+wait_for 300 "remote object count stable across 5s polls" settled
+
+echo "==== 2. remoteRead: consume all records from offset 0 ===="
+# local.retention.bytes=1 means old segments are gone locally once tiered;
+# reading from 0 exercises shim fetchLogSegment -> sidecar -> ranged S3 GET.
+consumed=$(kexec kafka-console-consumer.sh --bootstrap-server localhost:9092 \
+    --topic "$TOPIC" --from-beginning --max-messages "$RECORDS" \
+    --timeout-ms 300000 2>/dev/null | wc -l)
+[ "$consumed" -eq "$RECORDS" ] || { echo "consumed $consumed != $RECORDS"; exit 1; }
+echo "ok: consumed $consumed records through the tiered read path"
+
+echo "==== 3. remoteManualDelete: delete-records below offset 1000 on p0 ===="
+before=$(remote_objects)
+echo '{"partitions":[{"topic":"'"$TOPIC"'","partition":0,"offset":1000}],"version":1}' \
+    > /tmp/delete-records.json
+docker cp /tmp/delete-records.json tse2e-kafka-1:/tmp/delete-records.json
+kexec kafka-delete-records.sh --bootstrap-server localhost:9092 \
+    --offset-json-file /tmp/delete-records.json
+shrunk() { [ "$(remote_objects)" -lt "$before" ]; }
+wait_for 300 "remote objects pruned after delete-records (< $before)" shrunk
+
+echo "==== 4. remoteCleanupDueToRetention ===="
+kexec kafka-configs.sh --bootstrap-server localhost:9092 --alter \
+    --entity-type topics --entity-name "$TOPIC" --add-config retention.ms=1000
+drained() { [ "$(remote_objects)" -eq 0 ]; }
+wait_for 300 "all remote objects removed by retention" drained
+
+echo "==== 5. topicDelete ===="
+kexec kafka-configs.sh --bootstrap-server localhost:9092 --alter \
+    --entity-type topics --entity-name "$TOPIC" --delete-config retention.ms
+kexec kafka-producer-perf-test.sh --topic "$TOPIC" --num-records 2000 \
+    --record-size 1024 --throughput -1 \
+    --producer-props bootstrap.servers=localhost:9092 >/dev/null
+retiered() { [ "$(remote_objects)" -gt 0 ]; }
+wait_for 300 "fresh segments tiered again" retiered
+kexec kafka-topics.sh --bootstrap-server localhost:9092 --delete --topic "$TOPIC"
+wait_for 300 "remote objects removed on topic delete" drained
+
+echo "==== PASS: full ordered scenario against a real broker ===="
