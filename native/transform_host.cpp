@@ -308,11 +308,20 @@ int ts_aes_gcm_decrypt_batch(const uint8_t *key, const uint8_t *aad, uint64_t aa
 int ts_lz_expand(const uint16_t* seqs, int n_seq,
                  const uint8_t* lits, uint64_t lit_total,
                  uint8_t* out, uint64_t out_len) {
+  // The Python caller serializes sequences as numpy '<u2' (explicit
+  // little-endian); decode byte-wise so this expander and the numpy
+  // fallback agree on any host endianness.
+  const uint8_t* sb = reinterpret_cast<const uint8_t*>(seqs);
+  const auto u16le = [sb](uint64_t idx) -> uint64_t {
+    return static_cast<uint64_t>(sb[2 * idx]) |
+           (static_cast<uint64_t>(sb[2 * idx + 1]) << 8);
+  };
   uint64_t o = 0, lp = 0, last_d = 0;
   for (int i = 0; i < n_seq; ++i) {
-    const uint64_t lit = seqs[3 * i];
-    const uint64_t m = seqs[3 * i + 1];
-    uint64_t d = seqs[3 * i + 2];
+    const uint64_t base = 3ull * static_cast<uint64_t>(i);
+    const uint64_t lit = u16le(base);
+    const uint64_t m = u16le(base + 1);
+    uint64_t d = u16le(base + 2);
     if (lit) {
       if (lp + lit > lit_total || o + lit > out_len) return 1;
       std::memcpy(out + o, lits + lp, lit);

@@ -243,6 +243,38 @@ class TestGatewayContract:
         finally:
             conn.close()
 
+    def test_negative_content_length_maps_to_400(self, gateway):
+        """A negative Content-Length must fail fast with 400 — a negative
+        take(n) would spin `while remaining:` reading to EOF, pinning the
+        handler thread until the peer hangs up (round-5 advisor)."""
+        conn = http.client.HTTPConnection("127.0.0.1", gateway.port, timeout=10)
+        try:
+            conn.putrequest("POST", "/v1/delete")
+            conn.putheader("Content-Length", "-7")
+            conn.endheaders()
+            resp = conn.getresponse()
+            assert resp.status == 400
+            assert b"negative Content-Length" in resp.read()
+        finally:
+            conn.close()
+
+    @pytest.mark.parametrize("size_line", [b"-5", b"+5", b"0x1f", b"1_0", b""])
+    def test_non_canonical_chunk_size_maps_to_400(self, gateway, size_line):
+        """int(_, 16) alone accepts "-5"/"+5"/"0x1f"/"1_0"; negatives would
+        spin take() to EOF and the rest are request-smuggling surface, so the
+        gateway holds the strict 1*HEXDIG grammar (round-5 advisor)."""
+        conn = http.client.HTTPConnection("127.0.0.1", gateway.port, timeout=10)
+        try:
+            conn.putrequest("POST", "/v1/delete")
+            conn.putheader("Transfer-Encoding", "chunked")
+            conn.endheaders()
+            conn.send(size_line + b"\r\n\r\n0\r\n\r\n")
+            resp = conn.getresponse()
+            assert resp.status == 400
+            assert b"bad chunk size line" in resp.read()
+        finally:
+            conn.close()
+
     def test_oversized_body_maps_to_413(self, gateway):
         from tieredstorage_tpu.sidecar import http_gateway
 

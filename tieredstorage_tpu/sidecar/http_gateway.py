@@ -87,12 +87,19 @@ class _Handler(BaseHTTPRequestHandler):
                     # the size line into the chunk data.
                     raise shimwire.ShimWireError("chunk size line too long")
                 size_line = raw_line.strip()
-                try:
-                    size = int(size_line.split(b";")[0], 16)
-                except ValueError:
+                # Strict RFC 7230 chunk-size grammar (1*HEXDIG). int(_, 16)
+                # alone also accepts "-5"/"+5"/"0x1f"/"1_0" — the negative
+                # forms would make take(n<0) spin reading to EOF, and the
+                # non-canonical ones are request-smuggling surface against
+                # stricter intermediaries.
+                size_field = size_line.split(b";")[0]
+                if not size_field or not all(
+                    c in b"0123456789abcdefABCDEF" for c in size_field
+                ):
                     raise shimwire.ShimWireError(
                         f"bad chunk size line {size_line!r}"
-                    ) from None
+                    )
+                size = int(size_field, 16)
                 if size == 0:
                     # Consume the trailer section up to the final CRLF.
                     while self.rfile.readline(1024).strip():
@@ -102,6 +109,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self.rfile.read(2)  # chunk-terminating CRLF
         else:
             length = int(self.headers.get("Content-Length", "0"))
+            if length < 0:
+                raise shimwire.ShimWireError(f"negative Content-Length {length}")
             if length > MAX_BODY_BYTES:
                 raise _BodyTooLarge()
             take(length)
