@@ -73,14 +73,16 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _maybe_fail(self) -> bool:
         with self.state.lock:
-            for i, (matcher, status, body) in enumerate(self.state.fail_next):
+            for i, entry in enumerate(self.state.fail_next):
+                matcher, status, body = entry[:3]
+                headers = entry[3] if len(entry) > 3 else None
                 if matcher(self.command, self.path):
                     self.state.fail_next.pop(i)
                     break
             else:
                 return False
         self._body()  # drain the request body to keep the connection parseable
-        self._reply(status, body)
+        self._reply(status, body, headers)
         return True
 
     _AUTH_RE = re.compile(
@@ -292,8 +294,12 @@ class S3Emulator:
         code: str = "SlowDown",
         message: str = "injected",
         when=None,
+        headers: dict | None = None,
     ) -> None:
-        """Fail the next request (matching `when(method, path)` if given)."""
+        """Fail the next request (matching `when(method, path)` if given);
+        `headers` ride the error response (e.g. Retry-After)."""
         matcher = when if when is not None else (lambda method, path: True)
         with self.state.lock:
-            self.state.fail_next.append((matcher, status, _error_xml(code, message)))
+            self.state.fail_next.append(
+                (matcher, status, _error_xml(code, message), headers)
+            )

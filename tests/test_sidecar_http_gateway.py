@@ -243,18 +243,20 @@ class TestGatewayContract:
         finally:
             conn.close()
 
-    def test_negative_content_length_maps_to_400(self, gateway):
-        """A negative Content-Length must fail fast with 400 — a negative
-        take(n) would spin `while remaining:` reading to EOF, pinning the
-        handler thread until the peer hangs up (round-5 advisor)."""
+    @pytest.mark.parametrize("value", ["-7", "+5", "1_0"])
+    def test_non_canonical_content_length_maps_to_400(self, gateway, value):
+        """Content-Length outside 1*DIGIT must fail fast with 400 — a
+        negative take(n) would spin `while remaining:` reading to EOF
+        pinning the handler thread, and '+5'/'1_0' are desync surface
+        (round-5 advisor + review)."""
         conn = http.client.HTTPConnection("127.0.0.1", gateway.port, timeout=10)
         try:
             conn.putrequest("POST", "/v1/delete")
-            conn.putheader("Content-Length", "-7")
+            conn.putheader("Content-Length", value)
             conn.endheaders()
             resp = conn.getresponse()
             assert resp.status == 400
-            assert b"negative Content-Length" in resp.read()
+            assert b"bad Content-Length" in resp.read()
         finally:
             conn.close()
 

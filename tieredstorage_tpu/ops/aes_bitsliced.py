@@ -232,7 +232,11 @@ def _sbox_planes(tw: dict, bits: list[jnp.ndarray]) -> list[jnp.ndarray]:
     res = _linear4(tw["lin_out"], b_out + a_out)
     const = tw["const"]
     return [
-        res[i] ^ jnp.uint32(0xFFFFFFFF) if (const >> i) & 1 else res[i]
+        # ~x, not x ^ jnp.uint32(-1): a scalar-const XOR materializes an
+        # i32[] constant per call site, and the Pallas TPU lowering rejects
+        # kernels that capture constants (~300 of them across 14 rounds —
+        # seen on the real chip, round 5); bitwise NOT lowers constant-free.
+        ~res[i] if (const >> i) & 1 else res[i]
         for i in range(8)
     ]
 

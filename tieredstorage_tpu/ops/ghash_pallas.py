@@ -108,8 +108,11 @@ def _ghash_l1_kernel(x_ref, w_ref, o_ref):
     x = x_ref[:]
     acc = None
     for p in range(8):
-        plane = ((x >> p) & 1).astype(jnp.float32)
-        w_p = w_ref[p].astype(jnp.float32)
+        # Two-step casts: Mosaic on the v5e toolchain rejects direct
+        # uint8->f32 and int8->f32 vector casts (seen on chip, round 5);
+        # int32 is the supported waypoint.
+        plane = ((x >> p) & 1).astype(jnp.int32).astype(jnp.float32)
+        w_p = w_ref[p].astype(jnp.int32).astype(jnp.float32)
         part = jnp.dot(plane, w_p, preferred_element_type=jnp.float32)
         acc = part if acc is None else acc + part
     o_ref[:] = (acc.astype(jnp.int32) & 1).astype(jnp.int8)

@@ -92,7 +92,10 @@ class _Handler(BaseHTTPRequestHandler):
                 # forms would make take(n<0) spin reading to EOF, and the
                 # non-canonical ones are request-smuggling surface against
                 # stricter intermediaries.
-                size_field = size_line.split(b";")[0]
+                # BWS before the chunk-ext ';' is valid per RFC 7230 §3.2.3
+                # (recipients MUST parse and remove) — strip it before the
+                # strict 1*HEXDIG check.
+                size_field = size_line.split(b";")[0].strip()
                 if not size_field or not all(
                     c in b"0123456789abcdefABCDEF" for c in size_field
                 ):
@@ -108,9 +111,15 @@ class _Handler(BaseHTTPRequestHandler):
                 take(size)
                 self.rfile.read(2)  # chunk-terminating CRLF
         else:
-            length = int(self.headers.get("Content-Length", "0"))
-            if length < 0:
-                raise shimwire.ShimWireError(f"negative Content-Length {length}")
+            raw_len = self.headers.get("Content-Length", "0").strip()
+            # Same strict grammar rationale as chunk sizes: bare int()
+            # accepts '+5'/'1_0'/'-7', all desync surface ('-7' would also
+            # spin take() to EOF).
+            if not raw_len.isdigit():
+                raise shimwire.ShimWireError(
+                    f"bad Content-Length {raw_len!r}"
+                )
+            length = int(raw_len)
             if length > MAX_BODY_BYTES:
                 raise _BodyTooLarge()
             take(length)

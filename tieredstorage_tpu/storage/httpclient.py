@@ -9,6 +9,16 @@ SDK dependencies; this module is the shared transport: per-thread connection
 reuse, timeouts, an observer hook (the analogue of the reference's
 MetricCollector pipeline taps), and a socket factory hook used for SOCKS5
 proxying (storage/core/.../proxy/).
+
+Retry ownership is split the same way the reference splits it: the
+transport retries only replay-safe requests (ranged GETs, HEAD, deletes,
+and calls explicitly marked idempotent), so a failed segment UPLOAD is NOT
+retried here — it propagates, the RSM deletes the orphaned objects
+(rsm.py orphan cleanup), and Kafka's RemoteLogManager re-schedules the
+whole copy, exactly as it does for the reference (whose SDK retry configs
+also only replay idempotent calls, S3StorageConfig.java:65-68). Retrying a
+non-replay-safe body mid-stream from a pooled connection risks duplicate
+side effects on a request the server may have partially processed.
 """
 
 from __future__ import annotations
@@ -21,6 +31,8 @@ import socket
 import ssl
 import threading
 import time
+from datetime import datetime, timezone
+from email.utils import parsedate_to_datetime
 from typing import BinaryIO, Callable, Mapping, Optional
 from urllib.parse import urlsplit
 
@@ -70,12 +82,26 @@ NO_RETRY = RetryPolicy(max_attempts=1)
 
 
 def _parse_retry_after(value: str) -> Optional[float]:
-    """Seconds form only ('Retry-After: 2'); HTTP-date form is rare from
-    object stores and not worth a date parser on this path."""
+    """Both RFC 9110 forms: delta-seconds ('Retry-After: 2') and HTTP-date
+    ('Retry-After: Fri, 31 Jul 2026 07:28:00 GMT') — a real S3/GCS 503 can
+    send either (round-4 verdict). A past or unparsable date yields None
+    (the policy's own backoff applies)."""
     try:
         return max(0.0, float(value))
     except (TypeError, ValueError):
+        pass
+    try:
+        when = parsedate_to_datetime(value)
+    except (TypeError, ValueError):
         return None
+    if when is None:
+        return None
+    if when.tzinfo is None:
+        # RFC 5322 parse of an asctime form can come back naive; HTTP dates
+        # are GMT by definition.
+        when = when.replace(tzinfo=timezone.utc)
+    delta = (when - datetime.now(timezone.utc)).total_seconds()
+    return max(0.0, delta) if delta > 0 else None
 
 
 class HttpResponse:

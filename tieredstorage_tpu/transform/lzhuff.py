@@ -197,33 +197,27 @@ def _interleave_records(field_streams: list[bytes], n_seq: int) -> np.ndarray:
     return np.column_stack(cols) if n_seq else np.zeros((0, 3), np.int64)
 
 
-def compress_batch(chunks: list[bytes]) -> list[bytes]:
-    """LZ-analyze a window on device, entropy-code the streams on device,
-    RAW-frame anything the pipeline fails to shrink."""
-    if not chunks:
-        return []
-    for c in chunks:
-        if len(c) > MAX_CHUNK_BYTES:
-            raise LzhuffFormatError(
-                f"chunk of {len(c)} bytes exceeds the v1 frame limit"
-            )
-    live = [(i, c) for i, c in enumerate(chunks) if len(c) >= 4 * MIN_MATCH]
+def analysis_rows(chunks: list[bytes]) -> list[tuple[int, bytes]]:
+    """The (index, chunk) rows `compress_batch` sends to the LZ kernel —
+    chunks long enough that a match can ever pay for its record."""
+    return [(i, c) for i, c in enumerate(chunks) if len(c) >= 4 * MIN_MATCH]
+
+
+def frames_from_analysis(
+    chunks: list[bytes],
+    live: list[tuple[int, bytes]],
+    sel: np.ndarray,
+    lens: np.ndarray,
+    dists: np.ndarray,
+) -> list[bytes]:
+    """Serialize + entropy-code + frame a window from `lz_analyze_batch`
+    arrays (rows aligned with `live`), RAW-framing anything the pipeline
+    failed to shrink. The host-serialize seam shared between
+    `compress_batch` and the multichip dryrun (__graft_entry__.py), so the
+    sharded path cannot drift from the production framing."""
     out: list[bytes] = [
         _HEADER.pack(_MAGIC, _VERSION, _FLAG_RAW, len(c)) + c for c in chunks
     ]
-    if not live:
-        return out
-
-    n_max = lz_shape(max(len(c) for _, c in live))
-    batch = len(live)
-    data = np.zeros((batch, n_max), np.uint8)
-    n_sym = np.zeros(batch, np.int32)
-    for row, (_, c) in enumerate(live):
-        data[row, : len(c)] = np.frombuffer(c, np.uint8)
-        n_sym[row] = len(c)
-    lens, dists, sel = lz_analyze_batch(data, n_sym, n_max=n_max)
-    lens, dists, sel = np.asarray(lens), np.asarray(dists), np.asarray(sel)
-
     streams: list[bytes] = []  # _N_STREAMS per live chunk
     dicts: list[bytes] = []
     for row, (_, c) in enumerate(live):
@@ -250,6 +244,35 @@ def compress_batch(chunks: list[bytes]) -> list[bytes]:
         if len(body) < len(c):
             out[i] = _HEADER.pack(_MAGIC, _VERSION, 0, len(c)) + body
     return out
+
+
+def compress_batch(chunks: list[bytes]) -> list[bytes]:
+    """LZ-analyze a window on device, entropy-code the streams on device,
+    RAW-frame anything the pipeline fails to shrink."""
+    if not chunks:
+        return []
+    for c in chunks:
+        if len(c) > MAX_CHUNK_BYTES:
+            raise LzhuffFormatError(
+                f"chunk of {len(c)} bytes exceeds the v1 frame limit"
+            )
+    live = analysis_rows(chunks)
+    if not live:
+        return [
+            _HEADER.pack(_MAGIC, _VERSION, _FLAG_RAW, len(c)) + c for c in chunks
+        ]
+
+    n_max = lz_shape(max(len(c) for _, c in live))
+    batch = len(live)
+    data = np.zeros((batch, n_max), np.uint8)
+    n_sym = np.zeros(batch, np.int32)
+    for row, (_, c) in enumerate(live):
+        data[row, : len(c)] = np.frombuffer(c, np.uint8)
+        n_sym[row] = len(c)
+    lens, dists, sel = lz_analyze_batch(data, n_sym, n_max=n_max)
+    return frames_from_analysis(
+        chunks, live, np.asarray(sel), np.asarray(lens), np.asarray(dists)
+    )
 
 
 # ------------------------------------------------------------------ expand

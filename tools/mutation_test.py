@@ -52,6 +52,7 @@ DEFAULT_TARGETS = [
     ("tieredstorage_tpu/fetch/enumeration.py", ["tests/test_rsm_lifecycle.py"]),
     ("tieredstorage_tpu/transform/thuff.py", ["tests/test_thuff.py"]),
     ("tieredstorage_tpu/transform/lzhuff.py", ["tests/test_lzhuff.py"]),
+    ("tieredstorage_tpu/ops/lz.py", ["tests/test_lzhuff.py"]),
     ("tieredstorage_tpu/transform/tpu.py", ["tests/test_transform_tpu.py"]),
     ("tieredstorage_tpu/ops/gf128.py", ["tests/test_ops_gcm.py"]),
     ("tieredstorage_tpu/security/aes.py", ["tests/test_security.py"]),
