@@ -200,28 +200,38 @@ def bench_tunnel_roundtrip(total_bytes: int) -> float:
     return time_best(run, iters=1, warmup=1)
 
 
-def bench_ranged_fetch(chunks: list[bytes], *, chunk_bytes: int) -> dict:
+def bench_ranged_fetch(
+    chunks: list[bytes], *, chunk_bytes: int, codec: str = "zstd",
+    key_prefix: str = "",
+) -> dict:
     """BASELINE config 4: ranged fetches through the disk chunk cache with a
     16 MiB prefetch window over a compressed+encrypted segment on the
     filesystem backend. Reports p50/p99 latency of 64 KiB reads (seeded
     offsets, cold-start cache: the percentile mix includes miss-path
     decrypt+decompress and hit-path disk reads, like a broker serving a
     consumer catching up). Host-path by construction — the reference's fetch
-    path is host-side too, so the number is chip- and relay-independent."""
+    path is host-side too, so the number is chip- and relay-independent.
+
+    `codec` selects the manifest compression codec, so the detransform side
+    of tpu-lzhuff-v1 (native C expander) is measured next to zstd — the
+    round-4 verdict's missing fetch-side codec number."""
     import shutil
     import tempfile
     from pathlib import Path
 
     root = Path(tempfile.mkdtemp(prefix="bench-fetch-"))
     try:
-        return _ranged_fetch_measured(root, chunks, chunk_bytes)
+        out = _ranged_fetch_measured(root, chunks, chunk_bytes, codec)
+        return {f"{key_prefix}{k}": v for k, v in out.items()}
     finally:
         # ~3x the segment size of scratch (source file, remote objects,
         # disk-cache entries) — must not accumulate across bench runs.
         shutil.rmtree(root, ignore_errors=True)
 
 
-def _ranged_fetch_measured(root, chunks: list[bytes], chunk_bytes: int) -> dict:
+def _ranged_fetch_measured(
+    root, chunks: list[bytes], chunk_bytes: int, codec: str
+) -> dict:
     from tieredstorage_tpu.metadata import (
         KafkaUuid,
         LogSegmentData,
@@ -249,6 +259,7 @@ def _ranged_fetch_measured(root, chunks: list[bytes], chunk_bytes: int) -> dict:
         "storage.root": str(root / "remote"),
         "chunk.size": chunk_bytes,
         "compression.enabled": True,
+        "compression.codec": codec,
         "encryption.enabled": True,
         "encryption.key.pair.id": "key1",
         "encryption.key.pairs": "key1",
@@ -298,6 +309,16 @@ def run_bench() -> dict:
 
         pin_virtual_cpu(1)
     import jax
+
+    # Persistent compile cache: the full-GCM graph took 33 min to compile
+    # through the axon remote-compile relay (artifacts_r5/probe_min.json);
+    # with the cache the driver's round-end run loads it in seconds.
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as exc:  # cache is an optimization, never fatal
+        _err(f"[bench] compile cache unavailable: {exc}")
 
     _err(f"[bench] running on platform={platform} devices={jax.devices()}")
 
@@ -503,6 +524,29 @@ def run_bench() -> dict:
     except Exception as exc:
         extras["ranged_fetch_error"] = f"{type(exc).__name__}: {exc}"
         _err(f"[bench] ranged-fetch bench failed: {extras['ranged_fetch_error']}")
+
+    # Same protocol with the LZ device codec — the fetch side detransforms
+    # through the native C expander (round-4 verdict item 4). A smaller
+    # segment keeps the copy phase bounded when the LZ kernel runs on the
+    # CPU fallback (~2 s/MiB there).
+    try:
+        lz_chunks = chunks if platform == "tpu" else chunks[:4]
+        extras.update(bench_ranged_fetch(
+            lz_chunks, chunk_bytes=chunk_bytes,
+            codec="tpu-lzhuff-v1", key_prefix="lzhuff_",
+        ))
+        extras["lzhuff_fetch_chunks"] = len(lz_chunks)
+        _err(
+            f"[bench] ranged fetch with tpu-lzhuff-v1 ({len(lz_chunks)} chunks): "
+            f"p50={extras['lzhuff_ranged_fetch_p50_ms']}ms "
+            f"p99={extras['lzhuff_ranged_fetch_p99_ms']}ms"
+        )
+    except Exception as exc:
+        extras["lzhuff_ranged_fetch_error"] = f"{type(exc).__name__}: {exc}"
+        _err(
+            f"[bench] lzhuff ranged-fetch bench failed: "
+            f"{extras['lzhuff_ranged_fetch_error']}"
+        )
 
     result = {
         "metric": "device_segment_encrypt_throughput_per_chip",

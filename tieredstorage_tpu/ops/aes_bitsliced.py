@@ -18,6 +18,7 @@ index (LSB first), and W packed words, word w bit j = block 32*w + j.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -284,16 +285,31 @@ def round_key_planes(round_keys: np.ndarray) -> np.ndarray:
 
 
 def aes_encrypt_planes(rk_planes: jnp.ndarray, state: jnp.ndarray) -> jnp.ndarray:
-    """Encrypt a bitsliced state uint32[16, 8, W] with AES-256."""
+    """Encrypt a bitsliced state uint32[16, 8, W] with AES-256.
+
+    TSTPU_AES_SCAN=1 wraps the 13 middle rounds in a lax.scan: the traced
+    graph shrinks ~14x (one round body instead of an unrolled cipher),
+    which is the difference between a ~33-minute and a ~2-minute remote
+    compile on the axon relay (round-5, artifacts_r5/probe_min.json) at
+    identical per-byte math."""
     tw = _tower()
     state = state ^ rk_planes[0][..., None]
-    for rnd in range(1, _NR):
+
+    def round_body(state, rk):
         planes = [state[:, b] for b in range(8)]
         planes = _sbox_planes(tw, planes)
         state = jnp.stack(planes, axis=1)
         state = _shift_rows_planes(state)
         state = _mix_columns_planes(state)
-        state = state ^ rk_planes[rnd][..., None]
+        return state ^ rk[..., None]
+
+    if os.environ.get("TSTPU_AES_SCAN") == "1":
+        state, _ = jax.lax.scan(
+            lambda s, rk: (round_body(s, rk), None), state, rk_planes[1:_NR]
+        )
+    else:
+        for rnd in range(1, _NR):
+            state = round_body(state, rk_planes[rnd])
     planes = _sbox_planes(tw, [state[:, b] for b in range(8)])
     state = jnp.stack(planes, axis=1)
     state = _shift_rows_planes(state)

@@ -21,6 +21,7 @@ cipher, core/.../transform/EncryptionChunkEnumeration.java:66-81):
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -33,7 +34,10 @@ from tieredstorage_tpu.ops.aes_bitsliced import _sbox_planes, _tower
 
 #: Sublane rows per plane per grid step: one (8, 128) uint32 vreg per plane,
 #: i.e. 1024 words = 32768 blocks = 512 KiB of keystream per step.
-R = 8
+#: TSTPU_AES_R overrides for on-chip tile sweeps (tools/probe_min.py):
+#: larger R = more words per vector op and fewer grid steps, at the price
+#: of R/8 vregs live per plane.
+R = int(os.environ.get("TSTPU_AES_R", "8"))
 WORDS_PER_STEP = R * 128
 
 

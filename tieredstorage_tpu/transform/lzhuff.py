@@ -203,6 +203,10 @@ def analysis_rows(chunks: list[bytes]) -> list[tuple[int, bytes]]:
     return [(i, c) for i, c in enumerate(chunks) if len(c) >= 4 * MIN_MATCH]
 
 
+def _raw_frame(c: bytes) -> bytes:
+    return _HEADER.pack(_MAGIC, _VERSION, _FLAG_RAW, len(c)) + c
+
+
 def frames_from_analysis(
     chunks: list[bytes],
     live: list[tuple[int, bytes]],
@@ -215,9 +219,7 @@ def frames_from_analysis(
     failed to shrink. The host-serialize seam shared between
     `compress_batch` and the multichip dryrun (__graft_entry__.py), so the
     sharded path cannot drift from the production framing."""
-    out: list[bytes] = [
-        _HEADER.pack(_MAGIC, _VERSION, _FLAG_RAW, len(c)) + c for c in chunks
-    ]
+    out: list[bytes] = [_raw_frame(c) for c in chunks]
     streams: list[bytes] = []  # _N_STREAMS per live chunk
     dicts: list[bytes] = []
     for row, (_, c) in enumerate(live):
@@ -258,9 +260,7 @@ def compress_batch(chunks: list[bytes]) -> list[bytes]:
             )
     live = analysis_rows(chunks)
     if not live:
-        return [
-            _HEADER.pack(_MAGIC, _VERSION, _FLAG_RAW, len(c)) + c for c in chunks
-        ]
+        return [_raw_frame(c) for c in chunks]
 
     n_max = lz_shape(max(len(c) for _, c in live))
     batch = len(live)

@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Probe-and-measure loop (round-4 verdict next-step 1): probe the axon relay
+# cheaply every PROBE_INTERVAL_S; the moment it answers, run the full
+# measurement battery and persist artifacts, so even a short recovery
+# window yields on-chip numbers. Exits after one successful battery unless
+# KEEP_WATCHING=1.
+#
+# Usage: nohup tools/measure_on_recovery.sh >> /tmp/tpu_probe.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+OUT=${MEASURE_OUT:-artifacts_r5}
+INTERVAL=${PROBE_INTERVAL_S:-120}
+export PYTHONPATH="$PWD:/root/.axon_site"
+export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
+mkdir -p "$OUT"
+
+probe() {
+    timeout 120 python -c "import jax; d = jax.devices(); \
+assert d[0].platform == 'tpu', d" >/dev/null 2>&1
+}
+
+battery() {
+    echo "[$(date -u +%FT%TZ)] relay up - running battery"
+    PROBE_MIB=8 timeout 5400 python tools/probe_min.py "$OUT/probe_min_8.json"
+    PROBE_MIB=32 PROBE_STAGES=pallas_aes,circuit_xla,ghash_xla,ghash_pallas,full_gcm \
+        timeout 5400 python tools/probe_min.py "$OUT/probe_min_32.json"
+    timeout 3600 python tools/profile_lz.py > "$OUT/profile_lz.txt" 2>&1
+    timeout 5400 python bench.py > "$OUT/bench.json" 2> "$OUT/bench.stderr"
+    echo "[$(date -u +%FT%TZ)] battery done (see $OUT/)"
+}
+
+while :; do
+    if probe; then
+        battery
+        [ "${KEEP_WATCHING:-0}" = "1" ] || exit 0
+    else
+        echo "[$(date -u +%FT%TZ)] relay down"
+    fi
+    sleep "$INTERVAL"
+done

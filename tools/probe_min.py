@@ -28,7 +28,7 @@ def main() -> None:
     out_path = sys.argv[1] if len(sys.argv) > 1 else "artifacts_r5/probe_min.json"
     stages = os.environ.get(
         "PROBE_STAGES",
-        "sanity,ghash_pallas,pallas_aes,xla_ctr,ghash_xla,full_gcm",
+        "sanity,ghash_pallas,pallas_aes,circuit_xla,ghash_xla,full_gcm",
     ).split(",")
     mib = int(os.environ.get("PROBE_MIB", 8))
     results: dict = {"mib": mib, "stages": {}, "t_start": time.time()}
@@ -145,10 +145,14 @@ def main() -> None:
             results["stages"]["pallas_aes"] = {"error": repr(e)[:500]}
             persist()
 
-    if "xla_ctr" in stages and rkp is not None:
+    if "circuit_xla" in stages or "xla_ctr" in stages:  # accept either token
         try:
             from tieredstorage_tpu.ops.aes_pallas import WORDS_PER_STEP
 
+            if rkp is None:  # pallas_aes stage skipped or failed; cheap
+                rkp = jax.block_until_ready(
+                    jax.jit(rk_planes_from_round_keys)(jnp.asarray(rk))
+                )
             w = max(WORDS_PER_STEP, (n_bytes // 512) // WORDS_PER_STEP * WORDS_PER_STEP)
             planes = jax.block_until_ready(
                 materialize(

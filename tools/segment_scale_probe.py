@@ -1,0 +1,115 @@
+"""Segment-scale streaming attribution (SURVEY §7 hard part 4): drive the
+full RSM copy over a large synthetic segment on the virtual CPU mesh and
+attribute wall-clock to pipeline stages via tracer spans, next to a serial
+per-window `transform()` baseline. Companion of tests/test_segment_scale.py;
+this is the tool that produced the round-5 artifact.
+
+Usage: python tools/segment_scale_probe.py [total_mib] [out.txt]
+(Platform is pinned to the virtual CPU mesh internally — safe to run next
+to on-chip jobs.)
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from tieredstorage_tpu.utils.platforms import pin_virtual_cpu  # noqa: E402
+
+pin_virtual_cpu(8)
+
+CODEC = os.environ.get("SSP_CODEC", "zstd")
+
+
+def main() -> None:
+    total = (int(sys.argv[1]) if len(sys.argv) > 1 else 128) << 20
+
+    from tests.test_segment_scale import CHUNK, _build_segment
+    from tieredstorage_tpu.metadata import (
+        KafkaUuid,
+        LogSegmentData,
+        RemoteLogSegmentId,
+        RemoteLogSegmentMetadata,
+        TopicIdPartition,
+        TopicPartition,
+    )
+    from tieredstorage_tpu.rsm import RemoteStorageManager
+    from tieredstorage_tpu.security.aes import AesEncryptionProvider
+    from tieredstorage_tpu.security.rsa import generate_key_pair_pem_files
+    from tieredstorage_tpu.transform.api import TransformOptions
+
+    tmp = pathlib.Path(tempfile.mkdtemp())
+    seg = tmp / "s.log"
+    _build_segment(seg, total)
+    for n, c in [("index", b"I" * 16), ("timeindex", b"T" * 16),
+                 ("snapshot", b"S" * 8)]:
+        (tmp / f"s.{n}").write_bytes(c)
+    data = LogSegmentData(seg, tmp / "s.index", tmp / "s.timeindex",
+                          tmp / "s.snapshot", None, b"lec")
+    tip = TopicIdPartition(KafkaUuid(b"\x03" * 16), TopicPartition("big", 0))
+    md = RemoteLogSegmentMetadata(
+        RemoteLogSegmentId(tip, KafkaUuid(b"\x04" * 16)), 9, 10, total
+    )
+    root = tmp / "remote"
+    root.mkdir()
+    pub, priv = generate_key_pair_pem_files(tmp, prefix="k")
+    rsm = RemoteStorageManager()
+    rsm.configure({
+        "storage.backend.class":
+            "tieredstorage_tpu.storage.filesystem.FileSystemStorage",
+        "storage.root": str(root), "chunk.size": CHUNK,
+        "compression.enabled": True, "compression.codec": CODEC,
+        "encryption.enabled": True, "encryption.key.pair.id": "key1",
+        "encryption.key.pairs": "key1",
+        "encryption.key.pairs.key1.public.key.file": str(pub),
+        "encryption.key.pairs.key1.private.key.file": str(priv),
+        "transform.backend.class":
+            "tieredstorage_tpu.transform.tpu.TpuTransformBackend",
+        "upload.rate.limit.bytes.per.second": 1 << 30,
+        "tracing.enabled": True,
+    })
+    backend = rsm._transform_backend
+    opts = TransformOptions(
+        compression=True, compression_codec=CODEC,
+        encryption=AesEncryptionProvider.create_data_key_and_aad(),
+    )
+    wb = backend.preferred_batch_bytes
+    with seg.open("rb") as f:
+        wins = [[f.read(CHUNK) for _ in range(wb // CHUNK)] for _ in range(2)]
+    backend.transform(wins[0], opts)  # warm compile caches
+    t0 = time.monotonic()
+    for w in wins:
+        backend.transform(w, opts)
+    serial = time.monotonic() - t0
+    serial_est = serial / (2 * wb) * total
+    print(f"serial 2x{wb >> 20}MiB: {serial:.1f}s -> est "
+          f"{serial_est:.1f}s per {total >> 20}MiB", flush=True)
+
+    # Two copies: the first pays one-time jit compiles for every varlen
+    # bucket its windows produce; the second is the steady-state cost a
+    # broker actually sees per segment (thousands of segments per process).
+    for label in ("copy1(cold)", "copy2(warm)"):
+        n0 = len(rsm.tracer._spans)
+        t0 = time.monotonic()
+        rsm.copy_log_segment_data(md, data)
+        wall = time.monotonic() - t0
+        agg: dict = {}
+        for s in rsm.tracer._spans[n0:]:
+            a = agg.setdefault(s.name, [0, 0.0])
+            a[0] += 1
+            a[1] += s.duration_s
+        print(f"{label} wall={wall:.1f}s (serial estimate {serial_est:.1f}s)")
+        for name, (n, ts) in sorted(agg.items(), key=lambda kv: -kv[1][1]):
+            print(f"  {name:42s} n={n:3d} total={ts:7.1f}s")
+        md = RemoteLogSegmentMetadata(
+            RemoteLogSegmentId(tip, KafkaUuid(b"\x05" * 16)), 9, 10, total
+        )
+
+
+if __name__ == "__main__":
+    main()
