@@ -105,13 +105,13 @@ def use_pallas_ghash(rows: int, k: int) -> bool:
 def _ghash_l1_kernel(x_ref, w_ref, o_ref):
     """x_ref: VMEM uint8[R, K]; w_ref: VMEM int8[8, K, 128];
     o_ref: VMEM int8[R, 128]."""
-    x = x_ref[:]
+    # Widen to int32 BEFORE the bit math: Mosaic on the v5e toolchain can
+    # legalize neither i8 vector shifts (arith.shrui on vector<...xi8>) nor
+    # direct u8/i8->f32 casts — both failed on the real chip, round 5.
+    x = x_ref[:].astype(jnp.int32)
     acc = None
     for p in range(8):
-        # Two-step casts: Mosaic on the v5e toolchain rejects direct
-        # uint8->f32 and int8->f32 vector casts (seen on chip, round 5);
-        # int32 is the supported waypoint.
-        plane = ((x >> p) & 1).astype(jnp.int32).astype(jnp.float32)
+        plane = ((x >> p) & 1).astype(jnp.float32)
         w_p = w_ref[p].astype(jnp.int32).astype(jnp.float32)
         part = jnp.dot(plane, w_p, preferred_element_type=jnp.float32)
         acc = part if acc is None else acc + part

@@ -21,11 +21,14 @@ assert d[0].platform == 'tpu', d" >/dev/null 2>&1
 
 battery() {
     echo "[$(date -u +%FT%TZ)] relay up - running battery"
-    PROBE_MIB=8 timeout 5400 python tools/probe_min.py "$OUT/probe_min_8.json"
-    PROBE_MIB=32 PROBE_STAGES=pallas_aes,circuit_xla,ghash_xla,ghash_pallas,full_gcm \
-        timeout 5400 python tools/probe_min.py "$OUT/probe_min_32.json"
-    timeout 3600 python tools/profile_lz.py > "$OUT/profile_lz.txt" 2>&1
-    timeout 5400 python bench.py > "$OUT/bench.json" 2> "$OUT/bench.stderr"
+    # Kernel stages first (fast compiles since the round-5 fixes), then the
+    # composite, then the LZ kernel, then the headline bench.
+    PROBE_MIB=512 PROBE_STAGES=pallas_aes,ghash_pallas,ghash_xla,circuit_xla \
+        timeout 3600 python tools/probe_min.py "$OUT/probe_recovery_512.json"
+    PROBE_MIB=64 PROBE_STAGES=full_gcm \
+        timeout 5400 python tools/probe_min.py "$OUT/probe_recovery_fullgcm.json"
+    timeout 3600 python tools/profile_lz.py 64 4 > "$OUT/profile_lz.txt" 2>&1
+    timeout 7200 python bench.py > "$OUT/bench.json" 2> "$OUT/bench.stderr"
     echo "[$(date -u +%FT%TZ)] battery done (see $OUT/)"
 }
 
