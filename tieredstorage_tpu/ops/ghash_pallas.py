@@ -26,7 +26,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 #: Rows of the flattened [B*G, K] level-1 matrix per grid step. 256 rows x
-#: 2048 cols keeps the tile (512 KiB) + per-plane f32 operand (2 MiB) + the
+#: 2048 cols keeps the widened int32 tile (2 MiB — x is upcast before the
+#: bit math, see _ghash_l1_kernel) + per-plane f32 operand (2 MiB) + the
 #: f32 weight slice (1 MiB) well inside VMEM.
 ROWS_PER_STEP = 256
 
