@@ -138,6 +138,17 @@ def main() -> None:
             rkp = jax.block_until_ready(
                 jax.jit(rk_planes_from_round_keys)(jnp.asarray(rk))
             )
+            # Correctness first, then speed: a mistiled kernel can return
+            # instantly with garbage (seen once at TSTPU_AES_R=32) — a
+            # number without this check is not evidence.
+            got = np.asarray(aes_encrypt_planes_pallas(rkp, planes[:, :, :1024]))
+            ref = np.asarray(jax.jit(aes_encrypt_planes)(rkp, planes[:, :, :1024]))
+            if not np.array_equal(got, ref):
+                raise AssertionError(
+                    "pallas kernel output diverges from the XLA circuit "
+                    "on this platform/tile — refusing to time garbage"
+                )
+            say("pallas_aes: output cross-checked against the XLA circuit")
             timeit("pallas_aes", aes_encrypt_planes_pallas, rkp, planes,
                    bytes_measured=w * 512)
         except Exception as e:  # noqa: BLE001
