@@ -310,6 +310,12 @@ def run_bench() -> dict:
         pin_virtual_cpu(1)
     import jax
 
+    # If the Pallas preflight ever degrades this process to the XLA circuit
+    # (e.g. a relay blip during the gate probe), the scan-form cipher keeps
+    # that fallback's remote compile at minutes, not the 33 min/shape the
+    # unrolled graph costs (PROFILE.md round-5). Bit-exact either way.
+    os.environ.setdefault("TSTPU_AES_SCAN", "1")
+
     # Persistent compile cache: the full-GCM graph took 33 min to compile
     # through the axon remote-compile relay (artifacts_r5/probe_min.json);
     # with the cache the driver's round-end run loads it in seconds.

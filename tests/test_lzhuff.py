@@ -262,6 +262,20 @@ class TestMatchQualityPins:
             f"6-byte matches not found: {len(frame)}/{len(data)}"
         )
 
+    def test_long_runs_need_same_distance_merging(self):
+        """Kills transform/lzhuff.py:91 Add->Sub (the merge criterion's
+        `ends = mpos + mlen`): the device caps matches at MAX_MATCH, so a
+        400 KB zeros chunk is ~6k capped distance-1 matches that MUST merge
+        back into a handful of records (149 B correct vs 5663 B with
+        merging disabled — round-trip stays exact either way, so only the
+        ratio can pin it)."""
+        data = bytes(400_000)
+        frame = compress_batch([data])[0]
+        assert decompress_batch([frame])[0] == data
+        assert len(frame) < 1000, (
+            f"zeros chunk framed at {len(frame)} B — same-distance merging lost"
+        )
+
     def test_text_multiword_repeats_need_the_8gram_table(self):
         """Kills ops/lz.py:131 RShift->LShift (the 8-gram hash): on
         small-alphabet text every 4-gram collides constantly, so the

@@ -85,3 +85,15 @@ class TestIterChunks:
         from tieredstorage_tpu.storage.core import iter_chunks
 
         assert list(iter_chunks(io.BytesIO(b""), 4)) == []
+
+    def test_read_aligned_to_chunk_size_does_not_truncate(self):
+        """read_size == chunk_size leaves pending EMPTY mid-stream after
+        every slice; the continue-vs-return arm there must key on eof AND
+        emptiness — a round-5 mutation survivor (and->or at the post-yield
+        return) silently truncated exactly this alignment to one chunk."""
+        import io
+
+        from tieredstorage_tpu.storage.core import iter_chunks
+
+        chunks = list(iter_chunks(io.BytesIO(b"abcdefghijkl"), 4, read_size=4))
+        assert chunks == [b"abcd", b"efgh", b"ijkl"]

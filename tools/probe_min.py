@@ -138,19 +138,24 @@ def main() -> None:
             rkp = jax.block_until_ready(
                 jax.jit(rk_planes_from_round_keys)(jnp.asarray(rk))
             )
-            # Correctness first, then speed: a mistiled kernel can return
-            # instantly with garbage (seen once at TSTPU_AES_R=32) — a
-            # number without this check is not evidence.
-            got = np.asarray(aes_encrypt_planes_pallas(rkp, planes[:, :, :1024]))
-            ref = np.asarray(jax.jit(aes_encrypt_planes)(rkp, planes[:, :, :1024]))
-            if not np.array_equal(got, ref):
-                raise AssertionError(
-                    "pallas kernel output diverges from the XLA circuit "
-                    "on this platform/tile — refusing to time garbage"
-                )
-            say("pallas_aes: output cross-checked against the XLA circuit")
             timeit("pallas_aes", aes_encrypt_planes_pallas, rkp, planes,
                    bytes_measured=w * 512)
+            # Cross-check AFTER the timing persists (a relay drop during the
+            # reference compile must not cost the flagship number): one
+            # kernel tile vs the XLA circuit — a mistiled kernel can return
+            # instantly with garbage (seen once at TSTPU_AES_R=32), and a
+            # number that fails this check is not evidence.
+            tile = planes[:, :, :WORDS_PER_STEP]
+            got = np.asarray(aes_encrypt_planes_pallas(rkp, tile))
+            ref = np.asarray(jax.jit(aes_encrypt_planes)(rkp, tile))
+            if np.array_equal(got, ref):
+                say("pallas_aes: output cross-checked against the XLA circuit")
+                results["stages"]["pallas_aes"]["cross_check"] = "pass"
+            else:
+                say("pallas_aes: OUTPUT DIVERGES from the XLA circuit — "
+                    "the timing above is not evidence")
+                results["stages"]["pallas_aes"]["cross_check"] = "FAIL"
+            persist()
         except Exception as e:  # noqa: BLE001
             say(f"pallas_aes setup failed: {e!r}")
             results["stages"]["pallas_aes"] = {"error": repr(e)[:500]}
