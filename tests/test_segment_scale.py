@@ -66,6 +66,9 @@ def _build_segment(path: Path, total: int) -> None:
         (b"offset=%019d key=user-%06d value=" % (0, 0)) * 64, np.uint8
     )
     piece = 16 << 20
+    # One tile covering the largest piece; per-piece slices of it (re-tiling
+    # per 16 MiB piece costs ~64 redundant np.tile passes at 1 GiB).
+    tiled_full = np.tile(pattern, piece // (2 * len(pattern)) + 1)
     with path.open("wb") as f:
         header = struct.pack(">qiibih", 0, total - 12, 0, 2, 0, 0x00)
         f.write(header)
@@ -75,8 +78,7 @@ def _build_segment(path: Path, total: int) -> None:
             half = (n + 1) // 2
             buf = np.empty(n, np.uint8)
             buf[0::2] = rng.integers(0, 256, half, dtype=np.uint8)
-            tiled = np.tile(pattern, n // (2 * len(pattern)) + 1)[: n - half]
-            buf[1::2] = tiled
+            buf[1::2] = tiled_full[: n - half]
             f.write(buf.tobytes())
             remaining -= n
 
@@ -174,11 +176,19 @@ def test_one_gib_segment_streams_through_the_mesh(tmp_path):
     # encrypted copies). (2) Scaling: the warm copy must add almost
     # nothing — a per-copy materialization would add ~segment size again.
     window_bytes = rsm._transform_backend.preferred_batch_bytes
-    assert rss_peak_delta < 2 * total, (
-        f"peak RSS grew {rss_peak_delta / 2**20:.0f} MiB over two copies of "
-        f"a {total >> 20} MiB segment — materializing, not streaming"
-    )
-    assert rss_warm_delta < total // 4, (
+    if total >= 1 << 30:
+        # Only meaningful when the segment dwarfs the XLA-CPU runtime
+        # arena (~1.2 GiB baseline): at the 1 GiB default the measured
+        # delta is ~1.6 GiB vs the ~3 GiB a materializing design needs,
+        # while at 512 MiB the arena alone would breach 2x total.
+        assert rss_peak_delta < 2 * total, (
+            f"peak RSS grew {rss_peak_delta / 2**20:.0f} MiB over two copies "
+            f"of a {total >> 20} MiB segment — materializing, not streaming"
+        )
+    # Allowance floor: the XLA-CPU arena jitters ~100 MiB run-to-run at
+    # small scales regardless of streaming (measured 90 MiB at 64 MiB,
+    # 42 MiB at 1 GiB); the invariant has full power at the 1 GiB default.
+    assert rss_warm_delta < max(total // 4, 192 << 20), (
         f"second copy added {rss_warm_delta / 2**20:.0f} MiB of peak RSS — "
         "per-copy buffers are accumulating instead of streaming"
     )
