@@ -1,13 +1,19 @@
 # Developer entry points (counterpart of /root/reference/Makefile).
 PYTHON ?= python
 
-.PHONY: test test-e2e bench demo docs docker lint mutation clean
+.PHONY: test test-e2e chaos bench demo docs docker lint mutation clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q --ignore=tests/e2e
 
 test-e2e:
 	$(PYTHON) -m pytest tests/e2e -q
+
+# Fault-injection / resilience suite, including the slow soak variants.
+# Schedules are seeded (fault.seed / FaultSchedule(seed=...)), so runs are
+# deterministic and reproducible.
+chaos:
+	$(PYTHON) -m pytest tests/ -q -m chaos
 
 bench:
 	$(PYTHON) bench.py

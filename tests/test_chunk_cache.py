@@ -224,6 +224,57 @@ class TestMemoryChunkCache:
         with pytest.raises(ChunkCacheTimeoutException):
             cache.get_chunk(KEY, make_manifest(), 0)
 
+    def test_wedged_single_flight_population_falls_back_to_direct_fetch(self):
+        # get.timeout bounds waiting on ANOTHER reader's in-flight load; when
+        # that load is wedged, this reader must not fail — it bypasses the
+        # cache and fetches directly (and counts the degradation).
+        delegate = CountingChunkManager()
+        cache = MemoryChunkCache(delegate)
+        cache.configure({"size": -1, "get.timeout.ms": 100})
+        release = threading.Event()
+
+        def wedged_loader():
+            release.wait(5)
+            return b"W" * CHUNK
+
+        cache._cache.get_future(ChunkKey.of(KEY, 0), wedged_loader)
+        try:
+            out = cache.get_chunk(KEY, make_manifest(), 0).read()
+            assert out == bytes([0]) * CHUNK
+            assert cache.degradations == 1
+            assert delegate.calls == [[0]]  # the direct-fetch fallback
+        finally:
+            release.set()
+
+    def test_failed_prefetch_is_isolated_and_does_not_poison_cache(self):
+        class FlakyOnceChunkManager(CountingChunkManager):
+            """Fails the first batch that includes a chunk id > 0 (i.e. the
+            prefetch window), then behaves normally."""
+
+            def __init__(self):
+                super().__init__()
+                self.failed_once = False
+
+            def get_chunks(self, objects_key, manifest, chunk_ids):
+                if not self.failed_once and any(cid > 0 for cid in chunk_ids):
+                    self.failed_once = True
+                    raise RuntimeError("injected prefetch failure")
+                return super().get_chunks(objects_key, manifest, chunk_ids)
+
+        delegate = FlakyOnceChunkManager()
+        cache = MemoryChunkCache(delegate)
+        cache.configure({"size": -1, "prefetch.max.size": CHUNK * 2})
+        manifest = make_manifest(n_chunks=3)
+        assert cache.get_chunk(KEY, manifest, 0).read() == bytes([0]) * CHUNK
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and cache.prefetch_failures == 0:
+            time.sleep(0.01)
+        assert cache.prefetch_failures == 1  # counted, never propagated
+        # The failed prefetch left no poisoned entries: a foreground get of
+        # the same chunks loads them fresh and serves correct bytes.
+        assert cache.get_chunk(KEY, manifest, 1).read() == bytes([1]) * CHUNK
+        assert cache.get_chunk(KEY, manifest, 2).read() == bytes([2]) * CHUNK
+
 
 class TestDiskChunkCache:
     def test_cache_files_lifecycle(self, tmp_path):

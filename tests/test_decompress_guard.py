@@ -6,7 +6,9 @@ claiming a huge content size must not force an n_chunks * stride allocation).
 from __future__ import annotations
 
 import pytest
-import zstandard
+
+zstandard = pytest.importorskip(
+    "zstandard", reason="optional dependency for the zstd codec")
 
 from tieredstorage_tpu.native import (
     MAX_FRAME_CONTENT_SIZE,

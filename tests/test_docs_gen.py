@@ -28,6 +28,10 @@ def test_configs_rst_covers_all_config_classes():
         "``azure.upload.block.size``",
         "``prefetch.max.size``",
         "``proxy.host``",
+        "``fault.schedule``",
+        "``fault.injection.enabled``",
+        "``breaker.failure.threshold``",
+        "``breaker.cooldown.ms``",
     ):
         assert key in rst
     # Required keys render as required, defaulted ones with their default.
@@ -47,6 +51,7 @@ def test_metrics_rst_covers_all_groups():
         "remote-storage-manager-metrics",
         "cache-metrics",
         "thread-pool-metrics",
+        "resilience-metrics",
         "s3-client-metrics",
         "gcs-client-metrics",
         "azure-blob-client-metrics",
@@ -55,7 +60,11 @@ def test_metrics_rst_covers_all_groups():
     for name in (
         "segment-copy-time-avg",
         "object-upload-bytes-total",
+        "upload-rollbacks-total",
         "cache-hits-total",
+        "breaker-state",
+        "chunk-cache-degradations-total",
+        "quarantined-keys",
         "get-object-requests-total",
         "object-download-requests-total",
         "blob-upload-requests-total",

@@ -1,0 +1,622 @@
+"""Fault-injection and resilience suite (`make chaos`).
+
+Layers under test, bottom-up:
+- FaultSchedule / FaultInjectingBackend determinism (seeded triggers);
+- the storage contract (tests/storage_contract.py) holding verbatim under
+  benign latency injection for memory, filesystem, and the S3/GCS/Azure
+  emulators — the wrapper must be transparent;
+- CircuitBreaker state machine + ResilientStorageBackend classification;
+- detransform-corruption quarantine in DefaultChunkManager;
+- RSM end-to-end: upload rollback leaves zero orphans (manifest fails ⇒
+  log/index objects cleaned up), idempotent multi-delete, breaker fast-fail,
+  disk-cache degradation to cache-bypass;
+- a seeded probabilistic soak (marked slow, excluded from tier-1).
+
+Schedules are seeded, so every test here is deterministic and reproducible.
+"""
+
+from __future__ import annotations
+
+import io
+import shutil
+import time
+
+import pytest
+
+from tests.storage_contract import StorageContract
+from tests.test_chunk_cache import CHUNK, KEY, N_CHUNKS, make_manifest
+from tests.test_rsm_lifecycle import (
+    CHUNK_SIZE,
+    SEGMENT_SIZE,
+    TOPIC_ID,
+    make_rsm,
+    make_segment_data,
+    make_segment_metadata,
+)
+from tieredstorage_tpu.errors import RemoteStorageException
+from tieredstorage_tpu.faults import (
+    FaultInjectedException,
+    FaultInjectingBackend,
+    FaultRule,
+    FaultSchedule,
+)
+from tieredstorage_tpu.fetch.chunk_manager import (
+    CorruptChunkException,
+    DefaultChunkManager,
+)
+from tieredstorage_tpu.metadata import (
+    KafkaUuid,
+    RemoteLogSegmentId,
+    RemoteLogSegmentMetadata,
+    TopicIdPartition,
+    TopicPartition,
+)
+from tieredstorage_tpu.rsm import RemoteStorageManager
+from tieredstorage_tpu.storage.core import KeyNotFoundException, ObjectKey
+from tieredstorage_tpu.storage.memory import InMemoryStorage
+from tieredstorage_tpu.storage.resilient import (
+    BreakerState,
+    CircuitBreaker,
+    CircuitOpenException,
+    ResilientStorageBackend,
+)
+from tieredstorage_tpu.transform.api import (
+    AuthenticationError,
+    DetransformOptions,
+    TransformBackend,
+    TransformOptions,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+def unwrap(storage):
+    """Peel FaultInjecting/Resilient decorators down to the real backend."""
+    while hasattr(storage, "delegate"):
+        storage = storage.delegate
+    return storage
+
+
+def make_memory_rsm(extra: dict | None = None) -> RemoteStorageManager:
+    configs = {
+        "storage.backend.class": "tieredstorage_tpu.storage.memory.InMemoryStorage",
+        "chunk.size": CHUNK_SIZE,
+        "key.prefix": "test/",
+    }
+    configs.update(extra or {})
+    rsm = RemoteStorageManager()
+    rsm.configure(configs)
+    return rsm
+
+
+# ------------------------------------------------------------- FaultSchedule
+class TestFaultSchedule:
+    def test_parse_grammar(self):
+        schedule = FaultSchedule.parse(
+            "upload:raise@3; fetch:corrupt=7@1, *:delay=5@every=2, fetch:truncate@p=0.5"
+        )
+        rules = schedule.rules
+        assert rules[0] == FaultRule("upload", "raise", nth=3)
+        assert rules[1] == FaultRule("fetch", "corrupt", arg=7, nth=1)
+        assert rules[2] == FaultRule("*", "delay", arg=5, every=2)
+        assert rules[3] == FaultRule("fetch", "truncate", probability=0.5)
+
+    @pytest.mark.parametrize("bad", [
+        "upload",                 # no action
+        "upload:explode",         # unknown action
+        "chmod:raise",            # unknown op
+        "upload:raise@whenever",  # unknown trigger
+        "upload:corrupt@1",       # data action on non-fetch op
+        "fetch:raise@p=1.5",      # probability out of range
+        "fetch:raise@every=0",    # zero period
+    ])
+    def test_parse_rejects(self, bad):
+        with pytest.raises(ValueError):
+            FaultSchedule.parse(bad)
+
+    def test_nth_trigger_fires_exactly_once(self):
+        schedule = FaultSchedule.parse("upload:raise@3")
+        fired = [bool(schedule.fired_rules("upload", "k")) for _ in range(6)]
+        assert fired == [False, False, True, False, False, False]
+        assert schedule.injections == [("upload", "raise", "k")]
+
+    def test_every_trigger_and_per_op_counters(self):
+        schedule = FaultSchedule.parse("fetch:raise@every=2")
+        # Upload calls must not advance the fetch counter.
+        assert not schedule.fired_rules("upload", "k")
+        fired = [bool(schedule.fired_rules("fetch", "k")) for _ in range(6)]
+        assert fired == [False, True, False, True, False, True]
+
+    def test_probability_is_deterministic_for_seed(self):
+        patterns = []
+        for _ in range(2):
+            schedule = FaultSchedule.parse("fetch:raise@p=0.5", seed=42)
+            patterns.append(
+                [bool(schedule.fired_rules("fetch", "k")) for _ in range(32)]
+            )
+        assert patterns[0] == patterns[1]
+        assert any(patterns[0]) and not all(patterns[0])
+        other = FaultSchedule.parse("fetch:raise@p=0.5", seed=43)
+        assert [bool(other.fired_rules("fetch", "k")) for _ in range(32)] != patterns[0]
+
+
+# ----------------------------------------------------- FaultInjectingBackend
+class TestFaultInjectingBackend:
+    def _backend(self, spec: str, seed: int = 0) -> FaultInjectingBackend:
+        inner = InMemoryStorage()
+        inner.configure({})
+        return FaultInjectingBackend(inner, FaultSchedule.parse(spec, seed=seed))
+
+    def test_raise_on_nth_upload_then_recovers(self):
+        b = self._backend("upload:raise@2")
+        key = ObjectKey("a/b")
+        assert b.upload(io.BytesIO(b"one"), key) == 3
+        with pytest.raises(FaultInjectedException):
+            b.upload(io.BytesIO(b"two"), key)
+        assert b.upload(io.BytesIO(b"three"), key) == 5
+        with b.fetch(key) as s:
+            assert s.read() == b"three"
+
+    def test_key_not_found_injection(self):
+        b = self._backend("fetch:key-not-found@1")
+        key = ObjectKey("a/b")
+        b.upload(io.BytesIO(b"data"), key)
+        with pytest.raises(KeyNotFoundException):
+            b.fetch(key)
+        with b.fetch(key) as s:  # schedule exhausted
+            assert s.read() == b"data"
+
+    def test_corrupt_flips_one_byte(self):
+        b = self._backend("fetch:corrupt=2@1")
+        key = ObjectKey("a/b")
+        b.upload(io.BytesIO(b"abcdef"), key)
+        with b.fetch(key) as s:
+            corrupted = s.read()
+        assert corrupted == b"ab" + bytes([ord("c") ^ 0xFF]) + b"def"
+        with b.fetch(key) as s:
+            assert s.read() == b"abcdef"
+
+    def test_truncate_keeps_prefix(self):
+        b = self._backend("fetch:truncate=4@1")
+        key = ObjectKey("a/b")
+        b.upload(io.BytesIO(b"abcdefgh"), key)
+        with b.fetch(key) as s:
+            assert s.read() == b"abcd"
+
+    def test_delete_faults_apply_per_key_in_delete_all(self):
+        b = self._backend("delete:raise@2")
+        keys = [ObjectKey(f"k/{i}") for i in range(3)]
+        for k in keys:
+            b.upload(io.BytesIO(b"v"), k)
+        with pytest.raises(FaultInjectedException):
+            b.delete_all(keys)
+        # First key was deleted before the second's injected failure.
+        assert unwrap(b).keys() == ["k/1", "k/2"]
+
+    def test_configure_as_storage_backend_class(self, tmp_storage_root):
+        b = FaultInjectingBackend()
+        b.configure({
+            "fault.delegate.class":
+                "tieredstorage_tpu.storage.filesystem.FileSystemStorage",
+            "fault.schedule": "upload:raise@1",
+            "root": str(tmp_storage_root),
+            "overwrite.enabled": True,
+        })
+        with pytest.raises(FaultInjectedException):
+            b.upload(io.BytesIO(b"x"), ObjectKey("a/b"))
+        assert b.upload(io.BytesIO(b"x"), ObjectKey("a/b")) == 1
+
+
+# ------------------------------------- storage contract under benign faults
+# A latency-only schedule proves the wrapper transparent: the full backend
+# contract must hold unchanged while every call goes through the injector.
+LATENCY_ONLY = "*:delay=1@every=3"
+
+
+class TestInMemoryContractUnderFaults(StorageContract):
+    @pytest.fixture
+    def backend(self):
+        inner = InMemoryStorage()
+        inner.configure({})
+        return FaultInjectingBackend(inner, FaultSchedule.parse(LATENCY_ONLY, seed=7))
+
+
+class TestFileSystemContractUnderFaults(StorageContract):
+    @pytest.fixture
+    def backend(self, tmp_storage_root):
+        from tieredstorage_tpu.storage.filesystem import FileSystemStorage
+
+        inner = FileSystemStorage()
+        inner.configure({"root": str(tmp_storage_root), "overwrite.enabled": True})
+        return FaultInjectingBackend(inner, FaultSchedule.parse(LATENCY_ONLY, seed=7))
+
+
+@pytest.fixture(scope="module")
+def s3_emulator():
+    from tests.emulators.s3_emulator import S3Emulator
+
+    emu = S3Emulator().start()
+    yield emu
+    emu.stop()
+
+
+class TestS3ContractUnderFaults(StorageContract):
+    @pytest.fixture
+    def backend(self, s3_emulator):
+        from tests.test_storage_s3 import make_backend
+
+        with s3_emulator.state.lock:
+            s3_emulator.state.objects.clear()
+        return FaultInjectingBackend(
+            make_backend(s3_emulator), FaultSchedule.parse(LATENCY_ONLY, seed=7)
+        )
+
+
+@pytest.fixture(scope="module")
+def gcs_emulator():
+    from tests.emulators.gcs_emulator import GcsEmulator
+
+    emu = GcsEmulator().start()
+    yield emu
+    emu.stop()
+
+
+class TestGcsContractUnderFaults(StorageContract):
+    @pytest.fixture
+    def backend(self, gcs_emulator):
+        from tests.test_storage_gcs import make_backend
+
+        with gcs_emulator.state.lock:
+            gcs_emulator.state.objects.clear()
+        return FaultInjectingBackend(
+            make_backend(gcs_emulator), FaultSchedule.parse(LATENCY_ONLY, seed=7)
+        )
+
+
+@pytest.fixture(scope="module")
+def azure_emulator():
+    from tests.emulators.azure_emulator import AzureEmulator
+    from tests.test_storage_azure import ACCOUNT, ACCOUNT_KEY
+
+    emu = AzureEmulator(account=ACCOUNT, account_key=ACCOUNT_KEY).start()
+    yield emu
+    emu.stop()
+
+
+class TestAzureContractUnderFaults(StorageContract):
+    @pytest.fixture
+    def backend(self, azure_emulator):
+        from tests.test_storage_azure import make_backend
+
+        with azure_emulator.state.lock:
+            azure_emulator.state.blobs.clear()
+        return FaultInjectingBackend(
+            make_backend(azure_emulator), FaultSchedule.parse(LATENCY_ONLY, seed=7)
+        )
+
+
+# ------------------------------------------------------------ CircuitBreaker
+class TestCircuitBreaker:
+    def _breaker(self, threshold=3, cooldown=10.0):
+        clock = [0.0]
+        transitions: list[tuple[BreakerState, BreakerState]] = []
+        breaker = CircuitBreaker(
+            threshold, cooldown,
+            time_source=lambda: clock[0],
+            on_transition=lambda old, new: transitions.append((old, new)),
+        )
+        return breaker, clock, transitions
+
+    def test_opens_after_consecutive_failures(self):
+        breaker, _, transitions = self._breaker(threshold=3)
+        for _ in range(2):
+            breaker.acquire()
+            breaker.on_failure()
+        assert breaker.state is BreakerState.CLOSED
+        breaker.acquire()
+        breaker.on_failure()
+        assert breaker.state is BreakerState.OPEN
+        assert breaker.opens == 1
+        with pytest.raises(CircuitOpenException):
+            breaker.acquire()
+        assert breaker.fast_fails == 1
+        assert (BreakerState.CLOSED, BreakerState.OPEN) in transitions
+
+    def test_success_resets_consecutive_count(self):
+        breaker, _, _ = self._breaker(threshold=2)
+        breaker.acquire(); breaker.on_failure()
+        breaker.acquire(); breaker.on_success()
+        breaker.acquire(); breaker.on_failure()
+        assert breaker.state is BreakerState.CLOSED
+
+    def test_half_open_probe_success_closes(self):
+        breaker, clock, transitions = self._breaker(threshold=1, cooldown=10.0)
+        breaker.acquire(); breaker.on_failure()
+        assert breaker.state is BreakerState.OPEN
+        clock[0] = 10.0
+        breaker.acquire()  # the probe is allowed through
+        # A second caller during the probe fails fast.
+        with pytest.raises(CircuitOpenException):
+            breaker.acquire()
+        breaker.on_success()
+        assert breaker.state is BreakerState.CLOSED
+        breaker.acquire()  # closed again, no exception
+        assert (BreakerState.OPEN, BreakerState.HALF_OPEN) in transitions
+        assert (BreakerState.HALF_OPEN, BreakerState.CLOSED) in transitions
+
+    def test_half_open_probe_failure_reopens(self):
+        breaker, clock, _ = self._breaker(threshold=1, cooldown=10.0)
+        breaker.acquire(); breaker.on_failure()
+        clock[0] = 10.0
+        breaker.acquire()
+        breaker.on_failure()
+        assert breaker.state is BreakerState.OPEN
+        assert breaker.opens == 2
+        # The cooldown restarts from the failed probe.
+        clock[0] = 15.0
+        with pytest.raises(CircuitOpenException):
+            breaker.acquire()
+        clock[0] = 20.0
+        breaker.acquire()
+        breaker.on_success()
+        assert breaker.state is BreakerState.CLOSED
+
+
+class TestResilientStorageBackend:
+    def test_fast_fails_stop_reaching_backend(self):
+        schedule = FaultSchedule.parse("upload:raise")
+        inner = InMemoryStorage()
+        inner.configure({})
+        faulty = FaultInjectingBackend(inner, schedule)
+        backend = ResilientStorageBackend(faulty, CircuitBreaker(2, 60.0))
+        for _ in range(2):
+            with pytest.raises(FaultInjectedException):
+                backend.upload(io.BytesIO(b"x"), ObjectKey("a/b"))
+        with pytest.raises(CircuitOpenException):
+            backend.upload(io.BytesIO(b"x"), ObjectKey("a/b"))
+        assert schedule.calls("upload") == 2  # third call never reached storage
+        assert backend.breaker.fast_fails == 1
+
+    def test_key_not_found_does_not_trip_breaker(self):
+        inner = InMemoryStorage()
+        inner.configure({})
+        backend = ResilientStorageBackend(inner, CircuitBreaker(1, 60.0))
+        for _ in range(3):
+            with pytest.raises(KeyNotFoundException):
+                backend.fetch(ObjectKey("no/such"))
+        assert backend.breaker.state is BreakerState.CLOSED
+        backend.upload(io.BytesIO(b"x"), ObjectKey("a/b"))
+        with backend.fetch(ObjectKey("a/b")) as s:
+            assert s.read() == b"x"
+
+
+# ------------------------------------------------- detransform quarantine
+class ParityTransformBackend(TransformBackend):
+    """Identity transform whose detransform validates that every chunk is a
+    constant fill — the test stand-in for GCM tag / CRC verification."""
+
+    def transform(self, chunks, opts: TransformOptions):
+        return list(chunks)
+
+    def detransform(self, chunks, opts: DetransformOptions):
+        for chunk in chunks:
+            if chunk and any(b != chunk[0] for b in chunk):
+                raise AuthenticationError("chunk bytes fail integrity check")
+        return list(chunks)
+
+
+class TestDetransformQuarantine:
+    def _manager(self, spec: str, **kwargs):
+        inner = InMemoryStorage()
+        inner.configure({})
+        inner.upload(
+            io.BytesIO(b"".join(bytes([i]) * CHUNK for i in range(N_CHUNKS))), KEY
+        )
+        schedule = FaultSchedule.parse(spec)
+        fetcher = FaultInjectingBackend(inner, schedule)
+        return DefaultChunkManager(fetcher, ParityTransformBackend(), **kwargs), schedule
+
+    def test_corrupt_chunk_quarantines_key(self):
+        manager, schedule = self._manager("fetch:corrupt=3@1")
+        manifest = make_manifest()
+        with pytest.raises(CorruptChunkException):
+            manager.get_chunks(KEY, manifest, [0, 1])
+        assert manager.corruptions == 1
+        assert manager.quarantined_keys == 1
+        assert schedule.calls("fetch") == 1
+        # Retry storms fail fast without touching storage again.
+        with pytest.raises(CorruptChunkException):
+            manager.get_chunks(KEY, manifest, [0, 1])
+        assert schedule.calls("fetch") == 1
+
+    def test_quarantine_expires_and_clean_data_recovers(self):
+        manager, schedule = self._manager("fetch:corrupt@1", quarantine_ttl_s=0.05)
+        manifest = make_manifest()
+        with pytest.raises(CorruptChunkException):
+            manager.get_chunks(KEY, manifest, [2])
+        time.sleep(0.06)
+        # The @1 rule is exhausted: the re-fetch after expiry sees clean bytes.
+        out = manager.get_chunks(KEY, manifest, [2])
+        assert out == [bytes([2]) * CHUNK]
+        assert manager.quarantined_keys == 0
+        assert schedule.calls("fetch") == 2
+
+    def test_other_keys_unaffected(self):
+        manager, _ = self._manager("fetch:corrupt@1")
+        manifest = make_manifest()
+        with pytest.raises(CorruptChunkException):
+            manager.get_chunks(KEY, manifest, [0])
+        other = ObjectKey("pre/other-topic/1/00000000000000000099-uuid.log")
+        inner = unwrap(manager._fetcher)
+        inner.upload(
+            io.BytesIO(b"".join(bytes([i]) * CHUNK for i in range(N_CHUNKS))), other
+        )
+        assert manager.get_chunks(other, manifest, [1]) == [bytes([1]) * CHUNK]
+
+
+# ----------------------------------------------------------- RSM end-to-end
+class TestRsmUploadRollback:
+    # Upload order is .log (1), .indexes (2), .rsm-manifest (3).
+    @pytest.mark.parametrize("failing_call", [1, 2, 3])
+    def test_failed_upload_leaves_zero_objects(self, tmp_path, failing_call):
+        rsm, storage_root = make_rsm(
+            tmp_path, compression=False, encryption=False,
+            extra_configs={
+                "fault.injection.enabled": True,
+                "fault.schedule": f"upload:raise@{failing_call}",
+            },
+        )
+        metadata = make_segment_metadata()
+        data = make_segment_data(tmp_path, with_txn=True)
+        with pytest.raises(RemoteStorageException):
+            rsm.copy_log_segment_data(metadata, data)
+        assert [p for p in storage_root.rglob("*") if p.is_file()] == []
+        [rollback_metric] = rsm.metrics.registry.find("upload-rollbacks-total", {})
+        assert rsm.metrics.registry.value(rollback_metric) == 1.0
+
+    def test_broker_retry_succeeds_after_fault(self, tmp_path):
+        rsm, storage_root = make_rsm(
+            tmp_path, compression=False, encryption=False,
+            extra_configs={
+                "fault.injection.enabled": True,
+                "fault.schedule": "upload:raise@3",
+            },
+        )
+        metadata = make_segment_metadata()
+        data = make_segment_data(tmp_path, with_txn=True)
+        with pytest.raises(RemoteStorageException):
+            rsm.copy_log_segment_data(metadata, data)
+        rsm.copy_log_segment_data(metadata, data)  # the broker's retry
+        assert len([p for p in storage_root.rglob("*") if p.is_file()]) == 3
+        with rsm.fetch_log_segment(metadata, 0) as s:
+            assert s.read() == data.log_segment.read_bytes()
+
+
+class TestRsmIdempotentDelete:
+    def _copied_rsm(self, tmp_path, schedule: str):
+        rsm = make_memory_rsm({
+            "fault.injection.enabled": True,
+            "fault.schedule": schedule,
+        })
+        metadata = make_segment_metadata()
+        data = make_segment_data(tmp_path, with_txn=True)
+        rsm.copy_log_segment_data(metadata, data)
+        return rsm, metadata, unwrap(rsm._storage)
+
+    def test_key_not_found_is_swallowed_and_sweep_finishes(self, tmp_path):
+        rsm, metadata, inner = self._copied_rsm(tmp_path, "delete:key-not-found@1")
+        assert len(inner.keys()) == 3
+        rsm.delete_log_segment_data(metadata)  # must not raise
+        assert inner.keys() == []
+
+    def test_other_failures_aggregate_but_sweep_continues(self, tmp_path):
+        # Bulk pass: call 1 deletes .log, call 2 fails. Per-key sweep:
+        # call 3 (.log, already gone), call 4 (.indexes) fails again,
+        # call 5 (.rsm-manifest) succeeds — one aggregated exception, and
+        # everything deletable got deleted.
+        rsm, metadata, inner = self._copied_rsm(tmp_path, "delete:raise@2; delete:raise@4")
+        with pytest.raises(RemoteStorageException) as excinfo:
+            rsm.delete_log_segment_data(metadata)
+        assert "1/3" in str(excinfo.value)
+        remaining = inner.keys()
+        assert len(remaining) == 1 and remaining[0].endswith(".indexes")
+        [errors_metric] = rsm.metrics.registry.find("segment-delete-errors-total", {})
+        assert rsm.metrics.registry.value(errors_metric) == 1.0
+        # The retried delete converges: the remaining key goes, missing ones
+        # are swallowed.
+        rsm.delete_log_segment_data(metadata)
+        assert inner.keys() == []
+
+
+class TestRsmBreaker:
+    def test_open_breaker_fails_fast_without_storage_calls(self):
+        rsm = make_memory_rsm({
+            "breaker.enabled": True,
+            "breaker.failure.threshold": 2,
+            "breaker.cooldown.ms": 60_000,
+            "fault.injection.enabled": True,
+            "fault.schedule": "fetch:raise",
+        })
+        metadata = make_segment_metadata()
+        for _ in range(2):
+            with pytest.raises(RemoteStorageException):
+                rsm.fetch_log_segment(metadata, 0)
+        assert rsm._fault_schedule.calls("fetch") == 2
+        with pytest.raises(RemoteStorageException):
+            rsm.fetch_log_segment(metadata, 0)
+        assert rsm._fault_schedule.calls("fetch") == 2  # fast-failed
+        snapshot = rsm.metrics.snapshot()
+        assert snapshot["resilience-metrics:breaker-state"] == 2.0
+        assert snapshot["resilience-metrics:breaker-fast-fails-total"] >= 1.0
+        assert snapshot["resilience-metrics:fault-injections-total"] == 2.0
+
+
+class TestRsmDiskCacheDegradation:
+    def test_broken_cache_directory_degrades_to_bypass(self, tmp_path):
+        cache_dir = tmp_path / "chunk-cache"
+        cache_dir.mkdir()
+        rsm, _ = make_rsm(
+            tmp_path, compression=False, encryption=False,
+            extra_configs={
+                "fetch.chunk.cache.class":
+                    "tieredstorage_tpu.fetch.cache.disk.DiskChunkCache",
+                "fetch.chunk.cache.size": -1,
+                "fetch.chunk.cache.path": str(cache_dir),
+            },
+        )
+        metadata = make_segment_metadata()
+        data = make_segment_data(tmp_path, with_txn=True)
+        original = data.log_segment.read_bytes()
+        rsm.copy_log_segment_data(metadata, data)
+        with rsm.fetch_log_segment(metadata, 0) as s:
+            assert s.read() == original  # healthy cache pass
+        # Break the cache storage out from under the running manager.
+        shutil.rmtree(cache_dir / "cache")
+        shutil.rmtree(cache_dir / "temp")
+        for _ in range(2):
+            with rsm.fetch_log_segment(metadata, 0) as s:
+                assert s.read() == original  # correct bytes via cache-bypass
+        assert rsm._chunk_manager.degradations >= 1
+        snapshot = rsm.metrics.snapshot()
+        assert snapshot["resilience-metrics:chunk-cache-degradations-total"] >= 1.0
+        rsm.close()
+
+
+# ------------------------------------------------------------------- soak
+@pytest.mark.slow
+class TestSoak:
+    def test_probabilistic_upload_faults_never_leave_orphans(self, tmp_path):
+        rsm = make_memory_rsm({
+            "fault.injection.enabled": True,
+            "fault.seed": 1234,
+            "fault.schedule": "upload:raise@p=0.15",
+            "breaker.enabled": True,
+            "breaker.failure.threshold": 50,
+            "breaker.cooldown.ms": 1,
+        })
+        inner = unwrap(rsm._storage)
+        data = make_segment_data(tmp_path, with_txn=True)
+        original = data.log_segment.read_bytes()
+        failures = 0
+        for i in range(40):
+            tip = TopicIdPartition(TOPIC_ID, TopicPartition("topic", 7))
+            metadata = RemoteLogSegmentMetadata(
+                remote_log_segment_id=RemoteLogSegmentId(
+                    tip, KafkaUuid(b"\x03" * 15 + bytes([i]))
+                ),
+                start_offset=23,
+                end_offset=2000,
+                segment_size_in_bytes=SEGMENT_SIZE,
+            )
+            before = set(inner.keys())
+            try:
+                rsm.copy_log_segment_data(metadata, data)
+            except RemoteStorageException:
+                failures += 1
+                assert set(inner.keys()) == before  # rollback left no orphans
+            else:
+                with rsm.fetch_log_segment(metadata, 0) as s:
+                    assert s.read() == original
+        # The seeded schedule fired at least once and didn't fail everything.
+        assert 0 < failures < 40
+        assert len(rsm._fault_schedule.injections) == failures

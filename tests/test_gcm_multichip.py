@@ -17,7 +17,11 @@ import jax.numpy as jnp  # noqa: E402
 from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
 
 from tieredstorage_tpu.ops import gcm  # noqa: E402
-from tieredstorage_tpu.parallel.mesh import DATA_AXIS, data_mesh  # noqa: E402
+from tieredstorage_tpu.parallel.mesh import (  # noqa: E402
+    DATA_AXIS,
+    data_mesh,
+    shard_map_compat,
+)
 from tieredstorage_tpu.security.aes import IV_SIZE, TAG_SIZE  # noqa: E402
 
 
@@ -54,7 +58,7 @@ def test_sharded_varlen_encrypt_matches_single_device():
     row = P(DATA_AXIS)
     row2 = P(DATA_AXIS, None)
     step = jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             shard_step,
             mesh=mesh,
             in_specs=(row2, row2, row, row2),

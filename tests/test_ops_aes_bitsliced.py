@@ -10,6 +10,8 @@ from __future__ import annotations
 import numpy as np
 import jax.numpy as jnp
 import pytest
+
+pytest.importorskip("cryptography", reason="oracle for the AES kernels")
 from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
 
 from tieredstorage_tpu.ops.aes import SBOX, key_expansion

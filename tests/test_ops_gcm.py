@@ -8,6 +8,8 @@ import secrets
 
 import numpy as np
 import pytest
+
+pytest.importorskip("cryptography", reason="oracle for the GCM kernels")
 from cryptography.hazmat.primitives.ciphers.aead import AESGCM
 
 from tieredstorage_tpu.ops import gf128

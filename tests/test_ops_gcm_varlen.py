@@ -5,6 +5,9 @@ from __future__ import annotations
 import secrets
 
 import numpy as np
+import pytest
+
+pytest.importorskip("cryptography", reason="oracle for the GCM kernels")
 from cryptography.hazmat.primitives.ciphers.aead import AESGCM
 
 from tieredstorage_tpu.ops.gcm import (

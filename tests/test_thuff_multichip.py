@@ -13,7 +13,11 @@ import jax.numpy as jnp  # noqa: E402
 from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
 
 from tieredstorage_tpu.ops.huffman import encode_batch  # noqa: E402
-from tieredstorage_tpu.parallel.mesh import DATA_AXIS, data_mesh  # noqa: E402
+from tieredstorage_tpu.parallel.mesh import (  # noqa: E402
+    DATA_AXIS,
+    data_mesh,
+    shard_map_compat,
+)
 from tieredstorage_tpu.transform.thuff import (  # noqa: E402
     assemble_frame,
     compress_batch,
@@ -55,7 +59,7 @@ def _mesh_encode(mesh, data, n_sym, codes_rev, lengths, *, n_max, gather_sizes):
     row, row2 = P(DATA_AXIS), P(DATA_AXIS, None)
     out_specs = (row2, row, row2) + ((P(None),) if gather_sizes else ())
     step = jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             shard_step,
             mesh=mesh,
             in_specs=(row2, row, row2, row2),

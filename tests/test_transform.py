@@ -12,7 +12,9 @@ import io
 import random
 
 import pytest
-import zstandard
+
+zstandard = pytest.importorskip(
+    "zstandard", reason="optional dependency for the zstd codec")
 
 from tieredstorage_tpu.manifest.chunk_index import FixedSizeChunkIndex, VariableSizeChunkIndex
 from tieredstorage_tpu.security.aes import AesEncryptionProvider, DataKeyAndAAD, IV_SIZE, TAG_SIZE

@@ -22,6 +22,7 @@ from tieredstorage_tpu.config.configdef import (
     in_range,
     non_empty_string,
     null_or,
+    parseable_by,
     subset_with_prefix,
 )
 
@@ -55,6 +56,17 @@ def _codec_id(name: str, value) -> None:
 
 
 _codec_id.description = "[zstd, tpu-huff-v1, tpu-lzhuff-v1]"
+
+
+def _parse_fault_rules(value) -> None:
+    from tieredstorage_tpu.faults.schedule import FaultSchedule
+
+    FaultSchedule.parse(value)
+
+
+_valid_fault_schedule = parseable_by(
+    _parse_fault_rules, "fault rules 'op:action[=arg][@trigger]'"
+)
 
 
 def _base_def() -> ConfigDef:
@@ -135,6 +147,44 @@ def _base_def() -> ConfigDef:
         "custom.metadata.fields.include", "list", default=[], importance="low",
         doc="Custom metadata fields to persist with the broker "
             "(REMOTE_SIZE, OBJECT_PREFIX, OBJECT_KEY).",
+    ))
+    d.define(ConfigKey(
+        "fault.injection.enabled", "bool", default=False, importance="low",
+        doc="Wrap the storage backend in a FaultInjectingBackend executing "
+            "fault.schedule (chaos/soak runs only; never enable in "
+            "production).",
+    ))
+    d.define(ConfigKey(
+        "fault.schedule", "list", default=[], validator=_valid_fault_schedule,
+        importance="low",
+        doc="Deterministic fault rules 'op:action[=arg][@trigger]' with op in "
+            "[upload, fetch, delete, *], action in [raise, key-not-found, "
+            "delay, truncate, corrupt], trigger '@N' (Nth call), '@every=K', "
+            "or '@p=P' (seeded probability). E.g. 'upload:raise@3, "
+            "fetch:corrupt=7@1'.",
+    ))
+    d.define(ConfigKey(
+        "fault.seed", "long", default=0, importance="low",
+        doc="Seed for probabilistic fault triggers (deterministic for a "
+            "given seed and call sequence).",
+    ))
+    d.define(ConfigKey(
+        "breaker.enabled", "bool", default=False, importance="medium",
+        doc="Wrap the storage backend in a circuit breaker: after "
+            "breaker.failure.threshold consecutive backend failures, calls "
+            "fail fast until a half-open probe succeeds after "
+            "breaker.cooldown.ms.",
+    ))
+    d.define(ConfigKey(
+        "breaker.failure.threshold", "int", default=5,
+        validator=in_range(1, None), importance="medium",
+        doc="Consecutive storage failures that open the circuit breaker.",
+    ))
+    d.define(ConfigKey(
+        "breaker.cooldown.ms", "long", default=30_000,
+        validator=in_range(1, None), importance="medium",
+        doc="How long the breaker stays open before allowing a half-open "
+            "probe request through.",
     ))
     d.define(ConfigKey(
         "metrics.num.samples", "int", default=2, validator=in_range(1, None), importance="low",
@@ -266,6 +316,30 @@ class RemoteStorageManagerConfig:
     @property
     def custom_metadata_fields_include(self) -> list[str]:
         return self._values["custom.metadata.fields.include"]
+
+    @property
+    def fault_injection_enabled(self) -> bool:
+        return self._values["fault.injection.enabled"]
+
+    @property
+    def fault_schedule(self) -> list[str]:
+        return self._values["fault.schedule"]
+
+    @property
+    def fault_seed(self) -> int:
+        return self._values["fault.seed"]
+
+    @property
+    def breaker_enabled(self) -> bool:
+        return self._values["breaker.enabled"]
+
+    @property
+    def breaker_failure_threshold(self) -> int:
+        return self._values["breaker.failure.threshold"]
+
+    @property
+    def breaker_cooldown_ms(self) -> int:
+        return self._values["breaker.cooldown.ms"]
 
     @property
     def metrics_num_samples(self) -> int:

@@ -22,7 +22,27 @@ def _collect_rsm() -> dict[str, list[str]]:
     m.record_segment_delete_error("topic", 0)
     m.record_segment_fetch_requested_bytes("topic", 0, 1)
     m.record_object_upload("topic", 0, "log", 1)
+    m.record_upload_rollback("topic", 0)
     return _group_names(m.registry)
+
+
+def _collect_resilience() -> dict[str, list[str]]:
+    from tieredstorage_tpu.faults.schedule import FaultSchedule
+    from tieredstorage_tpu.fetch.cache.memory import MemoryChunkCache
+    from tieredstorage_tpu.fetch.chunk_manager import DefaultChunkManager
+    from tieredstorage_tpu.metrics.core import MetricsRegistry
+    from tieredstorage_tpu.metrics.rsm_metrics import register_resilience_metrics
+    from tieredstorage_tpu.storage.resilient import CircuitBreaker
+
+    registry = MetricsRegistry()
+    register_resilience_metrics(
+        registry,
+        breaker=CircuitBreaker(),
+        fault_schedule=FaultSchedule([]),
+        chunk_cache=MemoryChunkCache(None),
+        chunk_manager=DefaultChunkManager(None, None),
+    )
+    return _group_names(registry)
 
 
 def _collect_caches() -> dict[str, list[str]]:
@@ -108,6 +128,7 @@ def generate() -> str:
     for heading, collected in [
         ("RemoteStorageManager metrics", _collect_rsm()),
         ("Cache and thread-pool metrics", _collect_caches()),
+        ("Resilience metrics", _collect_resilience()),
         ("Storage backend client metrics", _collect_backends()),
     ]:
         section(heading)

@@ -1,0 +1,21 @@
+"""Deterministic fault injection for storage backends.
+
+`FaultInjectingBackend` wraps any `StorageBackend` and executes a seedable
+`FaultSchedule` (raise on the Nth upload/fetch/delete, truncate or corrupt
+fetched bytes, inject latency). Used by the chaos test suite directly and by
+soak runs through the `fault.injection.enabled` RSM config flag.
+"""
+
+from tieredstorage_tpu.faults.backend import FaultInjectingBackend
+from tieredstorage_tpu.faults.schedule import (
+    FaultInjectedException,
+    FaultRule,
+    FaultSchedule,
+)
+
+__all__ = [
+    "FaultInjectedException",
+    "FaultInjectingBackend",
+    "FaultRule",
+    "FaultSchedule",
+]

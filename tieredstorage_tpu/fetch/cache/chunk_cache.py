@@ -19,6 +19,7 @@ import abc
 import concurrent.futures
 import dataclasses
 import io
+import logging
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, BinaryIO, Generic, Mapping, Optional, Sequence, TypeVar
@@ -28,6 +29,8 @@ from tieredstorage_tpu.fetch.chunk_manager import ChunkManager
 from tieredstorage_tpu.manifest.segment_manifest import SegmentManifestV1
 from tieredstorage_tpu.storage.core import ObjectKey
 from tieredstorage_tpu.utils.caching import LoadingCache, RemovalCause
+
+log = logging.getLogger(__name__)
 
 T = TypeVar("T")
 
@@ -62,6 +65,11 @@ class ChunkCache(ChunkManager, Generic[T], abc.ABC):
         self._config: Optional[ChunkCacheConfig] = None
         self._cache: Optional[LoadingCache[ChunkKey, T]] = None
         self._executor: Optional[ThreadPoolExecutor] = None
+        #: Times a cache failure (I/O error or wedged load) was bypassed by
+        #: fetching straight from the delegate instead of failing the read.
+        self.degradations = 0
+        #: Background prefetch loads that failed; never propagated.
+        self.prefetch_failures = 0
 
     # ------------------------------------------------------------------ setup
     def configure(self, configs: Mapping[str, Any]) -> None:
@@ -124,22 +132,47 @@ class ChunkCache(ChunkManager, Generic[T], abc.ABC):
         self._start_prefetching(objects_key, manifest, chunk_ids[-1])
         futures = self._populate_window(objects_key, manifest, chunk_ids, deadline)
         out: dict[int, bytes] = {}
-        deleted: list[int] = []
+        fallback: list[int] = []
         for cid in chunk_ids:
-            value = self._await(futures[cid], deadline, cid, objects_key)
-            data = self._read_cached(value)
+            chunk_key = ChunkKey.of(objects_key, cid)
+            try:
+                value = self._await(futures[cid], deadline, cid, objects_key)
+            except ChunkCacheTimeoutException:
+                # Another reader's wedged population (the delegate fetch of
+                # THIS window is bounded separately in _populate_window) must
+                # not fail this read: degrade to a direct fetch.
+                self.degradations += 1
+                fallback.append(cid)
+                continue
+            except OSError:
+                # The loader only persists already-fetched bytes, so an error
+                # here is cache-storage I/O (unwritable disk cache directory,
+                # full disk): bypass the cache for this chunk.
+                log.warning("Chunk cache store failed for %s; bypassing cache",
+                            chunk_key, exc_info=True)
+                self._cache.invalidate(chunk_key)
+                self.degradations += 1
+                fallback.append(cid)
+                continue
+            try:
+                data = self._read_cached(value)
+            except OSError:
+                log.warning("Chunk cache read failed for %s; bypassing cache",
+                            chunk_key, exc_info=True)
+                self.degradations += 1
+                data = None
             if data is None:  # evicted + unlinked between resolve and open
-                self._cache.invalidate(ChunkKey.of(objects_key, cid))
-                deleted.append(cid)
+                self._cache.invalidate(chunk_key)
+                fallback.append(cid)
             else:
                 out[cid] = data
-        if deleted:
-            # Rare eviction race (cache bound smaller than the read window):
-            # re-fetch the affected chunks straight from the delegate, without
+        if fallback:
+            # Eviction races and degraded cache I/O both land here: re-fetch
+            # the affected chunks straight from the delegate, without
             # re-caching — going through the cache again would just re-race
-            # with its own evictions.
-            refetched = self._delegate.get_chunks(objects_key, manifest, deleted)
-            out.update(zip(deleted, refetched))
+            # with its own evictions (or re-hit the broken disk).
+            refetched = self._delegate.get_chunks(objects_key, manifest, fallback)
+            out.update(zip(fallback, refetched))
         return [out[cid] for cid in chunk_ids]
 
     def _await(self, future, deadline: float, cid: int, objects_key: ObjectKey) -> T:
@@ -228,7 +261,20 @@ class ChunkCache(ChunkManager, Generic[T], abc.ABC):
             return
         # Fire-and-forget: one batched load covers the whole prefetch window
         # (deadline=None — already on a pool worker, fetch runs inline there).
-        self._executor.submit(self._populate_window, objects_key, manifest, ids, None)
+        self._executor.submit(self._prefetch_window, objects_key, manifest, ids)
+
+    def _prefetch_window(
+        self, objects_key: ObjectKey, manifest: SegmentManifestV1, ids: Sequence[int]
+    ) -> None:
+        """Isolation boundary: a failed prefetch is counted, never raised —
+        and the LoadingCache drops failed loads, so the entries stay clean
+        for the next foreground get."""
+        try:
+            self._populate_window(objects_key, manifest, ids, None)
+        except Exception:
+            self.prefetch_failures += 1
+            log.debug("Prefetch of chunks %s of %s failed", list(ids), objects_key,
+                      exc_info=True)
 
     # ------------------------------------------------------------- subclasses
     @abc.abstractmethod

@@ -40,9 +40,18 @@ class DiskChunkCache(ChunkCache[Path]):
         name = f"{chunk_key.path}.{next(self._generation)}"
         temp = self._config.temp_path / name
         final = self._config.cache_path / name
-        with open(temp, "wb") as f:
-            f.write(chunk)
-        os.replace(temp, final)  # atomic within the cache filesystem
+        try:
+            with open(temp, "wb") as f:
+                f.write(chunk)
+            os.replace(temp, final)  # atomic within the cache filesystem
+        except OSError:
+            # Cache-write I/O errors degrade to cache-bypass upstream
+            # (ChunkCache.get_chunks); don't leak the partial temp file.
+            try:
+                temp.unlink(missing_ok=True)
+            except OSError:
+                pass
+            raise
         self.record_write(len(chunk))
         return final
 

@@ -12,12 +12,28 @@ from __future__ import annotations
 
 import abc
 import io
-from typing import BinaryIO, Sequence
+import logging
+import threading
+import time
+from typing import BinaryIO, Callable, Optional, Sequence
 
 from tieredstorage_tpu.manifest.segment_manifest import SegmentManifestV1
-from tieredstorage_tpu.storage.core import BytesRange, ObjectFetcher, ObjectKey
+from tieredstorage_tpu.storage.core import (
+    BytesRange,
+    ObjectFetcher,
+    ObjectKey,
+    StorageBackendException,
+)
 from tieredstorage_tpu.transform.api import DetransformOptions, TransformBackend
 from tieredstorage_tpu.utils.streams import read_exactly
+
+log = logging.getLogger(__name__)
+
+
+class CorruptChunkException(StorageBackendException):
+    """Detransform failed on fetched bytes (GCM tag / CRC / frame mismatch):
+    the stored object is corrupt or forged. The object key is quarantined so
+    broker retry storms can't hammer a poisoned object."""
 
 
 class ChunkManager(abc.ABC):
@@ -37,9 +53,51 @@ class ChunkManager(abc.ABC):
 
 
 class DefaultChunkManager(ChunkManager):
-    def __init__(self, fetcher: ObjectFetcher, transform_backend: TransformBackend):
+    #: How long a key stays quarantined after a detransform failure.
+    DEFAULT_QUARANTINE_TTL_S = 60.0
+
+    def __init__(
+        self,
+        fetcher: ObjectFetcher,
+        transform_backend: TransformBackend,
+        *,
+        quarantine_ttl_s: Optional[float] = None,
+        time_source: Callable[[], float] = time.monotonic,
+    ):
         self._fetcher = fetcher
         self._backend = transform_backend
+        self.quarantine_ttl_s = (
+            self.DEFAULT_QUARANTINE_TTL_S if quarantine_ttl_s is None else quarantine_ttl_s
+        )
+        self._now = time_source
+        self._quarantine: dict[str, tuple[float, str]] = {}
+        self._quarantine_lock = threading.Lock()
+        #: Total detransform corruption detections (exported as a gauge).
+        self.corruptions = 0
+
+    @property
+    def quarantined_keys(self) -> int:
+        with self._quarantine_lock:
+            return len(self._quarantine)
+
+    def _check_quarantine(self, key: ObjectKey) -> None:
+        with self._quarantine_lock:
+            entry = self._quarantine.get(key.value)
+            if entry is None:
+                return
+            expires_at, reason = entry
+            if self._now() >= expires_at:
+                del self._quarantine[key.value]
+                return
+        raise CorruptChunkException(
+            f"Object {key} is quarantined after a detransform failure: {reason}"
+        )
+
+    def _quarantine_key(self, key: ObjectKey, reason: str) -> None:
+        with self._quarantine_lock:
+            self.corruptions += 1
+            self._quarantine[key.value] = (self._now() + self.quarantine_ttl_s, reason)
+        log.warning("Quarantining %s for %.0fs: %s", key, self.quarantine_ttl_s, reason)
 
     def get_chunk(
         self, objects_key: ObjectKey, manifest: SegmentManifestV1, chunk_id: int
@@ -51,6 +109,7 @@ class DefaultChunkManager(ChunkManager):
     ) -> list[bytes]:
         if len(chunk_ids) == 0:
             return []
+        self._check_quarantine(objects_key)
         index = manifest.chunk_index
         chunks = [index._chunk_at(cid) for cid in chunk_ids]
         contiguous = all(
@@ -72,4 +131,14 @@ class DefaultChunkManager(ChunkManager):
                 with self._fetcher.fetch(objects_key, c.range()) as stream:
                     stored.append(read_exactly(stream, c.transformed_size))
         opts = DetransformOptions.from_manifest(manifest)
-        return self._backend.detransform(stored, opts)
+        try:
+            return self._backend.detransform(stored, opts)
+        except Exception as e:
+            # Any detransform failure (AuthenticationError on a GCM tag
+            # mismatch, CRC/frame errors from the codecs) means the stored
+            # bytes are poisoned — re-fetching won't fix them, so quarantine
+            # the key instead of letting retries hammer the backend.
+            self._quarantine_key(objects_key, f"{type(e).__name__}: {e}")
+            raise CorruptChunkException(
+                f"Detransform failed for chunks {list(chunk_ids)} of {objects_key}"
+            ) from e

@@ -12,6 +12,12 @@ sensor-name scheme :438-470). Families:
 - segment-fetch-requested-bytes-rate/-total
 - object-upload-rate/-total, object-upload-bytes-rate/-total
   (aggregate/topic/partition × optional object-type tag)
+- upload-rollbacks-rate/-total (orphan cleanup after a failed copy; this
+  build's addition — the reference logs rollbacks but doesn't count them)
+
+Plus `register_resilience_metrics`: gauges for the circuit breaker, fault
+injection, degraded cache, and quarantine states (group
+`resilience-metrics`), shared between the RSM and the docs generator.
 """
 
 from __future__ import annotations
@@ -30,6 +36,7 @@ from tieredstorage_tpu.metrics.core import (
 )
 
 METRIC_GROUP = "remote-storage-manager-metrics"
+RESILIENCE_METRIC_GROUP = "resilience-metrics"
 
 
 class Metrics:
@@ -95,6 +102,11 @@ class Metrics:
         for tags in self._scopes(topic, partition):
             self._rate_total("segment-fetch-requested-bytes", tags, float(n_bytes))
 
+    def record_upload_rollback(self, topic: str, partition: int) -> None:
+        """A failed copy's partial objects were (best-effort) deleted."""
+        for tags in self._scopes(topic, partition):
+            self._count_rate_total("upload-rollbacks", tags)
+
     def record_object_upload(
         self, topic: str, partition: int, object_type: str, n_bytes: int
     ) -> None:
@@ -105,3 +117,46 @@ class Metrics:
     # -------------------------------------------------------------- snapshot
     def snapshot(self) -> dict[str, float]:
         return self.registry.snapshot()
+
+
+def register_resilience_metrics(
+    registry: MetricsRegistry,
+    *,
+    breaker=None,
+    fault_schedule=None,
+    chunk_cache=None,
+    chunk_manager=None,
+) -> None:
+    """Publish resilience counters as gauges (group `resilience-metrics`).
+
+    Components keep plain int counters (storage/resilient.py CircuitBreaker,
+    faults/schedule.py FaultSchedule, fetch/cache ChunkCache,
+    fetch/chunk_manager.py DefaultChunkManager); the RSM registers whichever
+    are wired after configure(), and the docs generator registers all of them
+    against throwaway instances.
+    """
+
+    def gauge(name: str, supplier, description: str = "") -> None:
+        registry.add_gauge(
+            MetricName.of(name, RESILIENCE_METRIC_GROUP, description), supplier
+        )
+
+    if breaker is not None:
+        gauge("breaker-state", lambda: float(breaker.state_code),
+              "0 = closed, 1 = half-open, 2 = open")
+        gauge("breaker-opens-total", lambda: float(breaker.opens))
+        gauge("breaker-fast-fails-total", lambda: float(breaker.fast_fails))
+    if fault_schedule is not None:
+        gauge("fault-injections-total",
+              lambda: float(len(fault_schedule.injections)))
+    if chunk_cache is not None:
+        gauge("chunk-cache-degradations-total",
+              lambda: float(chunk_cache.degradations),
+              "Reads served by cache-bypass after a cache failure")
+        gauge("chunk-cache-prefetch-failures-total",
+              lambda: float(chunk_cache.prefetch_failures))
+    if chunk_manager is not None:
+        gauge("detransform-corruptions-total",
+              lambda: float(chunk_manager.corruptions))
+        gauge("quarantined-keys", lambda: float(chunk_manager.quarantined_keys),
+              "Object keys currently quarantined after detransform failures")

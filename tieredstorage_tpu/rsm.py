@@ -28,7 +28,8 @@ from tieredstorage_tpu.custom_metadata import (
     serialize_custom_metadata,
 )
 from tieredstorage_tpu.errors import RemoteResourceNotFoundException, RemoteStorageException
-from tieredstorage_tpu.fetch.chunk_manager import ChunkManager
+from tieredstorage_tpu.fetch.cache.chunk_cache import ChunkCache
+from tieredstorage_tpu.fetch.chunk_manager import ChunkManager, DefaultChunkManager
 from tieredstorage_tpu.fetch.factory import ChunkManagerFactory
 from tieredstorage_tpu.fetch.enumeration import FetchChunkEnumeration
 from tieredstorage_tpu.fetch.index_cache import MemorySegmentIndexesCache
@@ -48,7 +49,7 @@ from tieredstorage_tpu.metrics.cache_metrics import (
     register_thread_pool_metrics,
 )
 from tieredstorage_tpu.metrics.core import MetricConfig
-from tieredstorage_tpu.metrics.rsm_metrics import Metrics
+from tieredstorage_tpu.metrics.rsm_metrics import Metrics, register_resilience_metrics
 from tieredstorage_tpu.object_key import ObjectKeyFactory, Suffix
 from tieredstorage_tpu.security.aes import AesEncryptionProvider, DataKeyAndAAD
 from tieredstorage_tpu.security.rsa import RsaEncryptionProvider
@@ -59,6 +60,7 @@ from tieredstorage_tpu.storage.core import (
     StorageBackend,
     StorageBackendException,
 )
+from tieredstorage_tpu.storage.resilient import CircuitBreaker, ResilientStorageBackend
 from tieredstorage_tpu.transform.api import DetransformOptions, TransformOptions
 from tieredstorage_tpu.transform.pipeline import SegmentTransformation
 from tieredstorage_tpu.utils.ratelimit import RateLimitedStream, TokenBucket
@@ -99,6 +101,8 @@ class RemoteStorageManager:
         self._manifest_cache: Optional[MemorySegmentManifestCache] = None
         self._indexes_cache: Optional[MemorySegmentIndexesCache] = None
         self._metrics = None
+        self._breaker: Optional[CircuitBreaker] = None
+        self._fault_schedule = None
         self.tracer = NOOP_TRACER
 
     # ------------------------------------------------------------------ setup
@@ -119,6 +123,7 @@ class RemoteStorageManager:
 
         storage = config.storage_backend_class()
         storage.configure(config.storage_configs())
+        storage = self._wrap_storage_resilience(config, storage)
         self._storage = storage
 
         backend = config.transform_backend_class()
@@ -143,6 +148,48 @@ class RemoteStorageManager:
         self._indexes_cache = MemorySegmentIndexesCache()
         self._indexes_cache.configure(config.fetch_indexes_cache_configs())
         self._register_cache_metrics()
+        self._register_resilience_metrics()
+
+    def _wrap_storage_resilience(
+        self, config: RemoteStorageManagerConfig, storage: StorageBackend
+    ) -> StorageBackend:
+        """Layering (innermost first): backend → fault injection (soak runs
+        only) → circuit breaker, so injected faults exercise the breaker the
+        same way real outages do."""
+        if config.fault_injection_enabled:
+            from tieredstorage_tpu.faults import FaultInjectingBackend, FaultSchedule
+
+            self._fault_schedule = FaultSchedule.parse(
+                config.fault_schedule, seed=config.fault_seed
+            )
+            storage = FaultInjectingBackend(storage, self._fault_schedule)
+            log.warning(
+                "Fault injection ENABLED with %d rule(s); storage calls will "
+                "be deliberately failed/corrupted/slowed", len(self._fault_schedule),
+            )
+        if config.breaker_enabled:
+            self._breaker = CircuitBreaker(
+                failure_threshold=config.breaker_failure_threshold,
+                cooldown_s=config.breaker_cooldown_ms / 1000.0,
+                on_transition=lambda old, new: self.tracer.event(
+                    "storage.breaker.transition", from_state=old.name, to_state=new.name
+                ),
+            )
+            storage = ResilientStorageBackend(storage, self._breaker)
+        return storage
+
+    def _register_resilience_metrics(self) -> None:
+        chunk_cache = (
+            self._chunk_manager if isinstance(self._chunk_manager, ChunkCache) else None
+        )
+        inner = chunk_cache._delegate if chunk_cache is not None else self._chunk_manager
+        register_resilience_metrics(
+            self._metrics.registry,
+            breaker=self._breaker,
+            fault_schedule=self._fault_schedule,
+            chunk_cache=chunk_cache,
+            chunk_manager=inner if isinstance(inner, DefaultChunkManager) else None,
+        )
 
     def _register_cache_metrics(self) -> None:
         registry = self._metrics.registry
@@ -224,10 +271,19 @@ class RemoteStorageManager:
         except Exception as e:
             # Orphan cleanup: a failed copy must not leave partial objects
             # (reference :258-267); the broker will retry the whole copy.
-            try:
-                self._delete_keys(uploaded_keys)
-            except Exception:
-                log.warning("Failed to clean up partial upload for %s", metadata, exc_info=True)
+            if uploaded_keys:
+                topic, partition = self._topic_partition(metadata)
+                self._metrics.record_upload_rollback(topic, partition)
+                self.tracer.event(
+                    "rsm.upload_rollback", topic=topic, partition=partition,
+                    keys=len(uploaded_keys),
+                )
+                try:
+                    self._delete_keys(uploaded_keys)
+                except Exception:
+                    log.warning(
+                        "Failed to clean up partial upload for %s", metadata, exc_info=True
+                    )
             if isinstance(e, RemoteStorageException):
                 raise
             raise RemoteStorageException(f"Failed to copy segment {metadata}") from e
@@ -504,6 +560,9 @@ class RemoteStorageManager:
         try:
             keys = [self._object_key(metadata, s) for s in Suffix]
             self._delete_keys(keys)
+        except RemoteStorageException:
+            self._metrics.record_segment_delete_error(topic, partition)
+            raise
         except StorageBackendException as e:
             self._metrics.record_segment_delete_error(topic, partition)
             raise RemoteStorageException(f"Failed to delete {metadata}") from e
@@ -512,8 +571,31 @@ class RemoteStorageManager:
         )
 
     def _delete_keys(self, keys: list[ObjectKey]) -> None:
-        if self._storage is not None and keys:
+        """Idempotent multi-delete: bulk fast path, then a per-key sweep on
+        failure — missing keys (KeyNotFoundException) are fine (a retried
+        delete or a partially-failed bulk call must converge), every other
+        per-key failure is collected and surfaced as ONE
+        RemoteStorageException after the sweep finishes."""
+        if self._storage is None or not keys:
+            return
+        try:
             self._storage.delete_all(keys)
+            return
+        except StorageBackendException:
+            log.debug("Bulk delete failed; sweeping per key", exc_info=True)
+        failures: list[tuple[ObjectKey, StorageBackendException]] = []
+        for key in keys:
+            try:
+                self._storage.delete(key)
+            except KeyNotFoundException:
+                continue  # already gone — deletion is idempotent
+            except StorageBackendException as e:
+                failures.append((key, e))
+        if failures:
+            detail = "; ".join(f"{key}: {e}" for key, e in failures)
+            raise RemoteStorageException(
+                f"Failed to delete {len(failures)}/{len(keys)} keys: {detail}"
+            ) from failures[0][1]
 
     def close(self) -> None:
         if self._chunk_manager is not None and hasattr(self._chunk_manager, "close"):

@@ -17,7 +17,26 @@ from __future__ import annotations
 import dataclasses
 import os
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:  # Optional dependency: only the encrypt/decrypt paths need it, so the
+    # module (and everything importing DataKeyAndAAD) stays importable and
+    # unencrypted pipelines keep working without `cryptography` installed.
+    from cryptography.exceptions import InvalidTag
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ImportError:  # pragma: no cover - exercised only without cryptography
+    AESGCM = None
+
+    class InvalidTag(Exception):  # type: ignore[no-redef]
+        """Stand-in so callers can catch aes.InvalidTag unconditionally."""
+
+
+def _aesgcm(data_key: bytes) -> "AESGCM":
+    if AESGCM is None:
+        raise ModuleNotFoundError(
+            "The 'cryptography' package is required for AES-GCM encryption "
+            "(encryption.enabled) but is not installed"
+        )
+    return AESGCM(data_key)
+
 
 KEY_SIZE = 32  # AES-256
 IV_SIZE = 12
@@ -45,7 +64,7 @@ class AesEncryptionProvider:
             iv = os.urandom(IV_SIZE)
         if len(iv) != IV_SIZE:
             raise ValueError(f"IV must be {IV_SIZE} bytes")
-        return iv + AESGCM(data_key).encrypt(iv, plaintext, aad)
+        return iv + _aesgcm(data_key).encrypt(iv, plaintext, aad)
 
     @staticmethod
     def decrypt_chunk(transformed: bytes, data_key: bytes, aad: bytes) -> bytes:
@@ -54,7 +73,7 @@ class AesEncryptionProvider:
         if len(transformed) < IV_SIZE + TAG_SIZE:
             raise ValueError("Encrypted chunk shorter than IV+tag")
         iv, ct = transformed[:IV_SIZE], transformed[IV_SIZE:]
-        return AESGCM(data_key).decrypt(iv, ct, aad)
+        return _aesgcm(data_key).decrypt(iv, ct, aad)
 
     @staticmethod
     def encrypted_chunk_size(plaintext_size: int) -> int:

@@ -20,10 +20,23 @@ import os
 from pathlib import Path
 from typing import Mapping
 
-from cryptography.hazmat.primitives import serialization
-from cryptography.hazmat.primitives.asymmetric import rsa
+try:  # Optional dependency: PEM parsing / keygen only; the OAEP math below
+    # is dependency-free, and unencrypted deployments never reach either.
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+except ImportError:  # pragma: no cover - exercised only without cryptography
+    serialization = None
+    rsa = None
 
 from tieredstorage_tpu.security.keys import EncryptedDataKey
+
+
+def _require_crypto() -> None:
+    if rsa is None:
+        raise ModuleNotFoundError(
+            "The 'cryptography' package is required for RSA key handling "
+            "(encryption.enabled) but is not installed"
+        )
 
 _HASH = hashlib.sha3_512
 
@@ -39,6 +52,7 @@ class RsaKeyReader:
 
     @staticmethod
     def read(public_key_path: str | Path, private_key_path: str | Path) -> KeyPair:
+        _require_crypto()
         try:
             pub_pem = Path(public_key_path).read_bytes()
             priv_pem = Path(private_key_path).read_bytes()
@@ -199,6 +213,7 @@ def generate_key_pair_pem_files(
     The analogue of the reference's RsaKeyAwareTest fixture
     (core/src/test/java/.../RsaKeyAwareTest.java).
     """
+    _require_crypto()
     directory = Path(directory)
     private_key = rsa.generate_private_key(public_exponent=65537, key_size=key_size)
     priv_pem = private_key.private_bytes(

@@ -15,8 +15,12 @@ import json
 import time
 from typing import Optional
 
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import padding
+try:  # Optional dependency: only service-account JWT signing needs it;
+    # default credentials (emulators, workload identity) send no token.
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import padding
+except ImportError:  # pragma: no cover - exercised only without cryptography
+    hashes = serialization = padding = None
 
 
 def _b64url(data: bytes) -> bytes:
@@ -35,6 +39,11 @@ class ServiceAccountTokenProvider:
             key_pem = credentials["private_key"]
         except KeyError as e:
             raise ValueError(f"Service account JSON missing field: {e}") from e
+        if serialization is None:
+            raise ModuleNotFoundError(
+                "The 'cryptography' package is required for GCS "
+                "service-account credentials but is not installed"
+            )
         self._key = serialization.load_pem_private_key(key_pem.encode(), password=None)
         self._token: Optional[str] = None
         self._expires_at = 0.0

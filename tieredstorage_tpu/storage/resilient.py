@@ -1,0 +1,165 @@
+"""Per-backend circuit breaker: fail fast while the backend is down.
+
+The HTTP transport already retries transient 5xx/429 with jittered backoff
+(storage/httpclient.py); this layer sits above it and contains *sustained*
+backend outages: after `failure.threshold` consecutive
+StorageBackendExceptions the breaker opens and every call fails immediately
+with CircuitOpenException (no network), until a `cooldown.ms` period passes
+and a single half-open probe is allowed through — success closes the
+breaker, failure re-opens it. KeyNotFoundException / InvalidRangeException
+are contract responses from a healthy backend and count as successes.
+
+Wired by the RSM behind the `breaker.enabled` config flag
+(config/rsm_config.py); state and counters are exported as gauges via
+metrics/rsm_metrics.register_resilience_metrics and transitions are recorded
+as tracing events.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from typing import BinaryIO, Callable, Mapping, Optional
+
+from tieredstorage_tpu.storage.core import (
+    BytesRange,
+    InvalidRangeException,
+    KeyNotFoundException,
+    ObjectKey,
+    StorageBackend,
+    StorageBackendException,
+)
+
+
+class BreakerState(enum.Enum):
+    CLOSED = 0
+    HALF_OPEN = 1
+    OPEN = 2
+
+
+class CircuitOpenException(StorageBackendException):
+    """Fast-fail: the breaker is open and the call never reached the backend."""
+
+
+class CircuitBreaker:
+    def __init__(
+        self,
+        failure_threshold: int = 5,
+        cooldown_s: float = 30.0,
+        *,
+        time_source: Callable[[], float] = time.monotonic,
+        on_transition: Optional[Callable[[BreakerState, BreakerState], None]] = None,
+    ) -> None:
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self._threshold = failure_threshold
+        self._cooldown_s = cooldown_s
+        self._now = time_source
+        self._on_transition = on_transition
+        self._lock = threading.Lock()
+        self._state = BreakerState.CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._probe_in_flight = False
+        #: Cumulative counters, exported as gauges.
+        self.opens = 0
+        self.fast_fails = 0
+
+    @property
+    def state(self) -> BreakerState:
+        with self._lock:
+            return self._state
+
+    @property
+    def state_code(self) -> int:
+        return self.state.value
+
+    def _transition_locked(self, new: BreakerState) -> None:
+        old, self._state = self._state, new
+        if old is not new and self._on_transition is not None:
+            try:
+                self._on_transition(old, new)
+            except Exception:  # noqa: BLE001 — observers must not break the breaker
+                pass
+
+    def acquire(self) -> None:
+        """Gate a call; raises CircuitOpenException while open."""
+        with self._lock:
+            if self._state is BreakerState.OPEN:
+                if self._now() - self._opened_at >= self._cooldown_s:
+                    self._transition_locked(BreakerState.HALF_OPEN)
+                else:
+                    self.fast_fails += 1
+                    raise CircuitOpenException(
+                        f"Circuit breaker open ({self._consecutive_failures} "
+                        "consecutive backend failures); failing fast"
+                    )
+            if self._state is BreakerState.HALF_OPEN:
+                if self._probe_in_flight:
+                    self.fast_fails += 1
+                    raise CircuitOpenException(
+                        "Circuit breaker half-open; probe already in flight"
+                    )
+                self._probe_in_flight = True
+
+    def on_success(self) -> None:
+        with self._lock:
+            self._consecutive_failures = 0
+            self._probe_in_flight = False
+            self._transition_locked(BreakerState.CLOSED)
+
+    def on_failure(self) -> None:
+        with self._lock:
+            self._consecutive_failures += 1
+            was_probe = self._probe_in_flight
+            self._probe_in_flight = False
+            if was_probe or self._consecutive_failures >= self._threshold:
+                if self._state is not BreakerState.OPEN:
+                    self.opens += 1
+                self._opened_at = self._now()
+                self._transition_locked(BreakerState.OPEN)
+
+
+class ResilientStorageBackend(StorageBackend):
+    """StorageBackend decorator routing every call through a CircuitBreaker."""
+
+    def __init__(self, delegate: StorageBackend, breaker: CircuitBreaker) -> None:
+        self._delegate = delegate
+        self.breaker = breaker
+
+    @property
+    def delegate(self) -> StorageBackend:
+        return self._delegate
+
+    def configure(self, configs: Mapping[str, object]) -> None:
+        self._delegate.configure(configs)
+
+    def _call(self, fn, *args):
+        self.breaker.acquire()
+        try:
+            result = fn(*args)
+        except (KeyNotFoundException, InvalidRangeException):
+            # The backend answered; the request was just unsatisfiable.
+            self.breaker.on_success()
+            raise
+        except Exception:
+            self.breaker.on_failure()
+            raise
+        self.breaker.on_success()
+        return result
+
+    def upload(self, input_stream: BinaryIO, key: ObjectKey) -> int:
+        return self._call(self._delegate.upload, input_stream, key)
+
+    def fetch(self, key: ObjectKey, byte_range: Optional[BytesRange] = None) -> BinaryIO:
+        return self._call(self._delegate.fetch, key, byte_range)
+
+    def delete(self, key: ObjectKey) -> None:
+        return self._call(self._delegate.delete, key)
+
+    def delete_all(self, keys) -> None:
+        return self._call(self._delegate.delete_all, keys)
+
+    def __str__(self) -> str:
+        return f"ResilientStorageBackend{{delegate={self._delegate}}}"

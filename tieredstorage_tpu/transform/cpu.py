@@ -12,10 +12,13 @@ from __future__ import annotations
 
 from typing import Sequence
 
-import zstandard
-from cryptography.exceptions import InvalidTag
+try:  # Optional dependency: only the zstd codec branches need it; identity
+    # and device-codec (tpu-huff/tpu-lzhuff) pipelines work without it.
+    import zstandard
+except ImportError:  # pragma: no cover - exercised only without zstandard
+    zstandard = None
 
-from tieredstorage_tpu.security.aes import AesEncryptionProvider
+from tieredstorage_tpu.security.aes import AesEncryptionProvider, InvalidTag
 from tieredstorage_tpu.transform.api import (
     THUFF,
     TLZHUFF,
@@ -25,6 +28,14 @@ from tieredstorage_tpu.transform.api import (
     TransformBackend,
     TransformOptions,
 )
+
+
+def _require_zstd() -> None:
+    if zstandard is None:
+        raise ModuleNotFoundError(
+            "The 'zstandard' package is required for the 'zstd' codec "
+            "(compression.codec) but is not installed"
+        )
 
 
 class CpuTransformBackend(TransformBackend):
@@ -49,6 +60,7 @@ class CpuTransformBackend(TransformBackend):
             else:
                 # A compressor per chunk size keeps the pledged-src-size
                 # frames identical to the reference's per-chunk Zstd usage.
+                _require_zstd()
                 out = [
                     zstandard.ZstdCompressor(
                         level=opts.compression_level, write_content_size=True
@@ -98,6 +110,7 @@ class CpuTransformBackend(TransformBackend):
             else:
                 from tieredstorage_tpu.native import checked_frame_content_sizes
 
+                _require_zstd()
                 checked_frame_content_sizes(out, opts.max_original_chunk_size)
                 dctx = zstandard.ZstdDecompressor()
                 out = [dctx.decompress(c) for c in out]

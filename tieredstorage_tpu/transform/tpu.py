@@ -20,7 +20,11 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-import zstandard
+try:  # Optional dependency: only the zstd codec path needs it (device
+    # codecs and identity/encrypt-only pipelines run without it).
+    import zstandard
+except ImportError:  # pragma: no cover - exercised only without zstandard
+    zstandard = None
 
 from tieredstorage_tpu import native
 from tieredstorage_tpu.ops.gcm import (
@@ -163,6 +167,11 @@ class TpuTransformBackend(TransformBackend):
         level = opts.compression_level
         if self._use_native():
             return native.zstd_compress_batch(chunks, level=level)
+        if zstandard is None:
+            raise ModuleNotFoundError(
+                "The 'zstandard' package is required for the 'zstd' codec "
+                "but is not installed"
+            )
         return list(
             self._zstd_pool().map(
                 lambda c: zstandard.ZstdCompressor(
@@ -266,6 +275,11 @@ class TpuTransformBackend(TransformBackend):
                     out, max_decompressed=opts.max_original_chunk_size
                 )
             else:
+                if zstandard is None:
+                    raise ModuleNotFoundError(
+                        "The 'zstandard' package is required for the 'zstd' "
+                        "codec but is not installed"
+                    )
                 native.checked_frame_content_sizes(out, opts.max_original_chunk_size)
                 # One DCtx per chunk: zstandard (de)compressor objects are not
                 # thread-safe across the pool's workers.

@@ -77,6 +77,20 @@ class Tracer:
                 if len(self._spans) < self.max_spans:
                     self._spans.append(s)
 
+    def event(self, name: str, **attributes) -> Optional[Span]:
+        """Record an instantaneous (zero-duration) span — state transitions
+        like circuit-breaker trips or upload rollbacks that have no useful
+        extent but must show up on the timeline."""
+        if not self.enabled:
+            return None
+        now = time.perf_counter()
+        s = Span(name=name, start_s=now, end_s=now,
+                 depth=getattr(self._local, "depth", 0), attributes=attributes)
+        with self._lock:
+            if len(self._spans) < self.max_spans:
+                self._spans.append(s)
+        return s
+
     def spans(self, name: Optional[str] = None) -> list[Span]:
         with self._lock:
             out = list(self._spans)
