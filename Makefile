@@ -1,7 +1,7 @@
 # Developer entry points (counterpart of /root/reference/Makefile).
 PYTHON ?= python
 
-.PHONY: test test-e2e chaos bench demo docs docker lint mutation clean
+.PHONY: test test-e2e chaos bench demo trace-demo docs docker lint mutation clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q --ignore=tests/e2e
@@ -20,6 +20,12 @@ bench:
 
 demo:
 	$(PYTHON) demo/run_demo.py
+
+# End-to-end tracing gate: upload+fetch through the HTTP gateway under the
+# memory backend, one trace tree (client -> gateway -> RSM -> storage),
+# written to artifacts/trace.json and validated as Chrome trace-event JSON.
+trace-demo:
+	$(PYTHON) tools/trace_demo.py --out artifacts/trace.json
 
 docs:
 	$(PYTHON) -m tieredstorage_tpu.docs.configs_docs > docs/configs.rst

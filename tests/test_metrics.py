@@ -10,7 +10,8 @@ from __future__ import annotations
 import pytest
 
 from tieredstorage_tpu.metrics.core import (
-    Avg, Count, Max, MetricConfig, MetricName, MetricsRegistry, Rate, Total,
+    Avg, Count, Histogram, Max, MetricConfig, MetricName, MetricsRegistry,
+    Rate, Total,
 )
 from tieredstorage_tpu.metrics.rsm_metrics import METRIC_GROUP, Metrics
 
@@ -98,6 +99,39 @@ class TestStats:
         assert self.registry.value(MetricName.of("o-total", "g")) == 3.0
         assert len(s._stats) == 1
 
+    def test_histogram_buckets_sum_count(self):
+        h = Histogram(buckets=(1.0, 10.0, 100.0))
+        s = self.registry.sensor("lat")
+        s.add(MetricName.of("lat-ms", "g"), h)
+        for v in (0.5, 1.0, 7.0, 99.0, 5000.0):
+            s.record(v)
+        # le semantics are inclusive: 1.0 lands in the le=1 bucket.
+        assert h.buckets() == [
+            (1.0, 2), (10.0, 3), (100.0, 4), (float("inf"), 5),
+        ]
+        assert h.count == 5 and h.sum == 5107.5
+        # measure()/snapshot expose the observation count.
+        assert self.registry.value(MetricName.of("lat-ms", "g")) == 5.0
+
+    def test_histogram_default_buckets_log_scale(self):
+        h = Histogram()
+        bounds = h._bounds
+        assert bounds[0] == 0.25 and len(bounds) == 20
+        assert all(b2 / b1 == 2.0 for b1, b2 in zip(bounds, bounds[1:]))
+
+    def test_histogram_quantile_interpolates(self):
+        h = Histogram(buckets=(10.0, 20.0, 40.0))
+        for _ in range(50):
+            h.record(5.0, 0.0)  # le=10
+        for _ in range(50):
+            h.record(15.0, 0.0)  # le=20
+        # Median sits at the le=10 boundary; p75 interpolates inside (10, 20].
+        assert h.quantile(0.5) == 10.0
+        assert 10.0 < h.quantile(0.75) <= 20.0
+        assert Histogram().quantile(0.5) == 0.0
+        with pytest.raises(ValueError):
+            h.quantile(1.5)
+
 
 class TestRsmMetrics:
     def test_scopes_and_families(self):
@@ -129,6 +163,30 @@ class TestRsmMetrics:
         assert all(
             mn.group == METRIC_GROUP for mn in m.registry.metric_names
         ), snap
+
+    def test_latency_histograms_record_aggregate_scope_only(self):
+        m = Metrics()
+        m.record_segment_copy_time("t1", 3, 250.0)
+        m.record_segment_fetch_time("t1", 3, 2.0)
+        m.record_chunk_fetch(4.0, 1 << 20)
+        m.record_cache_get(0.5)
+
+        def find_stat(name):
+            [mn] = m.registry.find(name, {})
+            return m.registry.stat(mn)
+
+        for family in ("segment-copy-time-ms", "remote-fetch-time-ms",
+                       "chunk-fetch-time-ms", "cache-get-time-ms"):
+            h = find_stat(family)
+            assert isinstance(h, Histogram) and h.count == 1, family
+            # Aggregate scope only: no per-topic histogram series.
+            assert m.registry.find(family, {"topic": "t1"}) == []
+        # The avg/max companions still record in all scopes.
+        [mn] = m.registry.find("remote-fetch-time-avg",
+                               {"topic": "t1", "partition": "3"})
+        assert m.registry.value(mn) == 2.0
+        [mn] = m.registry.find("chunk-fetch-bytes-total", {})
+        assert m.registry.value(mn) == float(1 << 20)
 
     def test_multiple_topics_do_not_mix(self):
         m = Metrics()
@@ -175,6 +233,17 @@ class TestRsmIntegrationMetrics:
         assert v("segment-fetch-requested-bytes-total") == 200.0
         assert v("segment-delete-total") == 1.0
         assert v("segment-delete-time-avg") >= 0
+
+        # Latency histograms populated by the hot paths (counts exposed via
+        # the registry's scalar view; buckets via Prometheus exposition).
+        assert v("segment-copy-time-ms") == 1.0
+        assert v("remote-fetch-time-ms") == 2.0
+        assert v("chunk-fetch-time-ms") >= 1.0  # one window per fetch miss
+        assert v("cache-get-time-ms") >= 1.0
+        assert v("chunk-fetch-bytes-total") > 0
+        # Tracer ring-buffer health gauges register at configure time.
+        assert v("tracer-dropped-spans") == 0.0
+        assert v("tracer-recorded-spans") >= 0.0
 
         # Cache exporters: manifest cache saw 1 miss + 1 hit; disk cache wrote.
         assert v("cache-misses-total", cache="segment-manifest-cache") == 1.0

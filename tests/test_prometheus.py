@@ -1,16 +1,31 @@
-"""Prometheus exporter: exposition format + the sidecar's /metrics endpoint."""
+"""Prometheus exporter: exposition format (HELP/TYPE metadata, histogram
+series, escaping, dedupe) + the sidecar's /metrics//healthz//varz endpoints."""
 
 from __future__ import annotations
 
 import json
 import pathlib
+import re
 import subprocess
 import sys
 import urllib.error
 import urllib.request
 
-from tieredstorage_tpu.metrics.core import MetricConfig, MetricName, MetricsRegistry
+from tieredstorage_tpu.metrics.core import (
+    Histogram,
+    MetricConfig,
+    MetricName,
+    MetricsRegistry,
+)
 from tieredstorage_tpu.metrics.prometheus import PrometheusExporter, render
+from tieredstorage_tpu.utils.tracing import Tracer
+
+
+def _samples(exposition: str) -> list[str]:
+    return [
+        line for line in exposition.strip().split("\n")
+        if line and not line.startswith("#")
+    ]
 
 
 def test_render_exposition_format():
@@ -34,16 +49,54 @@ def test_render_exposition_format():
     ) in out
 
 
-def test_label_values_are_escaped():
-    # Backslash, quote, and newline in a tag value must stay one
-    # well-formed exposition line or the whole scrape fails to parse.
+def test_help_and_type_metadata_lines():
     registry = MetricsRegistry(MetricConfig())
     registry.add_gauge(
-        MetricName.of("seg-copy", "rsm", tags={"topic": 'a"b\\c\nd'}), lambda: 42
+        MetricName.of("breaker-state", "resilience-metrics",
+                      "0 = closed, 1 = half-open, 2 = open"),
+        lambda: 0,
+    )
+    registry.add_gauge(
+        MetricName.of("rollbacks-total", "rsm"), lambda: 3
+    )
+    out = render([registry])
+    assert ("# HELP resilience_metrics_breaker_state "
+            "0 = closed, 1 = half-open, 2 = open") in out
+    assert "# TYPE resilience_metrics_breaker_state gauge" in out
+    # -total names expose as counters; no HELP line without a description.
+    assert "# TYPE rsm_rollbacks_total counter" in out
+    assert "# HELP rsm_rollbacks_total" not in out
+    # Metadata must precede the samples it describes.
+    lines = out.strip().split("\n")
+    assert lines.index("# TYPE resilience_metrics_breaker_state gauge") \
+        < lines.index("resilience_metrics_breaker_state 0.0")
+
+
+def test_label_values_are_escaped_round_trip():
+    # Backslash, quote, and newline in a tag value must stay one
+    # well-formed exposition line or the whole scrape fails to parse.
+    original = 'a"b\\c\nd'
+    registry = MetricsRegistry(MetricConfig())
+    registry.add_gauge(
+        MetricName.of("seg-copy", "rsm", tags={"topic": original}), lambda: 42
     )
     out = render([registry])
     assert 'topic="a\\"b\\\\c\\nd"' in out, out
-    assert out.count("\n") == 1
+    samples = _samples(out)
+    assert len(samples) == 1  # still exactly one sample line
+    # Round-trip: unescaping the rendered label restores the original value.
+    (escaped,) = re.findall(r'topic="((?:[^"\\]|\\.)*)"', samples[0])
+    unescaped = escaped.replace("\\n", "\n").replace('\\"', '"').replace("\\\\", "\\")
+    assert unescaped == original
+
+
+def test_invalid_chars_sanitized_in_names_and_label_keys():
+    registry = MetricsRegistry(MetricConfig())
+    registry.add_gauge(
+        MetricName.of("weird.name-%", "gr@up", tags={"bad key!": "v"}), lambda: 1
+    )
+    out = render([registry])
+    assert "gr_up_weird_name__{bad_key_=\"v\"} 1.0" in out
 
 
 def test_failing_gauge_does_not_break_scrape():
@@ -55,6 +108,50 @@ def test_failing_gauge_does_not_break_scrape():
     out = render([registry])
     assert "g_ok 1.0" in out
     assert "boom" not in out
+
+
+def test_histogram_renders_bucket_sum_count_with_monotonic_buckets():
+    registry = MetricsRegistry(MetricConfig())
+    h = Histogram(buckets=(1.0, 10.0, 100.0))
+    registry.sensor("lat").add(
+        MetricName.of("fetch-time-ms", "rsm", "fetch latency histogram",
+                      tags={"backend": "s3"}),
+        h,
+    )
+    for v in (0.5, 5.0, 5.0, 50.0, 5000.0):
+        registry.sensor("lat").record(v)
+    out = render([registry])
+    assert "# TYPE rsm_fetch_time_ms histogram" in out
+    assert "# HELP rsm_fetch_time_ms fetch latency histogram" in out
+    buckets = re.findall(
+        r'rsm_fetch_time_ms_bucket\{backend="s3",le="([^"]+)"\} (\d+)', out
+    )
+    assert [b[0] for b in buckets] == ["1", "10", "100", "+Inf"]
+    counts = [int(b[1]) for b in buckets]
+    assert counts == sorted(counts), "histogram buckets must be cumulative"
+    assert counts == [1, 3, 4, 5]
+    assert 'rsm_fetch_time_ms_sum{backend="s3"} 5060.5' in out
+    assert 'rsm_fetch_time_ms_count{backend="s3"} 5' in out
+
+
+def test_identical_series_across_registries_dedupe():
+    def make_registry():
+        registry = MetricsRegistry(MetricConfig())
+        registry.add_gauge(
+            MetricName.of("up", "dup", "exporter liveness"), lambda: 1
+        )
+        return registry
+
+    out = render([make_registry(), make_registry()])
+    assert out.count("dup_up 1.0") == 1
+    assert out.count("# TYPE dup_up gauge") == 1
+    assert out.count("# HELP dup_up exporter liveness") == 1
+    # Distinct label sets under the same name both survive, in one family.
+    r3 = MetricsRegistry(MetricConfig())
+    r3.add_gauge(MetricName.of("up", "dup", tags={"shard": "1"}), lambda: 1)
+    out = render([make_registry(), r3])
+    assert "dup_up 1.0" in out and 'dup_up{shard="1"} 1.0' in out
+    assert out.count("# TYPE dup_up gauge") == 1
 
 
 def test_http_endpoint_serves_metrics():
@@ -72,9 +169,31 @@ def test_http_endpoint_serves_metrics():
             urllib.request.urlopen(
                 f"http://127.0.0.1:{exporter.port}/nope", timeout=10
             )
-            raise AssertionError("non-/metrics path must 404")
+            raise AssertionError("unknown path must 404")
         except urllib.error.HTTPError as err:
             assert err.code == 404
+    finally:
+        exporter.stop()
+
+
+def test_healthz_and_varz_endpoints():
+    tracer = Tracer(enabled=True)
+    with tracer.span("op"):
+        pass
+    exporter = PrometheusExporter(
+        [MetricsRegistry(MetricConfig())], host="127.0.0.1", tracer=tracer
+    ).start()
+    try:
+        base = f"http://127.0.0.1:{exporter.port}"
+        with urllib.request.urlopen(f"{base}/healthz", timeout=10) as resp:
+            assert resp.status == 200 and resp.read() == b"ok\n"
+        with urllib.request.urlopen(f"{base}/varz", timeout=10) as resp:
+            assert resp.headers["Content-Type"].startswith("application/json")
+            varz = json.loads(resp.read())
+        assert varz["tracing"] is True
+        assert varz["recorded_spans"] == 1 and varz["dropped_spans"] == 0
+        assert varz["spans"]["op"]["count"] == 1
+        assert "p99_s" in varz["spans"]["op"]
     finally:
         exporter.stop()
 

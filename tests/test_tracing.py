@@ -71,3 +71,143 @@ def test_jax_profiler_forwarding_smoke(tmp_path):
             pass
     assert [s.name for s in tracer.spans()] == ["inner", "outer"]
     assert tracer.spans("inner")[0].depth == 1
+
+
+def test_event_forwards_to_jax_profiler():
+    """tracer.event() must honor use_jax_profiler like span() does —
+    zero-duration annotations keep timeline parity with spans."""
+    tracer = Tracer(enabled=True, use_jax_profiler=True)
+    s = tracer.event("breaker.trip", reason="threshold")
+    assert s is not None and s.duration_s == 0.0
+    assert tracer.spans("breaker.trip")[0].attributes["reason"] == "threshold"
+
+
+class TestTraceIdentity:
+    def test_nested_spans_share_trace_and_parent_correctly(self):
+        tracer = Tracer(enabled=True)
+        with tracer.span("root"):
+            with tracer.span("child"):
+                tracer.event("leaf")
+        root = tracer.spans("root")[0]
+        child = tracer.spans("child")[0]
+        leaf = tracer.spans("leaf")[0]
+        assert root.trace_id and len(root.trace_id) == 32
+        assert root.parent_id is None
+        assert child.trace_id == root.trace_id
+        assert child.parent_id == root.span_id
+        assert leaf.trace_id == root.trace_id
+        assert leaf.parent_id == child.span_id
+
+    def test_sibling_roots_get_distinct_traces(self):
+        tracer = Tracer(enabled=True)
+        with tracer.span("a"):
+            pass
+        with tracer.span("b"):
+            pass
+        a, b = tracer.spans("a")[0], tracer.spans("b")[0]
+        assert a.trace_id != b.trace_id
+
+    def test_traceparent_format_parse_round_trip(self):
+        from tieredstorage_tpu.utils.tracing import (
+            format_traceparent,
+            parse_traceparent,
+        )
+
+        header = format_traceparent("ab" * 16, "cd" * 8)
+        assert header == f"00-{'ab' * 16}-{'cd' * 8}-01"
+        assert parse_traceparent(header) == ("ab" * 16, "cd" * 8)
+        for bad in (None, "", "00-short-id-01", f"00-{'0' * 32}-{'cd' * 8}-01",
+                    f"00-{'ab' * 16}-{'0' * 16}-01", f"ff-{'ab' * 16}-{'cd' * 8}-01",
+                    "zz-not-hex-at-all"):
+            assert parse_traceparent(bad) is None, bad
+
+    def test_continue_trace_adopts_remote_parent(self):
+        from tieredstorage_tpu.utils.tracing import format_traceparent
+
+        tracer = Tracer(enabled=True)
+        remote_trace, remote_span = "12" * 16, "34" * 8
+        with tracer.continue_trace(format_traceparent(remote_trace, remote_span)):
+            assert tracer.current_traceparent() == format_traceparent(
+                remote_trace, remote_span
+            )
+            with tracer.span("server.op"):
+                pass
+        server = tracer.spans("server.op")[0]
+        assert server.trace_id == remote_trace
+        assert server.parent_id == remote_span
+        # Context is restored: the next root starts a fresh trace.
+        with tracer.span("later"):
+            pass
+        assert tracer.spans("later")[0].trace_id != remote_trace
+
+    def test_continue_trace_with_garbage_is_noop(self):
+        tracer = Tracer(enabled=True)
+        with tracer.continue_trace("totally-not-a-traceparent"):
+            with tracer.span("op"):
+                pass
+        assert tracer.spans("op")[0].parent_id is None
+
+    def test_current_traceparent_reflects_active_span(self):
+        tracer = Tracer(enabled=True)
+        assert tracer.current_traceparent() is None
+        with tracer.span("op") as s:
+            from tieredstorage_tpu.utils.tracing import format_traceparent
+
+            assert tracer.current_traceparent() == format_traceparent(
+                s.trace_id, s.span_id
+            )
+        assert tracer.current_traceparent() is None
+        disabled = Tracer(enabled=False)
+        assert disabled.current_traceparent() is None
+
+
+class TestRingBuffer:
+    def test_ring_buffer_keeps_newest_and_counts_drops(self):
+        tracer = Tracer(enabled=True, max_spans=5)
+        for i in range(8):
+            tracer.event(f"e{i}")
+        assert tracer.recorded_spans == 5
+        assert tracer.dropped_spans == 3
+        assert [s.name for s in tracer.spans()] == [f"e{i}" for i in range(3, 8)]
+
+    def test_clear_resets_drop_counter(self):
+        tracer = Tracer(enabled=True, max_spans=2)
+        for i in range(4):
+            tracer.event(f"e{i}")
+        tracer.clear()
+        assert tracer.recorded_spans == 0 and tracer.dropped_spans == 0
+
+
+class TestSummaryAndExport:
+    def test_summary_percentiles(self):
+        tracer = Tracer(enabled=True)
+        for i in range(100):
+            s = tracer.event("op")
+            s.end_s = s.start_s + (i + 1) / 1000.0  # 1ms..100ms
+        summary = tracer.summary()["op"]
+        assert summary["count"] == 100
+        assert abs(summary["p50_s"] - 0.050) < 1e-9
+        assert abs(summary["p95_s"] - 0.095) < 1e-9
+        assert abs(summary["p99_s"] - 0.099) < 1e-9
+        assert abs(summary["max_s"] - 0.100) < 1e-9
+
+    def test_chrome_trace_export_is_valid_and_loadable(self, tmp_path):
+        import json
+
+        tracer = Tracer(enabled=True)
+        with tracer.span("fetch", topic="t"):
+            tracer.event("breaker.trip")
+        out = tracer.write_chrome_trace(tmp_path / "artifacts" / "trace.json")
+        doc = json.loads(out.read_text())
+        events = doc["traceEvents"]
+        assert len(events) == 2
+        by_name = {e["name"]: e for e in events}
+        fetch, trip = by_name["fetch"], by_name["breaker.trip"]
+        assert fetch["ph"] == "X" and fetch["dur"] > 0
+        assert trip["ph"] == "i" and trip["s"] == "t"
+        for e in events:
+            assert {"name", "ph", "ts", "pid", "tid", "args"} <= set(e)
+        assert fetch["args"]["topic"] == "t"
+        assert trip["args"]["trace_id"] == fetch["args"]["trace_id"]
+        assert trip["args"]["parent_id"] == fetch["args"]["span_id"]
+        assert doc["otherData"]["dropped_spans"] == 0

@@ -125,6 +125,20 @@ def _base_def() -> ConfigDef:
             "(requires tracing.enabled).",
     ))
     d.define(ConfigKey(
+        "tracing.max.spans", "int", default=10_000,
+        validator=in_range(1, None), importance="low",
+        doc="Capacity of the tracer's span ring buffer; once full the oldest "
+            "spans are evicted (counted by the tracer-dropped-spans metric) "
+            "so long soak runs keep the newest spans.",
+    ))
+    d.define(ConfigKey(
+        "tracing.export.path", "string", default=None,
+        validator=non_empty_string, importance="low",
+        doc="Write the recorded spans as Chrome trace-event JSON to this "
+            "path on close() (loadable in Perfetto / chrome://tracing, "
+            "interleavable with jax.profiler device timelines).",
+    ))
+    d.define(ConfigKey(
         "encryption.enabled", "bool", default=False, importance="high",
         doc="Whether to encrypt chunks with per-segment AES-256-GCM data keys.",
     ))
@@ -284,6 +298,14 @@ class RemoteStorageManagerConfig:
     @property
     def tracing_jax_profiler_enabled(self) -> bool:
         return self._values["tracing.jax.profiler.enabled"]
+
+    @property
+    def tracing_max_spans(self) -> int:
+        return self._values["tracing.max.spans"]
+
+    @property
+    def tracing_export_path(self) -> Optional[str]:
+        return self._values["tracing.export.path"]
 
     @property
     def compression_enabled(self) -> bool:

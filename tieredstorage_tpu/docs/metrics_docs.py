@@ -21,9 +21,22 @@ def _collect_rsm() -> dict[str, list[str]]:
     m.record_segment_delete_time("topic", 0, 1.0)
     m.record_segment_delete_error("topic", 0)
     m.record_segment_fetch_requested_bytes("topic", 0, 1)
+    m.record_segment_fetch_time("topic", 0, 1.0)
+    m.record_chunk_fetch(1.0, 1)
+    m.record_cache_get(1.0)
     m.record_object_upload("topic", 0, "log", 1)
     m.record_upload_rollback("topic", 0)
     return _group_names(m.registry)
+
+
+def _collect_tracer() -> dict[str, list[str]]:
+    from tieredstorage_tpu.metrics.core import MetricsRegistry
+    from tieredstorage_tpu.metrics.rsm_metrics import register_tracer_metrics
+    from tieredstorage_tpu.utils.tracing import Tracer
+
+    registry = MetricsRegistry()
+    register_tracer_metrics(registry, Tracer())
+    return _group_names(registry)
 
 
 def _collect_resilience() -> dict[str, list[str]]:
@@ -125,10 +138,19 @@ def generate() -> str:
         out.extend([title, underline * len(title), ""])
 
     section("Tiered Storage TPU metrics", "=")
+    out.extend([
+        "Names ending in ``-ms`` are log-scale-bucket latency histograms: the",
+        "Prometheus endpoint serves them as ``_bucket`` (cumulative ``le``",
+        "labels), ``_sum``, and ``_count`` series; all other names are gauges",
+        "or windowed rate/avg/max stats. See ``docs/tracing.rst`` for the",
+        "request-tracing layer these histograms summarize.",
+        "",
+    ])
     for heading, collected in [
         ("RemoteStorageManager metrics", _collect_rsm()),
         ("Cache and thread-pool metrics", _collect_caches()),
         ("Resilience metrics", _collect_resilience()),
+        ("Tracer metrics", _collect_tracer()),
         ("Storage backend client metrics", _collect_backends()),
     ]:
         section(heading)

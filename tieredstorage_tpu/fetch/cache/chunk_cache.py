@@ -29,6 +29,7 @@ from tieredstorage_tpu.fetch.chunk_manager import ChunkManager
 from tieredstorage_tpu.manifest.segment_manifest import SegmentManifestV1
 from tieredstorage_tpu.storage.core import ObjectKey
 from tieredstorage_tpu.utils.caching import LoadingCache, RemovalCause
+from tieredstorage_tpu.utils.tracing import NOOP_TRACER
 
 log = logging.getLogger(__name__)
 
@@ -59,6 +60,12 @@ class ChunkCacheTimeoutException(RuntimeError):
 class ChunkCache(ChunkManager, Generic[T], abc.ABC):
     """Wraps a delegate ChunkManager; subclasses define the cached form T
     (bytes in memory, Path on disk)."""
+
+    #: Span recorder; the RSM swaps in its configured tracer.
+    tracer = NOOP_TRACER
+    #: Optional latency hook `(elapsed_ms)` per window read; the RSM wires it
+    #: to Metrics.record_cache_get.
+    on_get = None
 
     def __init__(self, delegate: ChunkManager) -> None:
         self._delegate = delegate
@@ -128,6 +135,16 @@ class ChunkCache(ChunkManager, Generic[T], abc.ABC):
         whole window is bounded by ONE `get.timeout.ms` deadline."""
         if not chunk_ids:
             return []
+        start = time.monotonic()
+        with self.tracer.span("cache.get_chunks", chunks=len(chunk_ids)):
+            out = self._get_chunks_timed(objects_key, manifest, chunk_ids)
+        if self.on_get is not None:
+            self.on_get((time.monotonic() - start) * 1000.0)
+        return out
+
+    def _get_chunks_timed(
+        self, objects_key: ObjectKey, manifest: SegmentManifestV1, chunk_ids: Sequence[int]
+    ) -> list[bytes]:
         deadline = time.monotonic() + self._config.get_timeout_s
         self._start_prefetching(objects_key, manifest, chunk_ids[-1])
         futures = self._populate_window(objects_key, manifest, chunk_ids, deadline)
@@ -270,9 +287,14 @@ class ChunkCache(ChunkManager, Generic[T], abc.ABC):
         and the LoadingCache drops failed loads, so the entries stay clean
         for the next foreground get."""
         try:
-            self._populate_window(objects_key, manifest, ids, None)
+            # Prefetch runs on a pool worker: its spans are roots of their own
+            # trace (the requesting thread's context is deliberately not
+            # captured — the prefetch outlives the request).
+            with self.tracer.span("cache.prefetch", chunks=len(ids)):
+                self._populate_window(objects_key, manifest, ids, None)
         except Exception:
             self.prefetch_failures += 1
+            self.tracer.event("cache.prefetch_failure", chunks=len(ids))
             log.debug("Prefetch of chunks %s of %s failed", list(ids), objects_key,
                       exc_info=True)
 

@@ -26,6 +26,7 @@ from tieredstorage_tpu.storage.core import (
 )
 from tieredstorage_tpu.transform.api import DetransformOptions, TransformBackend
 from tieredstorage_tpu.utils.streams import read_exactly
+from tieredstorage_tpu.utils.tracing import NOOP_TRACER
 
 log = logging.getLogger(__name__)
 
@@ -55,6 +56,13 @@ class ChunkManager(abc.ABC):
 class DefaultChunkManager(ChunkManager):
     #: How long a key stays quarantined after a detransform failure.
     DEFAULT_QUARANTINE_TTL_S = 60.0
+
+    #: Span recorder; the RSM swaps in its configured tracer so the storage
+    #: GET and detransform stages land in the request's trace tree.
+    tracer = NOOP_TRACER
+    #: Optional latency hook `(elapsed_ms, plaintext_bytes)` per batch; the
+    #: RSM wires it to Metrics.record_chunk_fetch.
+    on_fetch: Optional[Callable[[float, int], None]] = None
 
     def __init__(
         self,
@@ -97,6 +105,7 @@ class DefaultChunkManager(ChunkManager):
         with self._quarantine_lock:
             self.corruptions += 1
             self._quarantine[key.value] = (self._now() + self.quarantine_ttl_s, reason)
+        self.tracer.event("chunk.quarantine", key=key.value, reason=reason)
         log.warning("Quarantining %s for %.0fs: %s", key, self.quarantine_ttl_s, reason)
 
     def get_chunk(
@@ -110,29 +119,43 @@ class DefaultChunkManager(ChunkManager):
         if len(chunk_ids) == 0:
             return []
         self._check_quarantine(objects_key)
+        start = time.monotonic()
         index = manifest.chunk_index
         chunks = [index._chunk_at(cid) for cid in chunk_ids]
         contiguous = all(
             chunks[i + 1].id == chunks[i].id + 1 for i in range(len(chunks) - 1)
         )
-        if contiguous:
-            # One ranged GET covering the whole window on the transformed side.
-            whole = BytesRange.of(
-                chunks[0].transformed_position,
-                chunks[-1].transformed_position + chunks[-1].transformed_size - 1,
-            )
-            with self._fetcher.fetch(objects_key, whole) as stream:
+        with self.tracer.span(
+            "storage.fetch_chunks", key=objects_key.value, chunks=len(chunks),
+        ) as fetch_span:
+            if contiguous:
+                # One ranged GET covering the window on the transformed side.
+                whole = BytesRange.of(
+                    chunks[0].transformed_position,
+                    chunks[-1].transformed_position + chunks[-1].transformed_size - 1,
+                )
+                with self._fetcher.fetch(objects_key, whole) as stream:
+                    stored = []
+                    for c in chunks:
+                        stored.append(read_exactly(stream, c.transformed_size))
+            else:
                 stored = []
                 for c in chunks:
-                    stored.append(read_exactly(stream, c.transformed_size))
-        else:
-            stored = []
-            for c in chunks:
-                with self._fetcher.fetch(objects_key, c.range()) as stream:
-                    stored.append(read_exactly(stream, c.transformed_size))
+                    with self._fetcher.fetch(objects_key, c.range()) as stream:
+                        stored.append(read_exactly(stream, c.transformed_size))
+            stored_bytes = sum(len(b) for b in stored)
+            if fetch_span is not None:
+                fetch_span.attributes["bytes"] = stored_bytes
         opts = DetransformOptions.from_manifest(manifest)
         try:
-            return self._backend.detransform(stored, opts)
+            with self.tracer.span(
+                "chunk.detransform", chunks=len(stored), bytes_in=stored_bytes,
+            ) as span:
+                out = self._backend.detransform(stored, opts)
+                if span is not None:
+                    # Per-stage byte throughput: stored (transformed) bytes in,
+                    # plaintext bytes out.
+                    span.attributes["bytes_out"] = sum(len(b) for b in out)
         except Exception as e:
             # Any detransform failure (AuthenticationError on a GCM tag
             # mismatch, CRC/frame errors from the codecs) means the stored
@@ -142,3 +165,8 @@ class DefaultChunkManager(ChunkManager):
             raise CorruptChunkException(
                 f"Detransform failed for chunks {list(chunk_ids)} of {objects_key}"
             ) from e
+        if self.on_fetch is not None:
+            self.on_fetch(
+                (time.monotonic() - start) * 1000.0, sum(len(b) for b in out)
+            )
+        return out

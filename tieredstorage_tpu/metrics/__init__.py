@@ -1,6 +1,7 @@
 from tieredstorage_tpu.metrics.core import (
     Avg,
     Count,
+    Histogram,
     Max,
     MetricConfig,
     MetricName,
@@ -12,6 +13,6 @@ from tieredstorage_tpu.metrics.core import (
 from tieredstorage_tpu.metrics.rsm_metrics import METRIC_GROUP, Metrics
 
 __all__ = [
-    "Avg", "Count", "Max", "MetricConfig", "MetricName", "MetricsRegistry",
-    "Rate", "Sensor", "Total", "Metrics", "METRIC_GROUP",
+    "Avg", "Count", "Histogram", "Max", "MetricConfig", "MetricName",
+    "MetricsRegistry", "Rate", "Sensor", "Total", "Metrics", "METRIC_GROUP",
 ]

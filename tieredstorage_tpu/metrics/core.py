@@ -13,10 +13,11 @@ point-in-time snapshot in place of JMX.
 
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 from dataclasses import dataclass
-from typing import Callable, Mapping, Optional
+from typing import Callable, Mapping, Optional, Sequence
 
 
 @dataclass(frozen=True)
@@ -77,6 +78,85 @@ class Count(Stat):
 
     def measure(self, config: MetricConfig, now: float) -> float:
         return float(self._count)
+
+
+class Histogram(Stat):
+    """Fixed-bucket cumulative latency histogram (Prometheus histogram shape).
+
+    Buckets are inclusive upper bounds (`le` semantics); the default ladder is
+    log-scale — 0.25·2^i for i in 0..19, i.e. 0.25 ms to ~131 s when recording
+    milliseconds — so one fixed layout covers cache hits through cold
+    multi-GiB segment copies at ~2x relative error. Unlike the windowed
+    SampledStats, a histogram is cumulative for the process lifetime (the
+    Prometheus model: the scraper differentiates)."""
+
+    DEFAULT_BUCKETS: tuple[float, ...] = tuple(0.25 * 2**i for i in range(20))
+
+    def __init__(self, buckets: Optional[Sequence[float]] = None) -> None:
+        self._bounds: tuple[float, ...] = tuple(
+            sorted(self.DEFAULT_BUCKETS if buckets is None else buckets)
+        )
+        # One overflow slot past the last bound (the +Inf bucket).
+        self._counts = [0] * (len(self._bounds) + 1)
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def record(self, value: float, now: float) -> None:
+        idx = bisect.bisect_left(self._bounds, value)
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += value
+            self._count += 1
+
+    def measure(self, config: MetricConfig, now: float) -> float:
+        """Snapshot value: total observation count (the `_count` series)."""
+        return float(self._count)
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    def buckets(self) -> list[tuple[float, int]]:
+        """Cumulative (upper_bound, count) pairs, ending with (+Inf, count)."""
+        with self._lock:
+            counts = list(self._counts)
+        out: list[tuple[float, int]] = []
+        running = 0
+        for bound, n in zip(self._bounds, counts):
+            running += n
+            out.append((bound, running))
+        out.append((float("inf"), running + counts[-1]))
+        return out
+
+    def quantile(self, q: float) -> float:
+        """Bucket-interpolated quantile estimate (0 when empty). The answer is
+        exact only up to bucket resolution — the same contract as a
+        `histogram_quantile` over the exported series."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        cumulative = self.buckets()
+        total = cumulative[-1][1]
+        if total == 0:
+            return 0.0
+        rank = q * total
+        prev_bound, prev_count = 0.0, 0
+        for bound, count in cumulative:
+            if count >= rank:
+                if bound == float("inf"):
+                    return prev_bound
+                if count == prev_count:
+                    return bound
+                frac = (rank - prev_count) / (count - prev_count)
+                return prev_bound + (bound - prev_bound) * frac
+            prev_bound, prev_count = bound, count
+        return prev_bound
 
 
 @dataclass
@@ -279,6 +359,12 @@ class MetricsRegistry:
         if isinstance(m, Stat):
             return m.measure(self.config, self.time())
         return float(m())
+
+    def stat(self, metric_name: MetricName):
+        """The registered Stat (or gauge supplier) behind a metric — exporters
+        that need more than a scalar (histogram buckets) read through this."""
+        with self._lock:
+            return self._metrics[metric_name]
 
     def find(self, name: str, tags: Optional[Mapping[str, str]] = None) -> list[MetricName]:
         want = tuple(sorted((tags or {}).items()))

@@ -15,9 +15,20 @@ sensor-name scheme :438-470). Families:
 - upload-rollbacks-rate/-total (orphan cleanup after a failed copy; this
   build's addition — the reference logs rollbacks but doesn't count them)
 
+This build's additions beyond the reference's avg/max gauges: every `-time`
+family also records into a log-scale-bucket `Histogram` (`<base>-ms`,
+aggregate scope only to bound label cardinality), exported by the Prometheus
+endpoint as `_bucket`/`_sum`/`_count` series, and three fetch-tier latency
+families the reference can't see at all — `remote-fetch-time` (the
+fetch_log_segment request path), `chunk-fetch-time`/`chunk-fetch-bytes`
+(per ranged GET + detransform batch), and `cache-get-time` (chunk-cache
+window reads).
+
 Plus `register_resilience_metrics`: gauges for the circuit breaker, fault
 injection, degraded cache, and quarantine states (group
-`resilience-metrics`), shared between the RSM and the docs generator.
+`resilience-metrics`), and `register_tracer_metrics`: ring-buffer health of
+the distributed tracer (group `tracer-metrics`); both shared between the RSM
+and the docs generator.
 """
 
 from __future__ import annotations
@@ -27,6 +38,7 @@ from typing import Mapping, Optional
 from tieredstorage_tpu.metrics.core import (
     Avg,
     Count,
+    Histogram,
     Max,
     MetricConfig,
     MetricName,
@@ -37,6 +49,7 @@ from tieredstorage_tpu.metrics.core import (
 
 METRIC_GROUP = "remote-storage-manager-metrics"
 RESILIENCE_METRIC_GROUP = "resilience-metrics"
+TRACER_METRIC_GROUP = "tracer-metrics"
 
 
 class Metrics:
@@ -78,10 +91,26 @@ class Metrics:
             (MetricName.of(base + "-max", METRIC_GROUP, tags=tags), Max()),
         ]).record(ms)
 
+    def _histogram(self, base: str, ms: float) -> None:
+        """Aggregate-scope latency histogram (`<base>-ms`): log-scale buckets,
+        Prometheus `_bucket`/`_sum`/`_count` exposition. Aggregate only —
+        per-topic-partition histograms would multiply the bucket ladder by
+        every tag scope."""
+        self.registry.sensor(f"{base}.histogram").ensure_stats(lambda: [
+            (
+                MetricName.of(
+                    base + "-ms", METRIC_GROUP,
+                    f"{base} latency histogram (ms, log-scale buckets)",
+                ),
+                Histogram(),
+            ),
+        ]).record(ms)
+
     # ------------------------------------------------------------- recordings
     def record_segment_copy_time(self, topic: str, partition: int, ms: float) -> None:
         for tags in self._scopes(topic, partition):
             self._time("segment-copy-time", tags, ms)
+        self._histogram("segment-copy-time", ms)
 
     def record_segment_delete(self, topic: str, partition: int, n_bytes: int) -> None:
         for tags in self._scopes(topic, partition):
@@ -91,6 +120,7 @@ class Metrics:
     def record_segment_delete_time(self, topic: str, partition: int, ms: float) -> None:
         for tags in self._scopes(topic, partition):
             self._time("segment-delete-time", tags, ms)
+        self._histogram("segment-delete-time", ms)
 
     def record_segment_delete_error(self, topic: str, partition: int) -> None:
         for tags in self._scopes(topic, partition):
@@ -101,6 +131,25 @@ class Metrics:
     ) -> None:
         for tags in self._scopes(topic, partition):
             self._rate_total("segment-fetch-requested-bytes", tags, float(n_bytes))
+
+    def record_segment_fetch_time(self, topic: str, partition: int, ms: float) -> None:
+        """Latency of the fetch_log_segment request path (manifest resolve +
+        range mapping; the chunk transfer itself is lazy and lands in
+        chunk-fetch-time as the consumer drains the stream)."""
+        for tags in self._scopes(topic, partition):
+            self._time("remote-fetch-time", tags, ms)
+        self._histogram("remote-fetch-time", ms)
+
+    def record_chunk_fetch(self, ms: float, n_bytes: int) -> None:
+        """One chunk-manager batch: ranged storage GET + batched detransform."""
+        self._time("chunk-fetch-time", {}, ms)
+        self._histogram("chunk-fetch-time", ms)
+        self._rate_total("chunk-fetch-bytes", {}, float(n_bytes))
+
+    def record_cache_get(self, ms: float) -> None:
+        """One chunk-cache window read (hits + misses + fallback fetches)."""
+        self._time("cache-get-time", {}, ms)
+        self._histogram("cache-get-time", ms)
 
     def record_upload_rollback(self, topic: str, partition: int) -> None:
         """A failed copy's partial objects were (best-effort) deleted."""
@@ -160,3 +209,22 @@ def register_resilience_metrics(
               lambda: float(chunk_manager.corruptions))
         gauge("quarantined-keys", lambda: float(chunk_manager.quarantined_keys),
               "Object keys currently quarantined after detransform failures")
+
+
+def register_tracer_metrics(registry: MetricsRegistry, tracer) -> None:
+    """Ring-buffer health of the distributed tracer (group `tracer-metrics`):
+    soak runs watch `tracer-dropped-spans` to know the recorder wrapped."""
+    registry.add_gauge(
+        MetricName.of(
+            "tracer-dropped-spans", TRACER_METRIC_GROUP,
+            "Spans evicted from the tracer ring buffer (newest spans are kept)",
+        ),
+        lambda: float(tracer.dropped_spans),
+    )
+    registry.add_gauge(
+        MetricName.of(
+            "tracer-recorded-spans", TRACER_METRIC_GROUP,
+            "Spans currently held in the tracer ring buffer",
+        ),
+        lambda: float(tracer.recorded_spans),
+    )

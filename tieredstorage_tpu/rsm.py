@@ -49,7 +49,11 @@ from tieredstorage_tpu.metrics.cache_metrics import (
     register_thread_pool_metrics,
 )
 from tieredstorage_tpu.metrics.core import MetricConfig
-from tieredstorage_tpu.metrics.rsm_metrics import Metrics, register_resilience_metrics
+from tieredstorage_tpu.metrics.rsm_metrics import (
+    Metrics,
+    register_resilience_metrics,
+    register_tracer_metrics,
+)
 from tieredstorage_tpu.object_key import ObjectKeyFactory, Suffix
 from tieredstorage_tpu.security.aes import AesEncryptionProvider, DataKeyAndAAD
 from tieredstorage_tpu.security.rsa import RsaEncryptionProvider
@@ -119,6 +123,7 @@ class RemoteStorageManager:
         self.tracer = Tracer(
             enabled=config.tracing_enabled,
             use_jax_profiler=config.tracing_jax_profiler_enabled,
+            max_spans=config.tracing_max_spans,
         )
 
         storage = config.storage_backend_class()
@@ -142,6 +147,7 @@ class RemoteStorageManager:
             self._rate_bucket = TokenBucket(config.upload_rate_limit)
 
         self._chunk_manager = self._build_chunk_manager(backend)
+        self._wire_fetch_observability()
 
         self._manifest_cache = MemorySegmentManifestCache()
         self._manifest_cache.configure(config.fetch_manifest_cache_configs())
@@ -149,6 +155,19 @@ class RemoteStorageManager:
         self._indexes_cache.configure(config.fetch_indexes_cache_configs())
         self._register_cache_metrics()
         self._register_resilience_metrics()
+        register_tracer_metrics(self._metrics.registry, self.tracer)
+
+    def _wire_fetch_observability(self) -> None:
+        """Hand the configured tracer + latency hooks to the fetch tier so
+        chunk-fetch/detransform/cache-get land in traces and histograms."""
+        cm = self._chunk_manager
+        inner = cm._delegate if isinstance(cm, ChunkCache) else cm
+        if isinstance(inner, DefaultChunkManager):
+            inner.tracer = self.tracer
+            inner.on_fetch = self._metrics.record_chunk_fetch
+        if isinstance(cm, ChunkCache):
+            cm.tracer = self.tracer
+            cm.on_get = self._metrics.record_cache_get
 
     def _wrap_storage_resilience(
         self, config: RemoteStorageManagerConfig, storage: StorageBackend
@@ -337,7 +356,9 @@ class RemoteStorageManager:
         config = self._config
         key = self._object_key_factory.key(metadata, Suffix.LOG)
         file_size = Path(segment_data.log_segment).stat().st_size
-        with open(segment_data.log_segment, "rb") as source:
+        with self.tracer.span(
+            "rsm.upload.segment", bytes=file_size, key=key.value,
+        ) as span, open(segment_data.log_segment, "rb") as source:
             transformation = SegmentTransformation(
                 source, file_size, config.chunk_size,
                 self._transform_backend,
@@ -348,6 +369,8 @@ class RemoteStorageManager:
                 stream = RateLimitedStream(stream, self._rate_bucket)
             uploaded_keys.append(key)
             uploaded = self._storage.upload(stream, key)
+            if span is not None:
+                span.attributes["bytes_uploaded"] = uploaded
         custom_builder.add_upload_result(Suffix.LOG, uploaded)
         self._record_upload(metadata, Suffix.LOG, uploaded)
         log.debug("Uploaded segment log for %s, size: %d", metadata, uploaded)
@@ -360,6 +383,14 @@ class RemoteStorageManager:
         are concatenated into one `.indexes` object (reference :287-354,
         transformIndex :455-490; empty indexes record size 0 and upload no
         bytes)."""
+        with self.tracer.span("rsm.upload.indexes"):
+            return self._upload_indexes_traced(
+                metadata, segment_data, data_key, custom_builder, uploaded_keys
+            )
+
+    def _upload_indexes_traced(
+        self, metadata, segment_data: LogSegmentData, data_key, custom_builder, uploaded_keys
+    ):
         builder = SegmentIndexesV1Builder()
         parts: list[bytes] = []
 
@@ -427,7 +458,8 @@ class RemoteStorageManager:
         text = manifest_to_json(manifest, data_key_encoder=encoder)
         key = self._object_key_factory.key(metadata, Suffix.MANIFEST)
         uploaded_keys.append(key)
-        uploaded = self._storage.upload(io.BytesIO(text.encode("utf-8")), key)
+        with self.tracer.span("rsm.upload.manifest", bytes=len(text)):
+            uploaded = self._storage.upload(io.BytesIO(text.encode("utf-8")), key)
         custom_builder.add_upload_result(Suffix.MANIFEST, uploaded)
         self._record_upload(metadata, Suffix.MANIFEST, uploaded)
         log.debug("Uploaded segment manifest for %s, size: %d", metadata, uploaded)
@@ -443,11 +475,18 @@ class RemoteStorageManager:
 
     def fetch_segment_manifest(self, metadata: RemoteLogSegmentMetadata) -> SegmentManifestV1:
         key = self._object_key(metadata, Suffix.MANIFEST)
-        return self._manifest_cache.get(key, lambda: self._fetch_manifest_by_key(key))
+        # Request-thread span: covers the cache hit or the wait on the
+        # cache's loader pool (the storage GET itself runs on that pool and
+        # records its own storage.fetch_manifest root span).
+        with self.tracer.span("rsm.fetch_manifest", key=key.value):
+            return self._manifest_cache.get(
+                key, lambda: self._fetch_manifest_by_key(key)
+            )
 
     def _fetch_manifest_by_key(self, key: ObjectKey) -> SegmentManifestV1:
         try:
-            with self._storage.fetch(key) as stream:
+            with self.tracer.span("storage.fetch_manifest", key=key.value), \
+                    self._storage.fetch(key) as stream:
                 text = stream.read()
         except KeyNotFoundException as e:
             raise RemoteResourceNotFoundException(str(e)) from e
@@ -480,6 +519,7 @@ class RemoteStorageManager:
             raise ValueError(
                 f"endPosition {end_position} must be >= startPosition {start_position}"
             )
+        start = time.monotonic()
         try:
             manifest = self.fetch_segment_manifest(metadata)
             file_size = manifest.chunk_index.original_file_size
@@ -497,9 +537,15 @@ class RemoteStorageManager:
                 topic, partition, byte_range.size
             )
             key = self._object_key(metadata, Suffix.LOG)
-            return FetchChunkEnumeration(
+            stream = FetchChunkEnumeration(
                 self._chunk_manager, key, manifest, byte_range
             ).to_stream()
+            # Latency of the synchronous request path (manifest + range
+            # mapping); the lazy chunk transfer lands in chunk-fetch-time.
+            self._metrics.record_segment_fetch_time(
+                topic, partition, (time.monotonic() - start) * 1000.0
+            )
+            return stream
         except (RemoteStorageException, InvalidStartPosition):
             raise
         except KeyNotFoundException as e:
@@ -578,6 +624,10 @@ class RemoteStorageManager:
         RemoteStorageException after the sweep finishes."""
         if self._storage is None or not keys:
             return
+        with self.tracer.span("storage.delete_keys", keys=len(keys)):
+            self._delete_keys_traced(keys)
+
+    def _delete_keys_traced(self, keys: list[ObjectKey]) -> None:
         try:
             self._storage.delete_all(keys)
             return
@@ -598,6 +648,14 @@ class RemoteStorageManager:
             ) from failures[0][1]
 
     def close(self) -> None:
+        if self._config is not None and self._config.tracing_export_path:
+            try:
+                self.tracer.write_chrome_trace(self._config.tracing_export_path)
+            except OSError:
+                log.warning(
+                    "Failed to export Chrome trace to %s",
+                    self._config.tracing_export_path, exc_info=True,
+                )
         if self._chunk_manager is not None and hasattr(self._chunk_manager, "close"):
             self._chunk_manager.close()
         if self._manifest_cache is not None:

@@ -28,6 +28,7 @@ from tieredstorage_tpu.manifest.segment_indexes import IndexType
 from tieredstorage_tpu.metadata import LogSegmentData, RemoteLogSegmentMetadata
 from tieredstorage_tpu.sidecar import rpc
 from tieredstorage_tpu.sidecar import sidecar_pb2 as pb
+from tieredstorage_tpu.utils.tracing import NOOP_TRACER
 
 #: gRPC codes that mean "the sidecar can't serve right now" — the failover
 #: triggers; anything else is a real answer and must propagate.
@@ -54,9 +55,14 @@ def _raise_mapped(err: grpc.RpcError):
 
 
 class SidecarRsmClient:
-    def __init__(self, target: str, *, timeout: Optional[float] = None):
+    def __init__(self, target: str, *, timeout: Optional[float] = None,
+                 tracer=None):
         self._channel = grpc.insecure_channel(target, options=rpc.channel_options())
         self._timeout = timeout
+        # Client-side spans + traceparent metadata: a fetch through the
+        # sidecar shows up as ONE tree (client.fetch → sidecar.Fetch →
+        # rsm.fetch_log_segment → storage.*) instead of two disjoint traces.
+        self._tracer = tracer if tracer is not None else NOOP_TRACER
         self._stubs = {}
         for name, m in rpc.METHODS.items():
             make = (
@@ -70,9 +76,18 @@ class SidecarRsmClient:
                 response_deserializer=m.response.FromString,
             )
 
+    def _invoke(self, name: str, req, timeout: Optional[float] = None):
+        """Unary call inside a client span, traceparent metadata attached
+        (computed INSIDE the span so the server parents under it)."""
+        with self._tracer.span(f"client.{name}"):
+            return self._stubs[name](
+                req, timeout=timeout or self._timeout,
+                metadata=rpc.trace_metadata(self._tracer),
+            )
+
     # ------------------------------------------------------------- surface
     def health(self, timeout: Optional[float] = None) -> None:
-        self._stubs["Health"](pb.Empty(), timeout=timeout or self._timeout)
+        self._invoke("Health", pb.Empty(), timeout=timeout)
 
     def copy_log_segment_data(
         self, metadata: RemoteLogSegmentMetadata, data: LogSegmentData
@@ -89,7 +104,7 @@ class SidecarRsmClient:
             req.transaction_index = data.transaction_index.read_bytes()
             req.has_transaction_index = True
         try:
-            resp = self._stubs["Copy"](req, timeout=self._timeout)
+            resp = self._invoke("Copy", req)
         except grpc.RpcError as err:
             _raise_mapped(err)
         return bytes(resp.custom_metadata)
@@ -118,9 +133,8 @@ class SidecarRsmClient:
 
     def delete_log_segment_data(self, metadata: RemoteLogSegmentMetadata) -> None:
         try:
-            self._stubs["Delete"](
-                pb.DeleteRequest(metadata=rpc.metadata_to_proto(metadata)),
-                timeout=self._timeout,
+            self._invoke(
+                "Delete", pb.DeleteRequest(metadata=rpc.metadata_to_proto(metadata))
             )
         except grpc.RpcError as err:
             _raise_mapped(err)
@@ -132,8 +146,14 @@ class SidecarRsmClient:
     def _drain(self, name: str, req) -> BinaryIO:
         buf = io.BytesIO()
         try:
-            for chunk in self._stubs[name](req, timeout=self._timeout):
-                buf.write(chunk.data)
+            with self._tracer.span(f"client.{name}") as span:
+                for chunk in self._stubs[name](
+                    req, timeout=self._timeout,
+                    metadata=rpc.trace_metadata(self._tracer),
+                ):
+                    buf.write(chunk.data)
+                if span is not None:
+                    span.attributes["bytes"] = buf.tell()
         except grpc.RpcError as err:
             _raise_mapped(err)
         buf.seek(0)

@@ -23,6 +23,7 @@ from tieredstorage_tpu.errors import RemoteResourceNotFoundException
 from tieredstorage_tpu.manifest.segment_indexes import IndexType
 from tieredstorage_tpu.metadata import LogSegmentData
 from tieredstorage_tpu.sidecar import shimwire
+from tieredstorage_tpu.utils.tracing import NOOP_TRACER
 
 _STREAM_BLOCK = 1 << 20
 #: Spool request bodies to disk past this (copy uploads are whole segments).
@@ -196,8 +197,16 @@ class _Handler(BaseHTTPRequestHandler):
             self._fail(exc)
             self.close_connection = True
             return
+        # Join the caller's trace (W3C traceparent header, sent by the JVM
+        # shim or a Python client) and record the gateway leg as one span —
+        # the span covers the streamed response too, so time-to-last-byte of
+        # a fetch is the gateway span's extent.
+        tracer = getattr(self.rsm, "tracer", NOOP_TRACER)
         try:
-            with contextlib.closing(body):
+            with contextlib.closing(body), \
+                    tracer.continue_trace(
+                        self.headers.get(shimwire.TRACEPARENT_HEADER)), \
+                    tracer.span("gateway" + self.path.replace("/v1/", ".")):
                 handler(body)
         except _StreamAborted:
             # Response already committed; the only safe move is dropping

@@ -10,6 +10,7 @@ serializers. protoc itself generates sidecar_pb2 (see sidecar.proto).
 from __future__ import annotations
 
 import dataclasses
+from typing import Optional
 
 from tieredstorage_tpu.sidecar import sidecar_pb2 as pb
 
@@ -40,6 +41,18 @@ METHODS = {
         Method("Health", pb.Empty, pb.Empty),
     )
 }
+
+#: gRPC invocation-metadata key carrying the W3C trace context — the gRPC
+#: twin of the HTTP gateway's `traceparent` header (shimwire.TRACEPARENT_HEADER).
+TRACEPARENT_KEY = "traceparent"
+
+
+def trace_metadata(tracer) -> Optional[tuple[tuple[str, str], ...]]:
+    """Invocation metadata joining a call to the active trace, or None when
+    there is nothing to propagate (tracing disabled / no active span)."""
+    traceparent = tracer.current_traceparent() if tracer is not None else None
+    return ((TRACEPARENT_KEY, traceparent),) if traceparent else None
+
 
 #: Per-message ceiling for unary payloads (whole segments ride CopyRequest).
 MAX_MESSAGE_BYTES = 512 << 20

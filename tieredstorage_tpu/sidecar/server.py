@@ -27,6 +27,7 @@ from tieredstorage_tpu.manifest.segment_indexes import IndexType
 from tieredstorage_tpu.metadata import LogSegmentData
 from tieredstorage_tpu.sidecar import rpc
 from tieredstorage_tpu.sidecar import sidecar_pb2 as pb
+from tieredstorage_tpu.utils.tracing import NOOP_TRACER
 
 
 class SidecarServer:
@@ -34,6 +35,7 @@ class SidecarServer:
         self, rsm, *, port: int = 0, host: str = "127.0.0.1", max_workers: int = 8
     ):
         self._rsm = rsm
+        self._tracer = getattr(rsm, "tracer", NOOP_TRACER)
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers),
             options=rpc.channel_options(),
@@ -69,15 +71,18 @@ class SidecarServer:
                 else grpc.unary_unary_rpc_method_handler
             )
             handlers[name] = make(
-                self._guard(impls[name], streaming=method.server_streaming),
+                self._guard(impls[name], name=name,
+                            streaming=method.server_streaming),
                 request_deserializer=method.request.FromString,
                 response_serializer=method.response.SerializeToString,
             )
         return grpc.method_handlers_generic_handler(rpc.SERVICE, handlers)
 
-    @staticmethod
-    def _guard(fn, *, streaming: bool):
-        """Map RSM exceptions to gRPC status codes (also mid-stream)."""
+    def _guard(self, fn, *, name: str, streaming: bool):
+        """Map RSM exceptions to gRPC status codes (also mid-stream), and
+        join the caller's trace: `traceparent` invocation metadata (sent by
+        SidecarRsmClient) parents the server-side span under the client's."""
+        tracer = self._tracer
 
         def classify(exc: Exception):
             if isinstance(exc, RemoteResourceNotFoundException):
@@ -86,19 +91,29 @@ class SidecarServer:
                 return grpc.StatusCode.INVALID_ARGUMENT
             return grpc.StatusCode.INTERNAL
 
+        def traceparent_of(context):
+            for key, value in context.invocation_metadata() or ():
+                if key == rpc.TRACEPARENT_KEY:
+                    return value
+            return None
+
         if streaming:
             def wrapped(request, context):
-                try:
-                    yield from fn(request, context)
-                except Exception as exc:  # noqa: BLE001 — boundary translation
-                    context.abort(classify(exc), f"{type(exc).__name__}: {exc}")
+                with tracer.continue_trace(traceparent_of(context)), \
+                        tracer.span(f"sidecar.{name}"):
+                    try:
+                        yield from fn(request, context)
+                    except Exception as exc:  # noqa: BLE001 — boundary translation
+                        context.abort(classify(exc), f"{type(exc).__name__}: {exc}")
 
         else:
             def wrapped(request, context):
-                try:
-                    return fn(request, context)
-                except Exception as exc:  # noqa: BLE001 — boundary translation
-                    context.abort(classify(exc), f"{type(exc).__name__}: {exc}")
+                with tracer.continue_trace(traceparent_of(context)), \
+                        tracer.span(f"sidecar.{name}"):
+                    try:
+                        return fn(request, context)
+                    except Exception as exc:  # noqa: BLE001 — boundary translation
+                        context.abort(classify(exc), f"{type(exc).__name__}: {exc}")
 
         return wrapped
 
@@ -205,8 +220,11 @@ def main(argv: Optional[list[str]] = None) -> None:
 
         # Bind the exporter to the same interface as the gRPC side: a
         # loopback-only sidecar must not expose metrics network-wide.
+        # The RSM's tracer rides along so /varz serves the span summary
+        # (p50/p95/p99 per name) next to /metrics and /healthz.
         exporter = PrometheusExporter(
-            [rsm.metrics.registry], port=args.metrics_port, host=args.host
+            [rsm.metrics.registry], port=args.metrics_port, host=args.host,
+            tracer=rsm.tracer,
         ).start()
     gateway = None
     if args.http_port is not None:

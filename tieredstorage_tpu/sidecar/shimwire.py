@@ -43,6 +43,11 @@ Requests (POST bodies; responses are raw bytes or empty):
 Errors: 404 = RemoteResourceNotFoundException, 400 = invalid argument,
 500 = anything else; the body is a UTF-8 message. The Java shim maps these
 back onto the KIP-405 exception types.
+
+Trace context deliberately rides the standard W3C ``traceparent`` HTTP
+header, NOT the binary frame: wire version 1 stays byte-stable, and the JVM
+shim can join broker-side traces with one `setHeader` (java.net.http passes
+unknown headers through untouched, so older shims interoperate unchanged).
 """
 
 from __future__ import annotations
@@ -60,6 +65,18 @@ from tieredstorage_tpu.metadata import (
 )
 
 VERSION = 1
+
+#: W3C trace-context header joining shim requests to the caller's trace
+#: (see module docstring: headers, not frame bytes, carry trace identity).
+TRACEPARENT_HEADER = "traceparent"
+
+
+def trace_headers(tracer) -> dict[str, str]:
+    """Headers a shim-wire client should attach to join the active trace;
+    empty when there is nothing to propagate (tracing disabled / no span)."""
+    traceparent = tracer.current_traceparent() if tracer is not None else None
+    return {TRACEPARENT_HEADER: traceparent} if traceparent else {}
+
 
 COPY_SECTIONS = (
     "log_segment",

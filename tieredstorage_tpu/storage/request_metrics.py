@@ -8,7 +8,10 @@ requests (rate+total), time (avg+max); error classes: throttling (429/503),
 server (other 5xx), io (transport failures) — names after
 storage/s3/.../MetricRegistry.java:26-70. The HttpClient observer fires per
 ATTEMPT, so retried throttles/errors are each counted like the reference's
-per-attempt SDK metrics.
+per-attempt SDK metrics. Beyond the reference's avg/max, every `-time`
+family also records into a log-scale `Histogram` (`<op>-time-ms`), so the
+Prometheus endpoint serves per-backend request tail latencies as
+`_bucket`/`_sum`/`_count` series.
 """
 
 from __future__ import annotations
@@ -17,6 +20,7 @@ from typing import Callable, Optional
 
 from tieredstorage_tpu.metrics.core import (
     Avg,
+    Histogram,
     Max,
     MetricName,
     MetricsRegistry,
@@ -56,6 +60,13 @@ class RequestMetricCollector:
             lambda: [
                 (MetricName.of(f"{op}-time-avg", group), Avg()),
                 (MetricName.of(f"{op}-time-max", group), Max()),
+                (
+                    MetricName.of(
+                        f"{op}-time-ms", group,
+                        f"{op} request latency histogram (ms, per attempt)",
+                    ),
+                    Histogram(),
+                ),
             ]
         )
         return sensor

@@ -48,16 +48,33 @@ from tieredstorage_tpu.transform.api import (
 )
 
 
-def _spanned(name: str, count=len):
+def _spanned(name: str, count=len, n_bytes=None):
     """Trace a backend stage; `count` maps the first positional arg to the
     span's chunks attribute (mirrors rsm._traced — one wrapper, no _inner
-    twins a caller could bypass)."""
+    twins a caller could bypass). Byte throughput per stage: `n_bytes` maps
+    the first arg to bytes_in (default: summed chunk lengths when the arg is
+    a chunk list), and a chunk-list result is summed into bytes_out."""
+
+    def chunk_bytes(value):
+        if isinstance(value, (list, tuple)) and value and isinstance(
+            value[0], (bytes, bytearray, memoryview)
+        ):
+            return sum(len(c) for c in value)
+        return None
 
     def deco(fn):
         @functools.wraps(fn)
         def wrapper(self, arg, *args, **kwargs):
-            with self.tracer.span(name, chunks=count(arg)):
-                return fn(self, arg, *args, **kwargs)
+            with self.tracer.span(name, chunks=count(arg)) as span:
+                out = fn(self, arg, *args, **kwargs)
+                if span is not None:
+                    bytes_in = (n_bytes or chunk_bytes)(arg)
+                    if bytes_in is not None:
+                        span.attributes["bytes_in"] = bytes_in
+                    bytes_out = chunk_bytes(out)
+                    if bytes_out is not None:
+                        span.attributes["bytes_out"] = bytes_out
+                return out
 
         return wrapper
 
@@ -231,7 +248,8 @@ class TpuTransformBackend(TransformBackend):
                 pass  # non-jax arrays (mocked backends) / platforms without it
         return ivs, sizes, ct, tags
 
-    @_spanned("transform.encrypt_finish", count=lambda staged: len(staged[1]))
+    @_spanned("transform.encrypt_finish", count=lambda staged: len(staged[1]),
+              n_bytes=lambda staged: sum(staged[1]))
     def _encrypt_finish(self, staged) -> list[bytes]:
         """Block on a staged window's device arrays and materialize the wire
         format (IV || ct || tag per chunk)."""
