@@ -1,7 +1,7 @@
 # Developer entry points (counterpart of /root/reference/Makefile).
 PYTHON ?= python
 
-.PHONY: test test-e2e chaos bench demo trace-demo docs docker lint mutation clean
+.PHONY: test test-e2e chaos bench demo trace-demo scrub-demo docs docker lint mutation clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q --ignore=tests/e2e
@@ -26,6 +26,14 @@ demo:
 # written to artifacts/trace.json and validated as Chrome trace-event JSON.
 trace-demo:
 	$(PYTHON) tools/trace_demo.py --out artifacts/trace.json
+
+# Integrity-scrubber gate: seeded FaultSchedule damages a filesystem-backed
+# store at rest (corrupt byte, truncation, deleted object, orphan); one scrub
+# pass must detect 100% of it with zero false positives, repair everything
+# from a shadow source, and a second pass must come back clean. Writes and
+# re-validates artifacts/scrub_report.json.
+scrub-demo:
+	$(PYTHON) tools/scrub_demo.py --out artifacts/scrub_report.json
 
 docs:
 	$(PYTHON) -m tieredstorage_tpu.docs.configs_docs > docs/configs.rst

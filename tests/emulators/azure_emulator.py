@@ -167,7 +167,10 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if not self._check_auth(0):
             return
-        container, blob, _query = self._split()
+        container, blob, query = self._split()
+        if query.get("comp") == "list":
+            self._list_blobs(container, query)
+            return
         with self.state.lock:
             data = self.state.blobs.get((container, blob))
         if data is None:
@@ -194,6 +197,28 @@ class _Handler(BaseHTTPRequestHandler):
             )
             return
         self._reply(200, data)
+
+    def _list_blobs(self, container: str, query: dict[str, str]) -> None:
+        """List Blobs: lexicographic names, marker pagination (the marker is
+        the last name of the previous page)."""
+        prefix = query.get("prefix", "")
+        max_results = min(int(query.get("maxresults", "1000")), 1000)
+        marker = query.get("marker", "")
+        with self.state.lock:
+            names = sorted(
+                n for (c, n) in self.state.blobs
+                if c == container and n.startswith(prefix)
+            )
+        if marker:
+            names = [n for n in names if n > marker]
+        page, rest = names[:max_results], names[max_results:]
+        root = ET.Element("EnumerationResults")
+        blobs_el = ET.SubElement(root, "Blobs")
+        for n in page:
+            blob_el = ET.SubElement(blobs_el, "Blob")
+            ET.SubElement(blob_el, "Name").text = n
+        ET.SubElement(root, "NextMarker").text = page[-1] if rest else ""
+        self._reply(200, ET.tostring(root, encoding="utf-8", xml_declaration=True))
 
     def do_DELETE(self) -> None:
         if self._maybe_fail():

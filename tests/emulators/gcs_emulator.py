@@ -163,6 +163,10 @@ class _Handler(BaseHTTPRequestHandler):
             return
         parts = urlsplit(self.path)
         query = parse_qs(parts.query)
+        listing = re.fullmatch(r"/storage/v1/b/([^/]+)/o/?", parts.path)
+        if listing:
+            self._list_objects(listing.group(1), query)
+            return
         m = re.fullmatch(r"/storage/v1/b/([^/]+)/o/(.+)", parts.path)
         if not m:
             self._reply(404, b'{"error": "bad path"}')
@@ -195,6 +199,28 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(200, data)
             return
         self._json(200, {"name": name, "bucket": bucket, "size": str(len(data))})
+
+    def _list_objects(self, bucket: str, query: dict[str, list[str]]) -> None:
+        """JSON-API object listing: lexicographic names, paged via pageToken
+        (the last name of the previous page)."""
+        prefix = unquote(query.get("prefix", [""])[0])
+        max_results = min(int(query.get("maxResults", ["1000"])[0]), 1000)
+        token = unquote(query.get("pageToken", [""])[0])
+        with self.state.lock:
+            names = sorted(
+                n for (b, n) in self.state.objects
+                if b == bucket and n.startswith(prefix)
+            )
+        if token:
+            names = [n for n in names if n > token]
+        page, rest = names[:max_results], names[max_results:]
+        doc: dict = {
+            "kind": "storage#objects",
+            "items": [{"name": n} for n in page],
+        }
+        if rest:
+            doc["nextPageToken"] = page[-1]
+        self._json(200, doc)
 
     def do_DELETE(self) -> None:
         if self._maybe_fail():

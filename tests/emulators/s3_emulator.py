@@ -174,7 +174,10 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if not self._verify_sigv4():
             return
-        bucket, key, _query = self._split()
+        bucket, key, query = self._split()
+        if "list-type" in query:
+            self._list_objects(bucket, query)
+            return
         with self.state.lock:
             data = self.state.objects.get((bucket, key))
         if data is None:
@@ -200,6 +203,32 @@ class _Handler(BaseHTTPRequestHandler):
             )
             return
         self._reply(200, data)
+
+    def _list_objects(self, bucket: str, query: dict[str, list[str]]) -> None:
+        """ListObjectsV2: lexicographic keys, 1000-key pages, opaque
+        continuation tokens (the last key of the previous page)."""
+        prefix = query.get("prefix", [""])[0]
+        max_keys = min(int(query.get("max-keys", ["1000"])[0]), 1000)
+        token = query.get("continuation-token", [""])[0]
+        with self.state.lock:
+            keys = sorted(
+                k for (b, k) in self.state.objects
+                if b == bucket and k.startswith(prefix)
+            )
+        if token:
+            keys = [k for k in keys if k > token]
+        page, rest = keys[:max_keys], keys[max_keys:]
+        root = ET.Element("ListBucketResult")
+        ET.SubElement(root, "Name").text = bucket
+        ET.SubElement(root, "Prefix").text = prefix
+        ET.SubElement(root, "KeyCount").text = str(len(page))
+        ET.SubElement(root, "IsTruncated").text = "true" if rest else "false"
+        if rest:
+            ET.SubElement(root, "NextContinuationToken").text = page[-1]
+        for k in page:
+            contents = ET.SubElement(root, "Contents")
+            ET.SubElement(contents, "Key").text = k
+        self._reply(200, ET.tostring(root, encoding="utf-8", xml_declaration=True))
 
     def do_DELETE(self) -> None:
         if self._maybe_fail():

@@ -113,3 +113,38 @@ class StorageContract:
             assert s.read() == data
         with backend.fetch(KEY, BytesRange.of_from_position_and_size(100_000, 5000)) as s:
             assert s.read() == data[100_000:105_000]
+
+    # ------------------------------------------------------- list_objects
+    # Conformance for the scrubber's enumeration leg (ObjectLister): every
+    # backend must filter by string prefix, yield lexicographic order, and
+    # return an EMPTY iteration (never KeyNotFoundException) for unmatched
+    # prefixes and empty stores.
+
+    def test_list_objects_returns_all_keys_sorted(self, backend):
+        keys = ["b/2", "a/1", "b/1", "a/10"]
+        for k in keys:
+            backend.upload(io.BytesIO(b"v"), ObjectKey(k))
+        assert [k.value for k in backend.list_objects()] == sorted(keys)
+
+    def test_list_objects_prefix_filters(self, backend):
+        for k in ("seg/0001.log", "seg/0001.rsm-manifest", "other/x"):
+            backend.upload(io.BytesIO(b"v"), ObjectKey(k))
+        assert [k.value for k in backend.list_objects("seg/")] == [
+            "seg/0001.log", "seg/0001.rsm-manifest",
+        ]
+        # A prefix may end mid-component, not only at '/'.
+        assert [k.value for k in backend.list_objects("seg/0001.l")] == [
+            "seg/0001.log"
+        ]
+
+    def test_list_objects_empty_listing_is_not_an_error(self, backend):
+        assert list(backend.list_objects()) == []
+        backend.upload(io.BytesIO(b"v"), KEY)
+        assert list(backend.list_objects("no/such/prefix")) == []
+
+    def test_list_objects_reflects_deletes(self, backend):
+        a, b = ObjectKey("list/a"), ObjectKey("list/b")
+        backend.upload(io.BytesIO(b"v"), a)
+        backend.upload(io.BytesIO(b"v"), b)
+        backend.delete(a)
+        assert [k.value for k in backend.list_objects("list/")] == ["list/b"]

@@ -199,3 +199,23 @@ def test_preflight_works_under_a_jit_trace(monkeypatch):
 
     traced(jnp.zeros(4))
     assert verdicts == [True]
+
+
+class TestTstpuAesRValidation:
+    """TSTPU_AES_R mis-tiles the ShiftRows un-stack silently on the
+    TIEREDSTORAGE_TPU_PALLAS=1 forced path (no preflight cross-check runs
+    there), so the override must be validated at import: power of two in
+    [8, 256] or fail loud."""
+
+    @pytest.mark.parametrize("r", ["8", "16", "32", "64", "128", "256"])
+    def test_valid_tiles_accepted(self, r):
+        from tieredstorage_tpu.ops.aes_pallas import _validated_r
+
+        assert _validated_r(r) == int(r)
+
+    @pytest.mark.parametrize("r", ["12", "24", "0", "4", "-8", "512", "7", "x", "8.0"])
+    def test_mistiled_r_rejected(self, r):
+        from tieredstorage_tpu.ops.aes_pallas import _validated_r
+
+        with pytest.raises(ValueError):
+            _validated_r(r)

@@ -355,3 +355,42 @@ class TestManifestSerde:
     def test_unsupported_version_rejected(self):
         with pytest.raises(ValueError):
             manifest_from_json(json.dumps({"version": "2"}))
+
+    def test_chunk_checksums_round_trip(self):
+        """Scrubber ground truth: per-chunk CRC32C rides the manifest as
+        base64 of big-endian uint32s, aligned with the chunk index."""
+        crcs = [0, 1, 0xDEADBEEF, 0xFFFFFFFF]
+        m = SegmentManifestV1(
+            chunk_index=VariableSizeChunkIndex(100, 350, [30, 20, 10, 40]),
+            segment_indexes=_segment_indexes(),
+            compression=True,
+            chunk_checksums=crcs,
+        )
+        obj = json.loads(manifest_to_json(m))
+        assert base64.b64decode(obj["chunkChecksums"]) == b"".join(
+            c.to_bytes(4, "big") for c in crcs
+        )
+        back = manifest_from_json(json.dumps(obj))
+        assert back.chunk_checksums == crcs
+        assert back == m
+
+    def test_chunk_checksums_absent_for_reference_compat(self):
+        m = SegmentManifestV1(
+            chunk_index=FixedSizeChunkIndex(100, 250, 110, 80),
+            segment_indexes=_segment_indexes(),
+            compression=False,
+        )
+        obj = json.loads(manifest_to_json(m))
+        assert "chunkChecksums" not in obj
+        assert manifest_from_json(json.dumps(obj)).chunk_checksums is None
+
+    def test_chunk_checksums_misaligned_blob_rejected(self):
+        m = SegmentManifestV1(
+            chunk_index=FixedSizeChunkIndex(100, 250, 110, 80),
+            segment_indexes=_segment_indexes(),
+            compression=False,
+        )
+        obj = json.loads(manifest_to_json(m))
+        obj["chunkChecksums"] = base64.b64encode(b"\x00" * 5).decode()
+        with pytest.raises(ValueError):
+            manifest_from_json(json.dumps(obj))

@@ -7,7 +7,12 @@ import zlib
 
 import numpy as np
 
-from tieredstorage_tpu.ops.crc32c import crc32c_chunks, crc32c_host, crc32c_reference
+from tieredstorage_tpu.ops.crc32c import (
+    crc32c_batch,
+    crc32c_chunks,
+    crc32c_host,
+    crc32c_reference,
+)
 
 
 def test_reference_check_value():
@@ -38,6 +43,41 @@ def test_large_batch():
     assert [hex(v) for v in got] == [
         hex(crc32c_reference(row.tobytes())) for row in data
     ]
+
+
+class TestCrc32cBatch:
+    """The scrubber's verify primitive: heterogeneous chunk batches, device
+    path for big same-length groups (LEFT-zero-padded — crc0-preserving),
+    host table for small ones; every path must agree with the bitwise
+    oracle."""
+
+    def test_mixed_lengths_and_empty(self):
+        rng = np.random.default_rng(3)
+        chunks = [
+            rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+            for n in (0, 1, 15, 16, 17, 255, 1024, 1024, 4095)
+        ]
+        assert crc32c_batch(chunks) == [crc32c_reference(c) for c in chunks]
+
+    def test_device_path_aligned_group(self):
+        # 32 × 4096 clears _BATCH_MIN_BYTES → batched kernel, no padding.
+        chunks = [secrets.token_bytes(4096) for _ in range(32)]
+        assert crc32c_batch(chunks) == [crc32c_reference(c) for c in chunks]
+
+    def test_device_path_left_padded_group(self):
+        # Non-16-multiple length through the kernel exercises the
+        # crc0(0^k||M) = crc0(M) left-pad identity and the length-offset swap.
+        chunks = [secrets.token_bytes(4100) for _ in range(32)]
+        assert crc32c_batch(chunks) == [crc32c_reference(c) for c in chunks]
+
+    def test_detects_single_bit_flip(self):
+        blob = secrets.token_bytes(2048)
+        flipped = blob[:100] + bytes([blob[100] ^ 0x01]) + blob[101:]
+        a, b = crc32c_batch([blob, flipped])
+        assert a != b
+
+    def test_empty_batch(self):
+        assert crc32c_batch([]) == []
 
 
 def test_host_table_crc_matches_bitwise_reference():

@@ -225,8 +225,12 @@ class TestS3FaultInjection:
 
     def test_fetch_honors_http_date_retry_after(self, emulator, backend):
         """Live drive of the RFC 9110 HTTP-date form: a 503 carrying
-        'Retry-After: <date ~2s out>' must floor the backoff to that date
-        (policy backoff alone is ~1ms here, so wall time proves it)."""
+        'Retry-After: <date ~3s out>' must floor the backoff to that date
+        (policy backoff alone is ~1ms here, so wall time proves it).
+        3s, not 2s: format_datetime truncates sub-second precision, so the
+        parsed date can land up to ~1s earlier than now+N — with N=2 the
+        effective floor could brush the assertion's lower bound (a latent
+        flake); N=3 keeps ≥2s of margin."""
         import time as _time
         from datetime import datetime, timedelta, timezone
         from email.utils import format_datetime
@@ -236,7 +240,7 @@ class TestS3FaultInjection:
         )
         key = ObjectKey("retry/date.log")
         backend.upload(io.BytesIO(b"y" * 32), key)
-        when = datetime.now(timezone.utc) + timedelta(seconds=2)
+        when = datetime.now(timezone.utc) + timedelta(seconds=3)
         emulator.inject_error(
             503, "SlowDown",
             when=lambda m, p: m == "GET" and "date.log" in p,
@@ -246,8 +250,8 @@ class TestS3FaultInjection:
         with backend.fetch(key) as s:
             assert s.read() == b"y" * 32
         elapsed = _time.monotonic() - t0
-        assert 1.0 <= elapsed <= 10.0, (
-            f"expected ~2s Retry-After floor, waited {elapsed:.2f}s"
+        assert 1.5 <= elapsed <= 10.0, (
+            f"expected ~3s Retry-After floor, waited {elapsed:.2f}s"
         )
 
     def test_fetch_survives_429_throttle_and_counts_it(self, emulator, backend):

@@ -267,6 +267,32 @@ class TestGatewayContract:
         finally:
             conn.close()
 
+    @pytest.mark.parametrize("raw", [b"\xb2", b"7\xb2", b"\xb9\xb2\xb3"])
+    def test_non_ascii_digit_content_length_maps_to_400(self, gateway, raw):
+        """Latin-1 digit characters beyond ASCII ('²', '¹'…) pass
+        str.isdigit() — the old gate — but are outside the RFC's 1*DIGIT
+        grammar; the explicit ASCII allowlist must send them down the
+        ShimWireError 400 path. Sent over a raw socket: http.client refuses
+        to emit such headers itself."""
+        import socket
+
+        with socket.create_connection(("127.0.0.1", gateway.port), timeout=10) as sock:
+            sock.sendall(
+                b"POST /v1/delete HTTP/1.1\r\n"
+                b"Host: 127.0.0.1\r\n"
+                b"Content-Length: " + raw + b"\r\n"
+                b"\r\n"
+            )
+            sock.settimeout(10)
+            response = b""
+            while b"bad Content-Length" not in response:
+                block = sock.recv(4096)
+                if not block:
+                    break
+                response += block
+        assert b"400" in response.split(b"\r\n", 1)[0]
+        assert b"bad Content-Length" in response
+
     @pytest.mark.parametrize("size_line", [b"-5", b"+5", b"0x1f", b"1_0", b""])
     def test_non_canonical_chunk_size_maps_to_400(self, gateway, size_line):
         """int(_, 16) alone accepts "-5"/"+5"/"0x1f"/"1_0"; negatives would

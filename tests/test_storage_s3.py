@@ -54,6 +54,34 @@ class TestS3Storage(StorageContract):
         return make_backend(emulator)
 
 
+class TestS3ListPagination:
+    """ListObjectsV2 continuation-token paging: the emulator caps pages at
+    1000 keys, so a 1050-key bucket takes two pages and the client must chain
+    NextContinuationToken transparently. Keys are injected straight into the
+    emulator state — 1050 signed PUTs would only slow the suite down."""
+
+    def test_list_beyond_one_page(self, emulator):
+        backend = make_backend(emulator)
+        with emulator.state.lock:
+            emulator.state.objects.clear()
+            for i in range(1050):
+                emulator.state.objects[("test-bucket", f"page/{i:06d}")] = b""
+            emulator.state.objects[("test-bucket", "other/x")] = b""
+        keys = [k.value for k in backend.list_objects("page/")]
+        assert len(keys) == 1050
+        assert keys == sorted(keys)
+        assert keys[0] == "page/000000" and keys[-1] == "page/001049"
+        assert len([k for k in backend.list_objects()]) == 1051
+
+    def test_page_boundary_exact_multiple(self, emulator):
+        backend = make_backend(emulator)
+        with emulator.state.lock:
+            emulator.state.objects.clear()
+            for i in range(1000):
+                emulator.state.objects[("test-bucket", f"exact/{i:06d}")] = b""
+        assert len(list(backend.list_objects("exact/"))) == 1000
+
+
 class TestS3Multipart:
     def test_multipart_upload_splits_into_parts(self, emulator):
         backend = make_backend(emulator)

@@ -172,7 +172,7 @@ def _base_def() -> ConfigDef:
         "fault.schedule", "list", default=[], validator=_valid_fault_schedule,
         importance="low",
         doc="Deterministic fault rules 'op:action[=arg][@trigger]' with op in "
-            "[upload, fetch, delete, *], action in [raise, key-not-found, "
+            "[upload, fetch, delete, list, *], action in [raise, key-not-found, "
             "delay, truncate, corrupt], trigger '@N' (Nth call), '@every=K', "
             "or '@p=P' (seeded probability). E.g. 'upload:raise@3, "
             "fetch:corrupt=7@1'.",
@@ -199,6 +199,39 @@ def _base_def() -> ConfigDef:
         validator=in_range(1, None), importance="medium",
         doc="How long the breaker stays open before allowing a half-open "
             "probe request through.",
+    ))
+    d.define(ConfigKey(
+        "scrub.enabled", "bool", default=False, importance="medium",
+        doc="Run the background integrity scrubber (scrub/): periodic "
+            "passes enumerate stored objects, cross-check them against "
+            "manifests, verify chunk CRC32C / GCM round-trips, and "
+            "quarantine or repair what fails.",
+    ))
+    d.define(ConfigKey(
+        "scrub.interval.ms", "long", default=300_000,
+        validator=in_range(1, None), importance="medium",
+        doc="Period between scrub passes; the first pass starts after a "
+            "random jitter in [0, interval) so restarting fleets don't "
+            "synchronize their scrub load.",
+    ))
+    d.define(ConfigKey(
+        "scrub.rate.bytes", "int", default=8 * 1024 * 1024,
+        validator=null_or(in_range(16 * 1024, INT_MAX)), importance="medium",
+        doc="Scrub read budget in bytes/s (token bucket) so scrubbing never "
+            "starves foreground fetches; null disables throttling.",
+    ))
+    d.define(ConfigKey(
+        "scrub.repair.enabled", "bool", default=False, importance="medium",
+        doc="Let the scrubber heal what it can: orphan objects are deleted, "
+            "corrupt/missing objects are re-uploaded when a repair source "
+            "is wired (Scrubber.repair_source).",
+    ))
+    d.define(ConfigKey(
+        "scrub.checksums.enabled", "bool", default=False, importance="medium",
+        doc="Record CRC32C of every transformed chunk in the manifest "
+            "(chunkChecksums) at upload, giving scrub passes at-rest ground "
+            "truth without detransforming. Adds one batched CRC pass per "
+            "upload window (ops/crc32c).",
     ))
     d.define(ConfigKey(
         "metrics.num.samples", "int", default=2, validator=in_range(1, None), importance="low",
@@ -362,6 +395,26 @@ class RemoteStorageManagerConfig:
     @property
     def breaker_cooldown_ms(self) -> int:
         return self._values["breaker.cooldown.ms"]
+
+    @property
+    def scrub_enabled(self) -> bool:
+        return self._values["scrub.enabled"]
+
+    @property
+    def scrub_interval_ms(self) -> int:
+        return self._values["scrub.interval.ms"]
+
+    @property
+    def scrub_rate_bytes(self) -> Optional[int]:
+        return self._values["scrub.rate.bytes"]
+
+    @property
+    def scrub_repair_enabled(self) -> bool:
+        return self._values["scrub.repair.enabled"]
+
+    @property
+    def scrub_checksums_enabled(self) -> bool:
+        return self._values["scrub.checksums.enabled"]
 
     @property
     def metrics_num_samples(self) -> int:

@@ -58,6 +58,21 @@ def _collect_resilience() -> dict[str, list[str]]:
     return _group_names(registry)
 
 
+def _collect_scrub() -> dict[str, list[str]]:
+    from tieredstorage_tpu.metrics.core import MetricsRegistry
+    from tieredstorage_tpu.scrub.metrics import ScrubMetrics, register_scrub_metrics
+    from tieredstorage_tpu.scrub.scheduler import ScrubScheduler
+    from tieredstorage_tpu.scrub.scrubber import Scrubber, ScrubReport
+
+    registry = MetricsRegistry()
+    scrubber = Scrubber(None)
+    register_scrub_metrics(
+        registry, scrubber, ScrubScheduler(scrubber, interval_ms=1000)
+    )
+    ScrubMetrics(registry).record_pass(ScrubReport())
+    return _group_names(registry)
+
+
 def _collect_caches() -> dict[str, list[str]]:
     from tieredstorage_tpu.metrics.cache_metrics import (
         DiskCacheMetrics,
@@ -150,6 +165,7 @@ def generate() -> str:
         ("RemoteStorageManager metrics", _collect_rsm()),
         ("Cache and thread-pool metrics", _collect_caches()),
         ("Resilience metrics", _collect_resilience()),
+        ("Scrubber metrics", _collect_scrub()),
         ("Tracer metrics", _collect_tracer()),
         ("Storage backend client metrics", _collect_backends()),
     ]:

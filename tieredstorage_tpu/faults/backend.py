@@ -141,5 +141,11 @@ class FaultInjectingBackend(StorageBackend):
         self._apply("delete", key)
         self._delegate.delete(key)
 
+    def list_objects(self, prefix: str = ""):
+        # Listing faults fail/slow the whole enumeration; data actions are
+        # fetch-only and cannot fire here (schedule-level validation).
+        self._apply("list", ObjectKey(prefix))
+        return self._delegate.list_objects(prefix)
+
     def __str__(self) -> str:
         return f"FaultInjectingBackend{{delegate={self._delegate}}}"

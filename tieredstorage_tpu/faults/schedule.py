@@ -4,7 +4,7 @@ A schedule is a list of rules, each written as
 
     op ":" action ["=" arg] ["@" trigger]
 
-- op: ``upload`` | ``fetch`` | ``delete`` | ``*`` (any operation)
+- op: ``upload`` | ``fetch`` | ``delete`` | ``list`` | ``*`` (any operation)
 - action:
     - ``raise`` — raise FaultInjectedException (a StorageBackendException)
     - ``key-not-found`` — raise KeyNotFoundException for the requested key
@@ -40,7 +40,7 @@ from typing import Iterable, Optional, Sequence, Union
 
 from tieredstorage_tpu.storage.core import StorageBackendException
 
-OPS = ("upload", "fetch", "delete")
+OPS = ("upload", "fetch", "delete", "list")
 ACTIONS = ("raise", "key-not-found", "delay", "truncate", "corrupt")
 #: Actions that mutate fetched bytes instead of failing the call.
 DATA_ACTIONS = ("truncate", "corrupt")
@@ -51,7 +51,7 @@ class FaultInjectedException(StorageBackendException):
 
 
 _RULE_RE = re.compile(
-    r"(?P<op>\*|upload|fetch|delete)\s*:\s*(?P<action>[a-z-]+)"
+    r"(?P<op>\*|upload|fetch|delete|list)\s*:\s*(?P<action>[a-z-]+)"
     r"(?:\s*=\s*(?P<arg>\d+))?(?:\s*@\s*(?P<trigger>[a-z0-9.=]+))?"
 )
 

@@ -108,6 +108,12 @@ class DefaultChunkManager(ChunkManager):
         self.tracer.event("chunk.quarantine", key=key.value, reason=reason)
         log.warning("Quarantining %s for %.0fs: %s", key, self.quarantine_ttl_s, reason)
 
+    def quarantine(self, key: ObjectKey, reason: str) -> None:
+        """External quarantine hook: the scrubber routes objects it finds
+        corrupt at rest through the same gate a detransform failure takes,
+        so fetches fail fast instead of re-reading poisoned bytes."""
+        self._quarantine_key(key, reason)
+
     def get_chunk(
         self, objects_key: ObjectKey, manifest: SegmentManifestV1, chunk_id: int
     ) -> BinaryIO:

@@ -59,6 +59,18 @@ class SegmentIndexesV1:
             IndexType.TRANSACTION: self.transaction,
         }[index_type]
 
+    def all_indexes(self) -> tuple[Optional[SegmentIndexV1], ...]:
+        """Every slot in wire order (transaction may be None); the scrubber
+        sums sizes over this to know the expected `.indexes` object size."""
+        return (
+            self.offset, self.timestamp, self.producer_snapshot,
+            self.leader_epoch, self.transaction,
+        )
+
+    @property
+    def total_size(self) -> int:
+        return sum(si.size for si in self.all_indexes() if si is not None)
+
     def to_json(self) -> dict:
         def one(si: Optional[SegmentIndexV1]):
             return None if si is None else {"position": si.position, "size": si.size}

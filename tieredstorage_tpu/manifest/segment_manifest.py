@@ -52,6 +52,11 @@ class SegmentManifestV1:
     # unchanged and manifests this framework writes with zstd stay readable
     # by the reference.
     compression_codec: Optional[str] = None
+    # Extension: CRC32C of each stored (transformed) chunk, aligned with the
+    # chunk index, written when `scrub.checksums.enabled` — the background
+    # scrubber's at-rest integrity ground truth. Absent on reference
+    # manifests (they rely on the object store's checksums alone).
+    chunk_checksums: Optional[list[int]] = None
 
 
 def manifest_to_json(
@@ -66,6 +71,10 @@ def manifest_to_json(
     }
     if manifest.compression_codec and manifest.compression_codec != "zstd":
         obj["compressionCodec"] = manifest.compression_codec
+    if manifest.chunk_checksums is not None:
+        obj["chunkChecksums"] = base64.b64encode(
+            b"".join(c.to_bytes(4, "big") for c in manifest.chunk_checksums)
+        ).decode("ascii")
     if manifest.encryption is not None:
         if data_key_encoder is None:
             raise ValueError("Manifest has encryption metadata but no data key encoder given")
@@ -95,6 +104,14 @@ def manifest_from_json(
             data_key=data_key_decoder(enc["dataKey"]),
             aad=base64.b64decode(enc["aad"]),
         )
+    checksums = None
+    if obj.get("chunkChecksums") is not None:
+        raw = base64.b64decode(obj["chunkChecksums"])
+        if len(raw) % 4:
+            raise ValueError(f"chunkChecksums length {len(raw)} is not a multiple of 4")
+        checksums = [
+            int.from_bytes(raw[i : i + 4], "big") for i in range(0, len(raw), 4)
+        ]
     return SegmentManifestV1(
         chunk_index=chunk_index_from_json(obj["chunkIndex"]),
         segment_indexes=SegmentIndexesV1.from_json(obj["segmentIndexes"]),
@@ -102,4 +119,5 @@ def manifest_from_json(
         encryption=encryption,
         remote_log_segment_metadata=None,  # write-only field, like the reference
         compression_codec=obj.get("compressionCodec"),
+        chunk_checksums=checksums,
     )

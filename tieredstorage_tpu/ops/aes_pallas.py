@@ -37,7 +37,27 @@ from tieredstorage_tpu.ops.aes_bitsliced import _sbox_planes, _tower
 #: TSTPU_AES_R overrides for on-chip tile sweeps (tools/probe_min.py):
 #: larger R = more words per vector op and fewer grid steps, at the price
 #: of R/8 vregs live per plane.
-R = int(os.environ.get("TSTPU_AES_R", "8"))
+
+
+def _validated_r(raw: str) -> int:
+    """The ShiftRows un-stack slices the (16R, 128) sublane stack at R-row
+    boundaries; an R that isn't a power-of-two multiple of 8 mis-tiles those
+    slices and — on the TIEREDSTORAGE_TPU_PALLAS=1 forced path, which skips
+    the preflight cross-check — would corrupt keystream silently. Fail loud
+    at import instead."""
+    try:
+        r = int(raw)
+    except ValueError as e:
+        raise ValueError(f"TSTPU_AES_R={raw!r} is not an integer") from e
+    if r < 8 or r > 256 or r & (r - 1):
+        raise ValueError(
+            f"TSTPU_AES_R={raw!r} must be a power of two in [8, 256] "
+            "(sublane tiling of the ShiftRows un-stack)"
+        )
+    return r
+
+
+R = _validated_r(os.environ.get("TSTPU_AES_R", "8"))
 WORDS_PER_STEP = R * 128
 
 

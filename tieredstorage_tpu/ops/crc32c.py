@@ -18,6 +18,7 @@ manifest can carry per chunk).
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -203,6 +204,50 @@ def crc32c_chunks_device(data, leaf_t, level_mats, chunk_bytes, levels, length_o
     weights = jnp.asarray((1 << np.arange(31, -1, -1)).astype(np.uint32))
     vals = jnp.sum(bits.astype(jnp.uint32) * weights, axis=1)
     return vals ^ jnp.uint32(length_offset)
+
+
+#: Below this many total bytes in a same-length group, the jit dispatch costs
+#: more than the table loop; the host path takes over.
+_BATCH_MIN_BYTES = 1 << 16
+
+
+def crc32c_batch(chunks) -> list[int]:
+    """CRC32C of each chunk in a heterogeneous batch (the scrubber's verify
+    primitive).
+
+    Same-length groups are LEFT-zero-padded to a 16-byte multiple and reduced
+    through the MXU log-tree in one `crc32c_chunks` call — left padding is
+    free for the math (crc0(0^k || M) = crc0(M), since Z^k(0) = 0 and the
+    zero prefix contributes nothing), so only the length-offset term needs
+    swapping: crc(M) = kernel(0^k||M) ^ crc(0^lenP) ^ crc(0^lenM). Small
+    groups fall back to the table-driven host CRC, so CPU-only deployments
+    (and tiny scrub batches) never pay a device dispatch.
+    """
+    chunks = list(chunks)
+    out: list[Optional[int]] = [None] * len(chunks)
+    groups: dict[int, list[int]] = {}
+    for i, c in enumerate(chunks):
+        groups.setdefault(len(c), []).append(i)
+    for length, idxs in groups.items():
+        if length == 0:
+            for i in idxs:
+                out[i] = 0  # crc32c(b"") == 0
+            continue
+        padded = -(-length // 16) * 16
+        if length * len(idxs) < _BATCH_MIN_BYTES:
+            for i in idxs:
+                out[i] = crc32c_host(chunks[i])
+            continue
+        mat = np.zeros((len(idxs), padded), dtype=np.uint8)
+        for row, i in enumerate(idxs):
+            mat[row, padded - length:] = np.frombuffer(chunks[i], dtype=np.uint8)
+        crcs = crc32c_chunks(mat)
+        fix = 0 if padded == length else (
+            _length_offset(padded) ^ _length_offset(length)
+        )
+        for row, i in enumerate(idxs):
+            out[i] = int(crcs[row]) ^ fix
+    return out  # type: ignore[return-value]
 
 
 def crc32c_chunks(data: np.ndarray) -> np.ndarray:

@@ -107,6 +107,8 @@ class RemoteStorageManager:
         self._metrics = None
         self._breaker: Optional[CircuitBreaker] = None
         self._fault_schedule = None
+        self._scrubber = None
+        self._scrub_scheduler = None
         self.tracer = NOOP_TRACER
 
     # ------------------------------------------------------------------ setup
@@ -156,6 +158,65 @@ class RemoteStorageManager:
         self._register_cache_metrics()
         self._register_resilience_metrics()
         register_tracer_metrics(self._metrics.registry, self.tracer)
+        self._wire_scrubber(config)
+
+    def _wire_scrubber(self, config: RemoteStorageManagerConfig) -> None:
+        """Background integrity scrubbing (scrub/): enumerate + verify +
+        quarantine/repair on a jittered period, throttled so it never
+        starves foreground fetches."""
+        if not config.scrub_enabled:
+            return
+        from tieredstorage_tpu.scrub import ScrubMetrics, ScrubScheduler, Scrubber
+        from tieredstorage_tpu.scrub.metrics import register_scrub_metrics
+
+        bucket = (
+            TokenBucket(config.scrub_rate_bytes)
+            if config.scrub_rate_bytes is not None
+            else None
+        )
+        inner = (
+            self._chunk_manager._delegate
+            if isinstance(self._chunk_manager, ChunkCache)
+            else self._chunk_manager
+        )
+        quarantine = inner.quarantine if isinstance(inner, DefaultChunkManager) else None
+        self._scrubber = Scrubber(
+            self._storage,
+            prefix=config.key_prefix,
+            transform_backend=self._transform_backend,
+            data_key_decoder=self._rsa.data_key_decoder if self._rsa else None,
+            rate_bucket=bucket,
+            repair_enabled=config.scrub_repair_enabled,
+            quarantine=quarantine,
+            tracer=self.tracer,
+            metrics=ScrubMetrics(self._metrics.registry),
+        )
+        self._scrub_scheduler = ScrubScheduler(
+            self._scrubber, interval_ms=config.scrub_interval_ms
+        )
+        register_scrub_metrics(
+            self._metrics.registry, self._scrubber, self._scrub_scheduler
+        )
+        self._scrub_scheduler.start()
+        log.info(
+            "Integrity scrubber enabled: interval=%dms rate=%s repair=%s",
+            config.scrub_interval_ms, config.scrub_rate_bytes,
+            config.scrub_repair_enabled,
+        )
+
+    @property
+    def scrubber(self):
+        return self._scrubber
+
+    @property
+    def scrub_scheduler(self):
+        return self._scrub_scheduler
+
+    def scrub_status(self) -> dict:
+        """Status payload for the sidecar gateway's GET /scrub."""
+        if self._scrub_scheduler is None:
+            return {"enabled": False}
+        return {"enabled": True, **self._scrub_scheduler.status()}
 
     def _wire_fetch_observability(self) -> None:
         """Hand the configured tracer + latency hooks to the fetch tier so
@@ -276,7 +337,7 @@ class RemoteStorageManager:
 
         uploaded_keys: list[ObjectKey] = []
         try:
-            chunk_index = self._upload_segment_log(
+            chunk_index, chunk_checksums = self._upload_segment_log(
                 metadata, segment_data, requires_compression, data_key,
                 custom_builder, uploaded_keys,
             )
@@ -286,6 +347,7 @@ class RemoteStorageManager:
             self._upload_manifest(
                 metadata, chunk_index, segment_indexes, requires_compression,
                 data_key, custom_builder, uploaded_keys,
+                chunk_checksums=chunk_checksums,
             )
         except Exception as e:
             # Orphan cleanup: a failed copy must not leave partial objects
@@ -363,6 +425,7 @@ class RemoteStorageManager:
                 source, file_size, config.chunk_size,
                 self._transform_backend,
                 self._transform_opts(requires_compression, data_key),
+                collect_checksums=config.scrub_checksums_enabled,
             )
             stream: BinaryIO = transformation.stream()
             if self._rate_bucket is not None:
@@ -374,7 +437,7 @@ class RemoteStorageManager:
         custom_builder.add_upload_result(Suffix.LOG, uploaded)
         self._record_upload(metadata, Suffix.LOG, uploaded)
         log.debug("Uploaded segment log for %s, size: %d", metadata, uploaded)
-        return transformation.chunk_index
+        return transformation.chunk_index, transformation.chunk_checksums
 
     def _upload_indexes(
         self, metadata, segment_data: LogSegmentData, data_key, custom_builder, uploaded_keys
@@ -439,7 +502,7 @@ class RemoteStorageManager:
 
     def _upload_manifest(
         self, metadata, chunk_index, segment_indexes, requires_compression,
-        data_key, custom_builder, uploaded_keys,
+        data_key, custom_builder, uploaded_keys, chunk_checksums=None,
     ) -> None:
         config = self._config
         encryption_metadata = None
@@ -454,6 +517,7 @@ class RemoteStorageManager:
             encryption=encryption_metadata,
             remote_log_segment_metadata=metadata,
             compression_codec=config.compression_codec if requires_compression else None,
+            chunk_checksums=chunk_checksums,
         )
         text = manifest_to_json(manifest, data_key_encoder=encoder)
         key = self._object_key_factory.key(metadata, Suffix.MANIFEST)
@@ -648,6 +712,8 @@ class RemoteStorageManager:
             ) from failures[0][1]
 
     def close(self) -> None:
+        if self._scrub_scheduler is not None:
+            self._scrub_scheduler.stop()
         if self._config is not None and self._config.tracing_export_path:
             try:
                 self.tracer.write_chrome_trace(self._config.tracing_export_path)

@@ -1,0 +1,35 @@
+"""Background integrity scrubbing: detect-verify-repair over the object store.
+
+The paper's design trusts object storage blindly — once a segment is uploaded
+nothing re-reads it until a fetch hits it, so bit-rot, truncation, or a lost
+object surfaces as a user-facing read error months later. This subsystem is
+the proactive third leg next to fault injection (faults/) and tracing/metrics
+(utils/tracing.py, metrics/): enumerate (`StorageBackend.list_objects`),
+cross-check manifests against the inventory, batch-verify chunk CRC32C
+(ops/crc32c) and GCM/decompress round-trips, quarantine what is poisoned,
+and heal what is repairable — the same shape Ceph deep-scrub, ZFS scrub, and
+S3's internal auditors grew.
+"""
+
+from tieredstorage_tpu.scrub.metrics import SCRUB_METRIC_GROUP, ScrubMetrics
+from tieredstorage_tpu.scrub.scheduler import ScrubScheduler
+from tieredstorage_tpu.scrub.scrubber import (
+    INDEXES_SUFFIX,
+    LOG_SUFFIX,
+    MANIFEST_SUFFIX,
+    ScrubFinding,
+    ScrubReport,
+    Scrubber,
+)
+
+__all__ = [
+    "INDEXES_SUFFIX",
+    "LOG_SUFFIX",
+    "MANIFEST_SUFFIX",
+    "SCRUB_METRIC_GROUP",
+    "ScrubFinding",
+    "ScrubMetrics",
+    "ScrubReport",
+    "ScrubScheduler",
+    "Scrubber",
+]

@@ -115,8 +115,11 @@ class _Handler(BaseHTTPRequestHandler):
             raw_len = self.headers.get("Content-Length", "0").strip()
             # Same strict grammar rationale as chunk sizes: bare int()
             # accepts '+5'/'1_0'/'-7', all desync surface ('-7' would also
-            # spin take() to EOF).
-            if not raw_len.isdigit():
+            # spin take() to EOF). str.isdigit() is NOT the right gate — it
+            # accepts non-ASCII digits (e.g. '٥', '５') that int() happily
+            # parses, so hold the same explicit ASCII allowlist as the
+            # chunk-size arm.
+            if not raw_len or not all(c in "0123456789" for c in raw_len):
                 raise shimwire.ShimWireError(
                     f"bad Content-Length {raw_len!r}"
                 )
@@ -170,6 +173,18 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:
         if self.path == "/v1/health":
             self._reply(200)
+        elif self.path in ("/scrub", "/v1/scrub"):
+            # Integrity-scrubber status: scheduler state, cumulative
+            # counters, and the last pass summary ({"enabled": false} when
+            # scrub.enabled is off).
+            import json
+
+            status = (
+                self.rsm.scrub_status()
+                if hasattr(self.rsm, "scrub_status")
+                else {"enabled": False}
+            )
+            self._reply(200, json.dumps(status, indent=1).encode("utf-8"))
         else:
             self._reply(404, b"no such endpoint")
 

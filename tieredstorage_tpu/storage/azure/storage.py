@@ -71,7 +71,7 @@ class AzureBlobStorage(StorageBackend):
     def _request(
         self,
         method: str,
-        key_value: str,
+        key_value: Optional[str],
         query: dict[str, str],
         *,
         body: bytes = b"",
@@ -79,7 +79,11 @@ class AzureBlobStorage(StorageBackend):
         stream: bool = False,
     ):
         http = self._require_http()
-        path = f"{http.base_path}/{self.container}/" + quote(key_value, safe="/-._~")
+        # key_value=None addresses the container itself (List Blobs).
+        if key_value is None:
+            path = f"{http.base_path}/{self.container}"
+        else:
+            path = f"{http.base_path}/{self.container}/" + quote(key_value, safe="/-._~")
         headers = {
             "Host": f"{http.host}:{http.port}",
             # RFC 1123 date, locale-independent (strftime %a/%b would break
@@ -181,6 +185,37 @@ class AzureBlobStorage(StorageBackend):
         if status == 416:
             raise InvalidRangeException(f"Failed to fetch {key}: Invalid range {byte_range}")
         raise StorageBackendException(f"Failed to fetch {key}: HTTP {status}: {body[:200]!r}")
+
+    # ----------------------------------------------------------------- list
+    def list_objects(self, prefix: str = ""):
+        """List Blobs (restype=container&comp=list), paged via markers; the
+        service returns names in lexicographic order."""
+        marker = ""
+        while True:
+            query = {"restype": "container", "comp": "list"}
+            if prefix:
+                query["prefix"] = prefix
+            if marker:
+                query["marker"] = marker
+            try:
+                resp = self._request("GET", None, query)
+            except HttpError as e:
+                raise StorageBackendException(
+                    f"Failed to list blobs with prefix {prefix!r}"
+                ) from e
+            if resp.status != 200:
+                raise StorageBackendException(
+                    f"Failed to list blobs with prefix {prefix!r}: HTTP {resp.status}"
+                )
+            root = ET.fromstring(resp.body)
+            blobs = root.find("Blobs")
+            for blob in blobs.findall("Blob") if blobs is not None else ():
+                name = blob.findtext("Name")
+                if name:
+                    yield ObjectKey(name)
+            marker = root.findtext("NextMarker") or ""
+            if not marker:
+                return
 
     # --------------------------------------------------------------- delete
     def delete(self, key: ObjectKey) -> None:

@@ -152,11 +152,30 @@ class ObjectDeleter(abc.ABC):
             self.delete(key)
 
 
-class StorageBackend(ObjectUploader, ObjectFetcher, ObjectDeleter):
-    """A configurable uploader+fetcher+deleter.
+class ObjectLister:
+    """Key enumeration — the foundation of the integrity scrubber (scrub/).
+
+    No reference counterpart: the reference never enumerates the store (it
+    trusts uploads forever), which is exactly the gap the scrubber closes.
+    """
+
+    def list_objects(self, prefix: str = "") -> Iterable[ObjectKey]:
+        """Yield every object key starting with `prefix`, in lexicographic
+        order. An empty store (or unmatched prefix) yields nothing — never
+        KeyNotFoundException. Cloud backends page internally (S3
+        ListObjectsV2 continuation tokens, GCS pageToken, Azure marker), so
+        iteration over millions of keys stays O(page) in memory."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support object listing"
+        )
+
+
+class StorageBackend(ObjectUploader, ObjectFetcher, ObjectDeleter, ObjectLister):
+    """A configurable uploader+fetcher+deleter+lister.
 
     Reference: storage/core/.../StorageBackend.java:21 (Configurable +
-    ObjectUploader + ObjectFetcher + ObjectDeleter).
+    ObjectUploader + ObjectFetcher + ObjectDeleter); `list_objects` is this
+    build's extension for the background scrubber.
     """
 
     def configure(self, configs: Mapping[str, object]) -> None:  # noqa: B027

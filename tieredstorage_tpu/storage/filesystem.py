@@ -108,6 +108,22 @@ class FileSystemStorage(StorageBackend):
         except OSError as e:
             raise StorageBackendException(f"Failed to delete {key}") from e
 
+    def list_objects(self, prefix: str = ""):
+        assert self.fs_root is not None, "backend not configured"
+        root = self.fs_root.resolve()
+        keys: list[str] = []
+        try:
+            for dirpath, _dirnames, filenames in os.walk(root):
+                for name in filenames:
+                    rel = os.path.relpath(os.path.join(dirpath, name), root)
+                    key = rel.replace(os.sep, "/")
+                    if key.startswith(prefix):
+                        keys.append(key)
+        except OSError as e:
+            raise StorageBackendException("Failed to list storage root") from e
+        for key in sorted(keys):
+            yield ObjectKey(key)
+
     def __str__(self) -> str:
         return f"FileSystemStorage{{root={self.fs_root}, overwriteEnabled={self.overwrite_enabled}}}"
 

@@ -254,6 +254,39 @@ class GcsStorage(StorageBackend):
             raise InvalidRangeException(f"Failed to fetch {key}: Invalid range {byte_range}")
         raise StorageBackendException(f"Failed to fetch {key}: HTTP {status}: {body[:200]!r}")
 
+    # ----------------------------------------------------------------- list
+    def list_objects(self, prefix: str = ""):
+        """JSON-API object listing (GET /o?prefix=...), paged via pageToken;
+        GCS returns names in lexicographic order."""
+        import json
+
+        http = self._require_http()
+        page_token: Optional[str] = None
+        while True:
+            query = f"?prefix={quote(prefix, safe='')}"
+            if page_token:
+                query += f"&pageToken={quote(page_token, safe='')}"
+            try:
+                resp = http.request(
+                    "GET",
+                    f"{http.base_path}/storage/v1/b/{self.bucket}/o{query}",
+                    headers=self._headers(),
+                )
+            except HttpError as e:
+                raise StorageBackendException(
+                    f"Failed to list objects with prefix {prefix!r}"
+                ) from e
+            if resp.status != 200:
+                raise StorageBackendException(
+                    f"Failed to list objects with prefix {prefix!r}: HTTP {resp.status}"
+                )
+            doc = json.loads(resp.body)
+            for item in doc.get("items", []):
+                yield ObjectKey(str(item["name"]))
+            page_token = doc.get("nextPageToken")
+            if not page_token:
+                return
+
     # --------------------------------------------------------------- delete
     def delete(self, key: ObjectKey) -> None:
         http = self._require_http()

@@ -53,6 +53,12 @@ class InMemoryStorage(StorageBackend):
         with self._lock:
             self._objects.pop(key.value, None)
 
+    def list_objects(self, prefix: str = ""):
+        with self._lock:
+            matched = sorted(k for k in self._objects if k.startswith(prefix))
+        for k in matched:
+            yield ObjectKey(k)
+
     # --- test helpers ---
     def keys(self) -> list[str]:
         with self._lock:

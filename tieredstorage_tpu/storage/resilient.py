@@ -161,5 +161,10 @@ class ResilientStorageBackend(StorageBackend):
     def delete_all(self, keys) -> None:
         return self._call(self._delegate.delete_all, keys)
 
+    def list_objects(self, prefix: str = ""):
+        # Materialized under the breaker so mid-iteration page failures count
+        # as backend failures instead of escaping the accounting.
+        return iter(self._call(lambda p: list(self._delegate.list_objects(p)), prefix))
+
     def __str__(self) -> str:
         return f"ResilientStorageBackend{{delegate={self._delegate}}}"

@@ -171,6 +171,34 @@ class S3Client:
     def delete_object(self, key: str) -> None:
         self._call("DELETE", key, ok=(204, 200))
 
+    def list_objects_v2(
+        self,
+        prefix: str = "",
+        continuation_token: Optional[str] = None,
+        max_keys: Optional[int] = None,
+    ) -> tuple[list[str], Optional[str]]:
+        """One ListObjectsV2 page: (keys, next continuation token or None).
+
+        S3 caps pages at 1000 keys; callers loop while a token comes back
+        (S3Storage.list_objects does)."""
+        query: dict[str, str] = {"list-type": "2"}
+        if prefix:
+            query["prefix"] = prefix
+        if continuation_token:
+            query["continuation-token"] = continuation_token
+        if max_keys is not None:
+            query["max-keys"] = str(max_keys)
+        resp = self._call("GET", "", query=query)
+        root = ET.fromstring(resp.body)
+        ns = root.tag.partition("}")[0] + "}" if root.tag.startswith("{") else ""
+        keys = [
+            contents.findtext(f"{ns}Key") or ""
+            for contents in root.findall(f"{ns}Contents")
+        ]
+        truncated = (root.findtext(f"{ns}IsTruncated") or "").lower() == "true"
+        token = root.findtext(f"{ns}NextContinuationToken") if truncated else None
+        return keys, token
+
     def delete_objects(self, keys: list[str]) -> None:
         """Native bulk delete — one DeleteObjects call for up to 1000 keys
         (reference: S3Storage.java:82-97)."""

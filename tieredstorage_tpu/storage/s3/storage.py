@@ -137,6 +137,24 @@ class S3Storage(StorageBackend):
         except (S3ApiError, HttpError) as e:
             raise StorageBackendException(f"Failed to delete {key_list}") from e
 
+    # ----------------------------------------------------------------- list
+    def list_objects(self, prefix: str = ""):
+        """ListObjectsV2 pages (1000 keys each) chained via continuation
+        tokens; S3 returns keys in lexicographic (UTF-8 binary) order."""
+        client = self._require_client()
+        token: Optional[str] = None
+        while True:
+            try:
+                keys, token = client.list_objects_v2(prefix, token)
+            except (S3ApiError, HttpError) as e:
+                raise StorageBackendException(
+                    f"Failed to list objects with prefix {prefix!r}"
+                ) from e
+            for key in keys:
+                yield ObjectKey(key)
+            if token is None:
+                return
+
     @property
     def metrics(self):
         return self._metric_collector
