@@ -1,7 +1,7 @@
 # Developer entry points (counterpart of /root/reference/Makefile).
 PYTHON ?= python
 
-.PHONY: test test-e2e chaos bench demo trace-demo scrub-demo docs docker lint mutation clean
+.PHONY: test test-e2e chaos bench demo trace-demo scrub-demo tail-demo docs docker lint mutation clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q --ignore=tests/e2e
@@ -34,6 +34,16 @@ trace-demo:
 # re-validates artifacts/scrub_report.json.
 scrub-demo:
 	$(PYTHON) tools/scrub_demo.py --out artifacts/scrub_report.json
+
+# Tail-tolerance gate: a seeded FaultSchedule with jittered delay ranges
+# stalls every 4th storage fetch; the identical workload runs hedging-off
+# then hedging-on and must show hedged p99 < unhedged p99 with ZERO payload
+# diffs; the admission gate must shed with 429 + Retry-After when saturated;
+# an expired x-deadline-ms must fail fast (504 DeadlineExceededException,
+# well under one attempt-timeout). Writes and re-validates
+# artifacts/tail_report.json.
+tail-demo:
+	$(PYTHON) tools/tail_demo.py --out artifacts/tail_report.json
 
 docs:
 	$(PYTHON) -m tieredstorage_tpu.docs.configs_docs > docs/configs.rst

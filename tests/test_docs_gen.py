@@ -32,6 +32,12 @@ def test_configs_rst_covers_all_config_classes():
         "``fault.injection.enabled``",
         "``breaker.failure.threshold``",
         "``breaker.cooldown.ms``",
+        "``deadline.default.ms``",
+        "``hedge.delay.ms``",
+        "``hedge.budget.percent``",
+        "``retry.budget.percent``",
+        "``admission.max.concurrent``",
+        "``sidecar.grpc.max.workers``",
     ):
         assert key in rst
     # Required keys render as required, defaulted ones with their default.
@@ -65,6 +71,12 @@ def test_metrics_rst_covers_all_groups():
         "breaker-state",
         "chunk-cache-degradations-total",
         "quarantined-keys",
+        "hedges-won-total",
+        "retry-budget-balance",
+        "admission-shed-total",
+        "deadline-exceeded-total",
+        "hedge-win-time-ms",
+        "admission-wait-time-ms",
         "get-object-requests-total",
         "object-download-requests-total",
         "blob-upload-requests-total",

@@ -1,0 +1,613 @@
+"""Tail-tolerance & overload control suite (ISSUE 4).
+
+Layers under test, bottom-up:
+- Deadline math, wire codec, and scope semantics (utils/deadline.py);
+- the HTTP transport consuming the ambient deadline: pre-network fast-fail,
+  per-attempt clamp, retries abandoned when the budget can't fit them;
+- Hedger / HedgeBudget: hedge wins, budget suppression, first-SUCCESS-wins,
+  and the fault-injection contract test — a hedged fetch against a backend
+  corrupting the straggling attempt returns the intact winner (no torn
+  reads from the discarded loser);
+- RetryBudget + ResilientStorageBackend budgeted retries: amplification
+  under a sustained `fetch:raise` outage stays ≤ the configured factor
+  (seeded soak), breaker composition, no retry of fast-fail paths;
+- AdmissionController: concurrency limit, bounded queue, queue timeout, and
+  the gateway shedding with 429 + Retry-After before reading the body;
+- FaultSchedule jittered delay ranges (`delay=lo..hi`): grammar, bounds,
+  seeded determinism.
+"""
+
+from __future__ import annotations
+
+import http.client
+import threading
+import time
+
+import pytest
+
+from tests.test_rsm_lifecycle import make_rsm, make_segment_data, make_segment_metadata
+from tieredstorage_tpu.faults import (
+    FaultInjectedException,
+    FaultInjectingBackend,
+    FaultRule,
+    FaultSchedule,
+)
+from tieredstorage_tpu.fetch.chunk_manager import DefaultChunkManager
+from tieredstorage_tpu.fetch.hedge import HedgeBudget, Hedger
+from tieredstorage_tpu.sidecar import shimwire
+from tieredstorage_tpu.sidecar.http_gateway import SidecarHttpGateway
+from tieredstorage_tpu.storage.core import KeyNotFoundException, ObjectKey
+from tieredstorage_tpu.storage.httpclient import HttpClient, HttpError
+from tieredstorage_tpu.storage.memory import InMemoryStorage
+from tieredstorage_tpu.storage.resilient import (
+    BreakerState,
+    CircuitBreaker,
+    ResilientStorageBackend,
+    RetryBudget,
+)
+from tieredstorage_tpu.utils.admission import (
+    AdmissionController,
+    AdmissionRejectedException,
+)
+from tieredstorage_tpu.utils.deadline import (
+    Deadline,
+    DeadlineExceededException,
+    check_deadline,
+    current_deadline,
+    deadline_scope,
+    ensure_deadline,
+    parse_deadline_ms,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+# ------------------------------------------------------------------ Deadline
+class TestDeadline:
+    def test_remaining_and_expiry(self):
+        d = Deadline.after(0.05)
+        assert 0.0 < d.remaining_s() <= 0.05
+        assert not d.expired
+        assert Deadline.after(-0.001).expired
+
+    def test_wire_roundtrip(self):
+        d = Deadline.after_ms(5000)
+        parsed = parse_deadline_ms(d.header_value())
+        assert parsed is not None
+        # The re-parsed deadline budgets within a tick of the original.
+        assert abs(parsed.remaining_s() - d.remaining_s()) < 0.05
+
+    @pytest.mark.parametrize("bad", [None, "", "  ", "abc", "-5", "+5", "1_0",
+                                     "٥٠", "1.5"])
+    def test_malformed_wire_values_ignored(self, bad):
+        assert parse_deadline_ms(bad) is None
+
+    def test_zero_parses_to_expired(self):
+        d = parse_deadline_ms("0")
+        assert d is not None and d.expired
+
+    def test_scope_nesting_tightens_only(self):
+        outer = Deadline.after(10.0)
+        loose = Deadline.after(100.0)
+        tight = Deadline.after(1.0)
+        with deadline_scope(outer):
+            with deadline_scope(loose):
+                assert current_deadline() is outer  # loosening is ignored
+            with deadline_scope(tight):
+                assert current_deadline() is tight
+            assert current_deadline() is outer
+        assert current_deadline() is None
+
+    def test_scope_none_is_noop(self):
+        with deadline_scope(None):
+            assert current_deadline() is None
+        d = Deadline.after(1.0)
+        with deadline_scope(d), deadline_scope(None):
+            assert current_deadline() is d
+
+    def test_ensure_deadline_prefers_caller(self):
+        caller = Deadline.after(5.0)
+        with deadline_scope(caller), ensure_deadline(60.0) as effective:
+            assert effective is caller
+        with ensure_deadline(60.0) as effective:
+            assert effective is not None
+            assert 59.0 < effective.remaining_s() <= 60.0
+        with ensure_deadline(None) as effective:
+            assert effective is None
+
+    def test_check_deadline_raises_only_when_expired(self):
+        check_deadline("unconstrained")  # no ambient deadline: no-op
+        with deadline_scope(Deadline.after(10.0)):
+            check_deadline("plenty of budget")
+        with deadline_scope(Deadline.after(-0.01)):
+            with pytest.raises(DeadlineExceededException):
+                check_deadline("expired")
+
+
+# ---------------------------------------------------- transport consumption
+class TestHttpClientDeadline:
+    def test_expired_deadline_fails_before_any_network(self, monkeypatch):
+        client = HttpClient("http://test.invalid")
+        touched = []
+        monkeypatch.setattr(
+            client, "_new_connection",
+            lambda: touched.append(1) or pytest.fail("network touched"),
+        )
+        with deadline_scope(Deadline.after(-0.01)):
+            with pytest.raises(DeadlineExceededException):
+                client.request("GET", "/a")
+            with pytest.raises(DeadlineExceededException):
+                client.request_stream("GET", "/a")
+        assert touched == []
+
+    def test_attempt_timeout_clamped_to_remaining_budget(self, monkeypatch):
+        client = HttpClient("http://test.invalid", timeout=30.0)
+        seen = {}
+
+        class Conn:
+            timeout = None
+            sock = None
+
+            def request(self, *a, **k):
+                seen["timeout"] = self.timeout
+                raise OSError("refused")
+
+            def close(self):
+                pass
+
+        monkeypatch.setattr(client, "_new_connection", Conn)
+        with deadline_scope(Deadline.after(0.2)):
+            with pytest.raises((HttpError, DeadlineExceededException)):
+                client.request("GET", "/a")
+        # The 30 s socket timeout was clamped to the ~0.2 s budget.
+        assert seen["timeout"] is not None and seen["timeout"] <= 0.2
+
+    def test_retries_stop_when_budget_cannot_fit_backoff(self, monkeypatch):
+        client = HttpClient("http://test.invalid")
+        attempts = []
+
+        class Conn:
+            timeout = None
+            sock = None
+
+            def request(self, *a, **k):
+                attempts.append(time.monotonic())
+                raise OSError("reset")
+
+            def close(self):
+                pass
+
+        monkeypatch.setattr(client, "_new_connection", Conn)
+        start = time.monotonic()
+        with deadline_scope(Deadline.after(0.15)):
+            with pytest.raises((HttpError, DeadlineExceededException)):
+                client.request("GET", "/retryable")
+        # GETs normally retry up to 3 attempts with backoff; the deadline
+        # bounds the whole call well under a single fresh policy run.
+        assert time.monotonic() - start < 1.0
+
+
+# ------------------------------------------------------------------ hedging
+class _SlowCall:
+    """Callable whose Nth invocation (1-based) sleeps; returns its call no."""
+
+    def __init__(self, slow_calls: set[int], slow_s: float = 0.3):
+        self.calls = 0
+        self._lock = threading.Lock()
+        self._slow_calls = slow_calls
+        self._slow_s = slow_s
+
+    def __call__(self):
+        with self._lock:
+            self.calls += 1
+            n = self.calls
+        if n in self._slow_calls:
+            time.sleep(self._slow_s)
+        return n
+
+
+class TestHedger:
+    def make_hedger(self, delay_s=0.02, percent=100, **kwargs):
+        return Hedger(lambda: delay_s, HedgeBudget(percent), **kwargs)
+
+    def test_fast_primary_never_hedges(self):
+        hedger = self.make_hedger()
+        try:
+            fn = _SlowCall(set())
+            assert hedger.call(fn) == 1
+            assert (hedger.launched, hedger.wins, fn.calls) == (0, 0, 1)
+        finally:
+            hedger.close()
+
+    def test_hedge_wins_over_straggler(self):
+        wins_ms = []
+        hedger = self.make_hedger(on_win=wins_ms.append)
+        try:
+            fn = _SlowCall({1}, slow_s=0.5)
+            start = time.monotonic()
+            result = hedger.call(fn)
+            elapsed = time.monotonic() - start
+            assert result == 2  # the hedge's answer
+            assert elapsed < 0.4  # didn't wait out the straggler
+            assert hedger.launched == 1 and hedger.wins == 1
+            assert len(wins_ms) == 1 and wins_ms[0] < 400.0
+        finally:
+            hedger.close()
+
+    def test_budget_suppresses_hedges(self):
+        # 1% earn rate with the initial single token: the first straggler
+        # hedges, the second is suppressed and waits the primary out.
+        hedger = self.make_hedger(percent=1)
+        try:
+            fn = _SlowCall({1, 3}, slow_s=0.15)
+            assert hedger.call(fn) == 2
+            assert hedger.call(fn) == 3  # fast primary in between
+            assert hedger.call(fn) == 4  # straggler, hedge denied → waits
+            assert hedger.launched == 1 and hedger.suppressed == 1
+        finally:
+            hedger.close()
+
+    def test_first_success_wins_over_failing_fast_attempt(self):
+        # Primary straggles AND fails; the hedge succeeds → its result wins.
+        state = {"calls": 0}
+        lock = threading.Lock()
+
+        def fn():
+            with lock:
+                state["calls"] += 1
+                n = state["calls"]
+            if n == 1:
+                time.sleep(0.1)
+                raise OSError("straggler also failed")
+            return "hedge-ok"
+
+        hedger = self.make_hedger()
+        try:
+            assert hedger.call(fn) == "hedge-ok"
+        finally:
+            hedger.close()
+
+    def test_both_attempts_failing_propagates(self):
+        def fn():
+            time.sleep(0.05)
+            raise KeyNotFoundException("backend", ObjectKey("k"))
+
+        hedger = self.make_hedger(delay_s=0.01)
+        try:
+            with pytest.raises(KeyNotFoundException):
+                hedger.call(fn)
+        finally:
+            hedger.close()
+
+    def test_ambient_deadline_crosses_into_hedge_threads(self):
+        hedger = self.make_hedger()
+        seen = {}
+
+        def fn():
+            seen["deadline"] = current_deadline()
+            return 1
+
+        try:
+            with deadline_scope(Deadline.after(5.0)) as d:
+                hedger.call(fn)
+            assert seen["deadline"] is not None
+            assert seen["deadline"].at_monotonic == d.at_monotonic
+        finally:
+            hedger.close()
+
+
+def _upload_one_segment(storage, chunk=256, n_chunks=8):
+    """Store an identity-transformed segment (constant-fill chunks, the
+    quarantine suite's pattern); returns (key, manifest, payload, backend)
+    where the backend's detransform authenticates each chunk — a corrupt
+    byte anywhere would raise, so a clean result proves intact bytes."""
+    import io
+
+    from tests.test_fault_injection import ParityTransformBackend
+    from tieredstorage_tpu.manifest.chunk_index import FixedSizeChunkIndex
+    from tieredstorage_tpu.manifest.segment_indexes import (
+        IndexType,
+        SegmentIndexesV1Builder,
+    )
+    from tieredstorage_tpu.manifest.segment_manifest import SegmentManifestV1
+
+    payload = b"".join(bytes([i]) * chunk for i in range(n_chunks))
+    key = ObjectKey("seg/tail.log")
+    storage.upload(io.BytesIO(payload), key)
+    builder = SegmentIndexesV1Builder()
+    for t in (IndexType.OFFSET, IndexType.TIMESTAMP,
+              IndexType.PRODUCER_SNAPSHOT, IndexType.LEADER_EPOCH):
+        builder.add(t, 0)
+    manifest = SegmentManifestV1(
+        chunk_index=FixedSizeChunkIndex(
+            original_chunk_size=chunk, original_file_size=len(payload),
+            transformed_chunk_size=chunk, final_transformed_chunk_size=chunk,
+        ),
+        segment_indexes=builder.build(),
+        compression=False,
+        encryption=None,
+    )
+    return key, manifest, payload, ParityTransformBackend()
+
+
+class TestHedgedFetchUnderFaults:
+    def test_corrupt_straggling_loser_cannot_tear_the_winner(self):
+        """Contract test (ISSUE 4 satellite): the FIRST backend attempt is
+        both slow and corrupt (`fetch:delay` + `fetch:corrupt` on call 1);
+        the hedge is clean and fast, wins, and the returned plaintext is
+        byte-identical to the original — the discarded loser's poisoned
+        bytes never leak into the winner's result."""
+        storage = InMemoryStorage()
+        key, manifest, payload, backend = _upload_one_segment(storage)
+        schedule = FaultSchedule.parse(
+            "fetch:delay=300@1; fetch:corrupt=13@1", seed=7
+        )
+        faulty = FaultInjectingBackend(storage, schedule)
+        manager = DefaultChunkManager(faulty, backend)
+        hedger = Hedger(lambda: 0.02, HedgeBudget(100))
+        manager.hedger = hedger
+        try:
+            out = b"".join(
+                manager.get_chunks(key, manifest, list(range(8)))
+            )
+            assert out == payload
+            assert hedger.launched == 1 and hedger.wins == 1
+            # Both attempts hit the backend; the corrupt one was discarded.
+            assert schedule.calls("fetch") == 2
+            assert manager.corruptions == 0  # winner never detransformed rot
+        finally:
+            hedger.close()
+
+
+# ------------------------------------------------------------- retry budget
+class _FlakyBackend(InMemoryStorage):
+    """fetch fails `fail_first` times, then succeeds."""
+
+    def __init__(self, fail_first: int):
+        super().__init__()
+        self.fail_first = fail_first
+        self.fetches = 0
+
+    def fetch(self, key, byte_range=None):
+        self.fetches += 1
+        if self.fetches <= self.fail_first:
+            raise FaultInjectedException(f"flake #{self.fetches}")
+        return super().fetch(key, byte_range)
+
+
+class TestRetryBudget:
+    def test_earn_spend_and_denial(self):
+        budget = RetryBudget(50, capacity=2.0)
+        assert budget.try_spend() and budget.try_spend()
+        assert not budget.try_spend()  # drained
+        assert budget.denied == 1
+        for _ in range(2):
+            budget.deposit()  # 2 successes × 0.5 token
+        assert budget.try_spend()
+        assert budget.spent == 3
+
+    def test_budgeted_retry_recovers_transient_failure(self):
+        import io
+
+        inner = _FlakyBackend(fail_first=1)
+        inner.upload(io.BytesIO(b"payload"), ObjectKey("k"))
+        backend = ResilientStorageBackend(
+            inner, retry_budget=RetryBudget(100), max_attempts=3,
+            backoff_s=0.001,
+        )
+        with backend.fetch(ObjectKey("k")) as stream:
+            assert stream.read() == b"payload"
+        assert inner.fetches == 2
+        assert backend.retry_budget.spent == 1
+
+    def test_no_budget_means_no_retries(self):
+        inner = _FlakyBackend(fail_first=1)
+        backend = ResilientStorageBackend(inner)  # legacy single-attempt
+        with pytest.raises(FaultInjectedException):
+            backend.fetch(ObjectKey("k"))
+        assert inner.fetches == 1
+
+    def test_upload_is_never_replayed(self):
+        import io
+
+        calls = []
+
+        class FailingUpload(InMemoryStorage):
+            def upload(self, stream, key):
+                calls.append(1)
+                raise FaultInjectedException("upload broke")
+
+        backend = ResilientStorageBackend(
+            FailingUpload(), retry_budget=RetryBudget(100), max_attempts=3
+        )
+        with pytest.raises(FaultInjectedException):
+            backend.upload(io.BytesIO(b"x"), ObjectKey("k"))
+        assert calls == [1]
+
+    def test_expired_deadline_is_not_retried_and_spares_the_breaker(self):
+        class DeadlineRaiser(InMemoryStorage):
+            def fetch(self, key, byte_range=None):
+                raise DeadlineExceededException("budget gone")
+
+        breaker = CircuitBreaker(failure_threshold=1)
+        backend = ResilientStorageBackend(
+            DeadlineRaiser(), breaker, retry_budget=RetryBudget(100)
+        )
+        with pytest.raises(DeadlineExceededException):
+            backend.fetch(ObjectKey("k"))
+        assert breaker.state is BreakerState.CLOSED
+        assert backend.retry_budget.spent == 0
+
+    def test_amplification_capped_under_sustained_outage(self):
+        """Seeded soak (acceptance criterion): with percent=10 and
+        capacity=5, a 100% `fetch:raise` outage of N primary calls performs
+        at most N + 0.1·N + 5 backend attempts — amplification converges to
+        ≤ the configured budget factor instead of max_attempts×N."""
+        primaries = 200
+        percent, capacity = 10, 5.0
+        schedule = FaultSchedule.parse("fetch:raise", seed=42)
+        storage = FaultInjectingBackend(InMemoryStorage(), schedule)
+        backend = ResilientStorageBackend(
+            storage,
+            CircuitBreaker(failure_threshold=10_000),  # isolate the budget
+            retry_budget=RetryBudget(percent, capacity=capacity),
+            max_attempts=3,
+            backoff_s=0.0001,
+        )
+        for i in range(primaries):
+            with pytest.raises(FaultInjectedException):
+                backend.fetch(ObjectKey(f"k{i}"))
+        attempts = schedule.calls("fetch")
+        assert attempts >= primaries
+        cap = primaries + (percent / 100.0) * primaries + capacity
+        assert attempts <= cap, f"{attempts} attempts > cap {cap}"
+        # With zero successes the bucket drains: retries stopped long ago.
+        assert attempts == primaries + int(capacity)
+        assert backend.retry_budget.denied > 0
+
+    def test_retry_recloses_breaker_accounting(self):
+        """Each retry re-takes the breaker gate, so a retried call that
+        keeps failing still counts every attempt toward opening."""
+        inner = _FlakyBackend(fail_first=10)
+        breaker = CircuitBreaker(failure_threshold=3)
+        backend = ResilientStorageBackend(
+            inner, breaker, retry_budget=RetryBudget(100, capacity=10),
+            max_attempts=5, backoff_s=0.0001,
+        )
+        with pytest.raises(Exception):
+            backend.fetch(ObjectKey("k"))
+        assert breaker.state is BreakerState.OPEN
+        assert inner.fetches == 3  # opened after threshold, not max_attempts
+
+
+# -------------------------------------------------------- admission control
+class TestAdmissionController:
+    def test_admits_up_to_limit_then_sheds(self):
+        controller = AdmissionController(2, 0, retry_after_s=3.0)
+        controller.acquire("a")
+        controller.acquire("b")
+        with pytest.raises(AdmissionRejectedException) as exc_info:
+            controller.acquire("c")
+        assert exc_info.value.retry_after_s == 3.0
+        assert (controller.active, controller.shed_total) == (2, 1)
+        controller.release()
+        controller.acquire("d")  # freed slot admits again
+        assert controller.admitted_total == 3
+
+    def test_bounded_queue_admits_after_release(self):
+        controller = AdmissionController(1, 1, queue_timeout_s=5.0)
+        controller.acquire("first")
+        admitted = threading.Event()
+
+        def queued():
+            controller.acquire("second")
+            admitted.set()
+
+        t = threading.Thread(target=queued)
+        t.start()
+        time.sleep(0.05)
+        assert controller.queued == 1 and not admitted.is_set()
+        controller.release()
+        t.join(timeout=2)
+        assert admitted.is_set()
+
+    def test_queue_timeout_sheds(self):
+        controller = AdmissionController(1, 4, queue_timeout_s=0.05)
+        controller.acquire("holder")
+        start = time.monotonic()
+        with pytest.raises(AdmissionRejectedException):
+            controller.acquire("stuck")
+        assert 0.04 <= time.monotonic() - start < 1.0
+        assert controller.queued == 0  # queue slot released on shed
+
+
+class TestGatewaySheds:
+    def test_shed_returns_429_with_retry_after_before_reading_body(self, tmp_path):
+        rsm, _ = make_rsm(
+            tmp_path, compression=False, encryption=False,
+            extra_configs={
+                "admission.enabled": True,
+                "admission.max.concurrent": 1,
+                "admission.max.queue": 0,
+                "admission.retry.after.ms": 2_000,
+            },
+        )
+        md = make_segment_metadata()
+        rsm.copy_log_segment_data(md, make_segment_data(tmp_path, with_txn=False))
+        gateway = SidecarHttpGateway(rsm).start()
+        try:
+            # Deterministically occupy the single slot, then hit the gate.
+            rsm.admission.acquire("test-holder")
+            try:
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", gateway.port, timeout=10
+                )
+                body = shimwire.encode_metadata(md) + shimwire.encode_fetch_tail(0, None)
+                conn.request("POST", "/v1/fetch", body=body)
+                resp = conn.getresponse()
+                payload = resp.read()
+                conn.close()
+                assert resp.status == 429
+                assert resp.getheader("Retry-After") == "2"
+                assert b"AdmissionRejectedException" in payload
+            finally:
+                rsm.admission.release()
+            # Slot freed: the same request is served normally.
+            conn = http.client.HTTPConnection("127.0.0.1", gateway.port, timeout=30)
+            conn.request("POST", "/v1/fetch", body=body)
+            resp = conn.getresponse()
+            served = resp.read()
+            conn.close()
+            assert resp.status == 200
+            assert len(served) == md.segment_size_in_bytes
+            assert rsm.admission.shed_total == 1
+        finally:
+            gateway.stop()
+            rsm.close()
+
+
+# ------------------------------------------------- jittered fault schedules
+class TestJitteredDelays:
+    def test_grammar_parses_ranges(self):
+        schedule = FaultSchedule.parse("fetch:delay=10..250@p=0.5")
+        rule = schedule.rules[0]
+        assert rule == FaultRule("fetch", "delay", arg=10, probability=0.5,
+                                 arg_hi=250)
+
+    @pytest.mark.parametrize("bad", [
+        "fetch:delay=250..10",     # hi < lo
+        "fetch:corrupt=1..5",      # range on a non-delay action
+        "fetch:truncate=1..5@1",   # range on a non-delay action
+    ])
+    def test_grammar_rejects_bad_ranges(self, bad):
+        with pytest.raises(ValueError):
+            FaultSchedule.parse(bad)
+
+    def test_draws_are_within_bounds_and_seed_deterministic(self):
+        def draws(seed):
+            schedule = FaultSchedule.parse("fetch:delay=10..250", seed=seed)
+            rule = schedule.rules[0]
+            return [schedule.delay_ms(rule) for _ in range(50)]
+
+        first = draws(123)
+        assert all(10.0 <= d <= 250.0 for d in first)
+        assert len(set(first)) > 1  # actually jittered, not constant
+        assert first == draws(123)  # same seed ⇒ same distribution
+        assert first != draws(124)
+
+    def test_fixed_delay_unchanged(self):
+        schedule = FaultSchedule.parse("fetch:delay=25")
+        assert schedule.delay_ms(schedule.rules[0]) == 25.0
+        schedule2 = FaultSchedule.parse("fetch:delay")
+        assert schedule2.delay_ms(schedule2.rules[0]) == 10.0
+
+    def test_injected_jittered_delay_slows_the_call(self):
+        import io
+
+        schedule = FaultSchedule.parse("fetch:delay=30..60@1", seed=9)
+        backend = FaultInjectingBackend(InMemoryStorage(), schedule)
+        backend.upload(io.BytesIO(b"x"), ObjectKey("k"))
+        start = time.monotonic()
+        with backend.fetch(ObjectKey("k")) as stream:
+            stream.read()
+        assert time.monotonic() - start >= 0.03

@@ -174,8 +174,10 @@ def _base_def() -> ConfigDef:
         doc="Deterministic fault rules 'op:action[=arg][@trigger]' with op in "
             "[upload, fetch, delete, list, *], action in [raise, key-not-found, "
             "delay, truncate, corrupt], trigger '@N' (Nth call), '@every=K', "
-            "or '@p=P' (seeded probability). E.g. 'upload:raise@3, "
-            "fetch:corrupt=7@1'.",
+            "or '@p=P' (seeded probability). delay accepts a jittered range "
+            "'delay=lo..hi' (uniform seeded draw per firing, in ms) for "
+            "realistic tail-latency distributions. E.g. 'upload:raise@3, "
+            "fetch:corrupt=7@1, fetch:delay=10..250@p=0.2'.",
     ))
     d.define(ConfigKey(
         "fault.seed", "long", default=0, importance="low",
@@ -199,6 +201,121 @@ def _base_def() -> ConfigDef:
         validator=in_range(1, None), importance="medium",
         doc="How long the breaker stays open before allowing a half-open "
             "probe request through.",
+    ))
+    d.define(ConfigKey(
+        "deadline.default.ms", "long", default=None,
+        validator=null_or(in_range(1, None)), importance="medium",
+        doc="Default end-to-end deadline installed at the RSM/gateway entry "
+            "when the caller did not propagate one (x-deadline-ms header / "
+            "gRPC metadata). Every layer clamps its waiting to the remaining "
+            "budget and expired requests fail fast with "
+            "DeadlineExceededException before touching the network; null "
+            "means unconstrained.",
+    ))
+    d.define(ConfigKey(
+        "hedge.enabled", "bool", default=False, importance="medium",
+        doc="Hedge straggling chunk fetches: after hedge.delay (the observed "
+            "chunk-fetch p95, or hedge.delay.ms until enough samples exist) "
+            "issue a second identical ranged GET and take the first success; "
+            "the loser is cancelled/discarded. Extra load is capped by "
+            "hedge.budget.percent.",
+    ))
+    d.define(ConfigKey(
+        "hedge.delay.ms", "long", default=50,
+        validator=in_range(1, None), importance="medium",
+        doc="Static hedge delay fallback (ms) used until the chunk-fetch "
+            "latency histogram holds hedge.delay.min.samples observations, "
+            "after which the observed p95 drives the delay.",
+    ))
+    d.define(ConfigKey(
+        "hedge.delay.min.samples", "int", default=50,
+        validator=in_range(1, None), importance="low",
+        doc="Chunk-fetch histogram observations required before the hedge "
+            "delay switches from the static hedge.delay.ms to the observed "
+            "p95.",
+    ))
+    d.define(ConfigKey(
+        "hedge.budget.percent", "int", default=10,
+        validator=in_range(1, 100), importance="medium",
+        doc="Hedge token bucket: earn percent/100 tokens per primary chunk "
+            "fetch, spend one per hedge — hedged requests never exceed this "
+            "percentage of primary traffic, so hedging self-limits under a "
+            "systemic slowdown instead of doubling the load.",
+    ))
+    d.define(ConfigKey(
+        "retry.budget.enabled", "bool", default=False, importance="medium",
+        doc="Budget storage-layer retries with a per-backend token bucket "
+            "(earn on success, spend on retry) so an outage cannot amplify "
+            "into a retry storm; composes with the circuit breaker (each "
+            "retry re-takes the breaker gate).",
+    ))
+    d.define(ConfigKey(
+        "retry.budget.percent", "int", default=10,
+        validator=in_range(1, 100), importance="medium",
+        doc="Tokens earned per successful storage call, as a percentage: "
+            "long-run retries are capped at percent/100 of successes (+ the "
+            "fixed retry.budget.capacity allowance), bounding the "
+            "cluster-wide retry amplification factor at 1 + percent/100.",
+    ))
+    d.define(ConfigKey(
+        "retry.budget.capacity", "int", default=10,
+        validator=in_range(1, None), importance="low",
+        doc="Retry token bucket capacity (and initial balance): the fixed "
+            "allowance that lets cold starts and short blips retry before "
+            "any successes have been banked.",
+    ))
+    d.define(ConfigKey(
+        "retry.budget.max.attempts", "int", default=3,
+        validator=in_range(1, None), importance="low",
+        doc="Per-call attempt ceiling for budgeted storage retries "
+            "(including the first attempt).",
+    ))
+    d.define(ConfigKey(
+        "retry.budget.backoff.ms", "long", default=10,
+        validator=in_range(1, None), importance="low",
+        doc="Base backoff (ms) between budgeted storage retries; the actual "
+            "sleep is full-jitter exponential and always fits the remaining "
+            "end-to-end deadline, or the retry is abandoned.",
+    ))
+    d.define(ConfigKey(
+        "admission.enabled", "bool", default=False, importance="medium",
+        doc="Gate the sidecar boundaries (HTTP gateway + gRPC service) with "
+            "an admission controller: at most admission.max.concurrent "
+            "requests execute, admission.max.queue more wait, and the rest "
+            "are shed at entry with 429 + Retry-After / RESOURCE_EXHAUSTED "
+            "before the request body is read.",
+    ))
+    d.define(ConfigKey(
+        "admission.max.concurrent", "int", default=64,
+        validator=in_range(1, None), importance="medium",
+        doc="Concurrent requests executing past the admission gate.",
+    ))
+    d.define(ConfigKey(
+        "admission.max.queue", "int", default=128,
+        validator=in_range(0, None), importance="medium",
+        doc="Bounded admission queue depth; a request arriving with the "
+            "queue full is shed immediately (0 disables queuing entirely).",
+    ))
+    d.define(ConfigKey(
+        "admission.queue.timeout.ms", "long", default=1_000,
+        validator=in_range(1, None), importance="low",
+        doc="Longest a request waits in the admission queue before being "
+            "shed (queuing longer than the caller's patience just wastes "
+            "both ends' resources).",
+    ))
+    d.define(ConfigKey(
+        "admission.retry.after.ms", "long", default=1_000,
+        validator=in_range(1, None), importance="low",
+        doc="Backoff hint returned with shed requests (HTTP Retry-After "
+            "header, gRPC retry-after trailer), rounded up to whole "
+            "seconds on the HTTP side.",
+    ))
+    d.define(ConfigKey(
+        "sidecar.grpc.max.workers", "int", default=8,
+        validator=in_range(1, None), importance="low",
+        doc="Thread pool size of the gRPC sidecar server (was hardcoded at "
+            "8). Size to the expected broker fetch parallelism; admission "
+            "control sheds what the pool cannot absorb.",
     ))
     d.define(ConfigKey(
         "scrub.enabled", "bool", default=False, importance="medium",
@@ -395,6 +512,70 @@ class RemoteStorageManagerConfig:
     @property
     def breaker_cooldown_ms(self) -> int:
         return self._values["breaker.cooldown.ms"]
+
+    @property
+    def deadline_default_ms(self) -> Optional[int]:
+        return self._values["deadline.default.ms"]
+
+    @property
+    def hedge_enabled(self) -> bool:
+        return self._values["hedge.enabled"]
+
+    @property
+    def hedge_delay_ms(self) -> int:
+        return self._values["hedge.delay.ms"]
+
+    @property
+    def hedge_delay_min_samples(self) -> int:
+        return self._values["hedge.delay.min.samples"]
+
+    @property
+    def hedge_budget_percent(self) -> int:
+        return self._values["hedge.budget.percent"]
+
+    @property
+    def retry_budget_enabled(self) -> bool:
+        return self._values["retry.budget.enabled"]
+
+    @property
+    def retry_budget_percent(self) -> int:
+        return self._values["retry.budget.percent"]
+
+    @property
+    def retry_budget_capacity(self) -> int:
+        return self._values["retry.budget.capacity"]
+
+    @property
+    def retry_budget_max_attempts(self) -> int:
+        return self._values["retry.budget.max.attempts"]
+
+    @property
+    def retry_budget_backoff_ms(self) -> int:
+        return self._values["retry.budget.backoff.ms"]
+
+    @property
+    def admission_enabled(self) -> bool:
+        return self._values["admission.enabled"]
+
+    @property
+    def admission_max_concurrent(self) -> int:
+        return self._values["admission.max.concurrent"]
+
+    @property
+    def admission_max_queue(self) -> int:
+        return self._values["admission.max.queue"]
+
+    @property
+    def admission_queue_timeout_ms(self) -> int:
+        return self._values["admission.queue.timeout.ms"]
+
+    @property
+    def admission_retry_after_ms(self) -> int:
+        return self._values["admission.retry.after.ms"]
+
+    @property
+    def sidecar_grpc_max_workers(self) -> int:
+        return self._values["sidecar.grpc.max.workers"]
 
     @property
     def scrub_enabled(self) -> bool:

@@ -26,6 +26,8 @@ def _collect_rsm() -> dict[str, list[str]]:
     m.record_cache_get(1.0)
     m.record_object_upload("topic", 0, "log", 1)
     m.record_upload_rollback("topic", 0)
+    m.record_hedge_win(1.0)
+    m.record_admission_wait(1.0)
     return _group_names(m.registry)
 
 
@@ -43,19 +45,30 @@ def _collect_resilience() -> dict[str, list[str]]:
     from tieredstorage_tpu.faults.schedule import FaultSchedule
     from tieredstorage_tpu.fetch.cache.memory import MemoryChunkCache
     from tieredstorage_tpu.fetch.chunk_manager import DefaultChunkManager
+    from tieredstorage_tpu.fetch.hedge import HedgeBudget, Hedger
     from tieredstorage_tpu.metrics.core import MetricsRegistry
     from tieredstorage_tpu.metrics.rsm_metrics import register_resilience_metrics
-    from tieredstorage_tpu.storage.resilient import CircuitBreaker
+    from tieredstorage_tpu.storage.resilient import CircuitBreaker, RetryBudget
+    from tieredstorage_tpu.utils import deadline
+    from tieredstorage_tpu.utils.admission import AdmissionController
 
     registry = MetricsRegistry()
-    register_resilience_metrics(
-        registry,
-        breaker=CircuitBreaker(),
-        fault_schedule=FaultSchedule([]),
-        chunk_cache=MemoryChunkCache(None),
-        chunk_manager=DefaultChunkManager(None, None),
-    )
-    return _group_names(registry)
+    hedger = Hedger(lambda: 0.05, HedgeBudget(10), max_workers=1)
+    try:
+        register_resilience_metrics(
+            registry,
+            breaker=CircuitBreaker(),
+            fault_schedule=FaultSchedule([]),
+            chunk_cache=MemoryChunkCache(None),
+            chunk_manager=DefaultChunkManager(None, None),
+            hedger=hedger,
+            retry_budget=RetryBudget(10),
+            admission=AdmissionController(1, 0),
+            deadline_exceeded_supplier=deadline.exceeded_total,
+        )
+        return _group_names(registry)
+    finally:
+        hedger.close()
 
 
 def _collect_scrub() -> dict[str, list[str]]:

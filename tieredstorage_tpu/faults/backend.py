@@ -98,7 +98,8 @@ class FaultInjectingBackend(StorageBackend):
         data_rules: list[FaultRule] = []
         for rule in self._schedule.fired_rules(op, key):
             if rule.action == "delay":
-                time.sleep((rule.arg if rule.arg is not None else 10) / 1000.0)
+                # Fixed arg, or a seeded uniform draw for `delay=lo..hi`.
+                time.sleep(self._schedule.delay_ms(rule) / 1000.0)
             elif rule.action == "raise":
                 raise FaultInjectedException(
                     f"Injected {op} fault for {key} "

@@ -8,7 +8,10 @@ A schedule is a list of rules, each written as
 - action:
     - ``raise`` — raise FaultInjectedException (a StorageBackendException)
     - ``key-not-found`` — raise KeyNotFoundException for the requested key
-    - ``delay`` — sleep ``arg`` milliseconds (default 10) before the call
+    - ``delay`` — sleep ``arg`` milliseconds (default 10) before the call;
+      a jittered range ``delay=10..250`` sleeps a value drawn uniformly
+      from [10, 250] ms by the schedule's seeded RNG — realistic
+      tail-latency distributions instead of fixed sleeps
     - ``truncate`` — keep only the first ``arg`` bytes of a fetched object
       (default: half); fetch only
     - ``corrupt`` — flip the fetched byte at offset ``arg`` (default 0,
@@ -21,7 +24,8 @@ A schedule is a list of rules, each written as
     - absent — fire on every call
 
 Examples: ``upload:raise@3``, ``fetch:corrupt=7@1``, ``*:delay=5@every=2``,
-``fetch:truncate@p=0.1``. Rules are combined with ``,`` or ``;`` in the
+``fetch:delay=10..250@p=0.2``, ``fetch:truncate@p=0.1``. Rules are combined
+with ``,`` or ``;`` in the
 string form (``fault.schedule`` config) or passed as a list.
 
 Call counting is per op and thread-safe; every fired rule is recorded in
@@ -52,7 +56,7 @@ class FaultInjectedException(StorageBackendException):
 
 _RULE_RE = re.compile(
     r"(?P<op>\*|upload|fetch|delete|list)\s*:\s*(?P<action>[a-z-]+)"
-    r"(?:\s*=\s*(?P<arg>\d+))?(?:\s*@\s*(?P<trigger>[a-z0-9.=]+))?"
+    r"(?:\s*=\s*(?P<arg>\d+(?:\s*\.\.\s*\d+)?))?(?:\s*@\s*(?P<trigger>[a-z0-9.=]+))?"
 )
 
 
@@ -64,6 +68,9 @@ class FaultRule:
     nth: Optional[int] = None
     every: Optional[int] = None
     probability: Optional[float] = None
+    #: Upper bound of a jittered ``delay=lo..hi`` range (delay only); the
+    #: actual sleep is drawn per firing from the schedule's seeded RNG.
+    arg_hi: Optional[int] = None
 
     def __post_init__(self) -> None:
         if self.op != "*" and self.op not in OPS:
@@ -80,6 +87,14 @@ class FaultRule:
             raise ValueError("nth must be >= 1")
         if self.probability is not None and not (0.0 <= self.probability <= 1.0):
             raise ValueError("probability must be in [0, 1]")
+        if self.arg_hi is not None:
+            if self.action != "delay":
+                raise ValueError("range args (lo..hi) only apply to delay")
+            if self.arg is None or self.arg_hi < self.arg:
+                raise ValueError(
+                    f"delay range must be lo..hi with hi >= lo, "
+                    f"got {self.arg}..{self.arg_hi}"
+                )
 
     @staticmethod
     def parse(text: str) -> "FaultRule":
@@ -103,13 +118,21 @@ class FaultRule:
                     f"Invalid fault trigger {trigger!r}; expected N, every=K, or p=P"
                 )
         arg = m.group("arg")
+        arg_lo = arg_hi = None
+        if arg is not None:
+            if ".." in arg:
+                lo, _, hi = arg.partition("..")
+                arg_lo, arg_hi = int(lo), int(hi)
+            else:
+                arg_lo = int(arg)
         return FaultRule(
             op=m.group("op"),
             action=m.group("action"),
-            arg=None if arg is None else int(arg),
+            arg=arg_lo,
             nth=nth,
             every=every,
             probability=probability,
+            arg_hi=arg_hi,
         )
 
     def matches_op(self, op: str) -> bool:
@@ -161,6 +184,18 @@ class FaultSchedule:
             for r in fired:
                 self.injections.append((op, r.action, str(key)))
             return fired
+
+    def delay_ms(self, rule: FaultRule) -> float:
+        """Sleep duration for a fired `delay` rule: the fixed arg (default
+        10 ms), or — for a jittered ``delay=lo..hi`` range — a uniform draw
+        from the schedule's seeded RNG, so chaos runs get realistic
+        tail-latency distributions that are still reproducible."""
+        if rule.arg is None:
+            return 10.0
+        if rule.arg_hi is None:
+            return float(rule.arg)
+        with self._lock:
+            return self._rng.uniform(rule.arg, rule.arg_hi)
 
     def _fires_locked(self, rule: FaultRule, call_no: int) -> bool:
         if rule.nth is not None:

@@ -29,6 +29,7 @@ from tieredstorage_tpu.fetch.chunk_manager import ChunkManager
 from tieredstorage_tpu.manifest.segment_manifest import SegmentManifestV1
 from tieredstorage_tpu.storage.core import ObjectKey
 from tieredstorage_tpu.utils.caching import LoadingCache, RemovalCause
+from tieredstorage_tpu.utils.deadline import check_deadline, remaining_s
 from tieredstorage_tpu.utils.tracing import NOOP_TRACER
 
 log = logging.getLogger(__name__)
@@ -145,7 +146,14 @@ class ChunkCache(ChunkManager, Generic[T], abc.ABC):
     def _get_chunks_timed(
         self, objects_key: ObjectKey, manifest: SegmentManifestV1, chunk_ids: Sequence[int]
     ) -> list[bytes]:
+        # The window wait is bounded by the tighter of `get.timeout.ms` and
+        # the ambient end-to-end Deadline; an already-expired deadline fails
+        # fast before any loader is scheduled.
+        check_deadline(f"cache window read of {objects_key}")
         deadline = time.monotonic() + self._config.get_timeout_s
+        ambient = remaining_s()
+        if ambient is not None:
+            deadline = min(deadline, time.monotonic() + ambient)
         self._start_prefetching(objects_key, manifest, chunk_ids[-1])
         futures = self._populate_window(objects_key, manifest, chunk_ids, deadline)
         out: dict[int, bytes] = {}

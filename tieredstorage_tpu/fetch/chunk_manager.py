@@ -25,6 +25,7 @@ from tieredstorage_tpu.storage.core import (
     StorageBackendException,
 )
 from tieredstorage_tpu.transform.api import DetransformOptions, TransformBackend
+from tieredstorage_tpu.utils.deadline import check_deadline
 from tieredstorage_tpu.utils.streams import read_exactly
 from tieredstorage_tpu.utils.tracing import NOOP_TRACER
 
@@ -63,6 +64,10 @@ class DefaultChunkManager(ChunkManager):
     #: Optional latency hook `(elapsed_ms, plaintext_bytes)` per batch; the
     #: RSM wires it to Metrics.record_chunk_fetch.
     on_fetch: Optional[Callable[[float, int], None]] = None
+    #: Optional tail-tolerance hedger (fetch/hedge.py); when set, the ranged
+    #: storage GET of a chunk window is raced against a delayed second
+    #: attempt and the first success wins (`hedge.enabled`).
+    hedger = None
 
     def __init__(
         self,
@@ -125,6 +130,9 @@ class DefaultChunkManager(ChunkManager):
         if len(chunk_ids) == 0:
             return []
         self._check_quarantine(objects_key)
+        # Fast-fail BEFORE the ranged GET: a request whose end-to-end
+        # deadline already expired must not spend a storage round trip.
+        check_deadline(f"chunk fetch of {objects_key}")
         start = time.monotonic()
         index = manifest.chunk_index
         chunks = [index._chunk_at(cid) for cid in chunk_ids]
@@ -134,21 +142,13 @@ class DefaultChunkManager(ChunkManager):
         with self.tracer.span(
             "storage.fetch_chunks", key=objects_key.value, chunks=len(chunks),
         ) as fetch_span:
-            if contiguous:
-                # One ranged GET covering the window on the transformed side.
-                whole = BytesRange.of(
-                    chunks[0].transformed_position,
-                    chunks[-1].transformed_position + chunks[-1].transformed_size - 1,
+            if self.hedger is not None:
+                stored = self.hedger.call(
+                    lambda: self._fetch_stored(objects_key, chunks, contiguous),
+                    what=objects_key.value,
                 )
-                with self._fetcher.fetch(objects_key, whole) as stream:
-                    stored = []
-                    for c in chunks:
-                        stored.append(read_exactly(stream, c.transformed_size))
             else:
-                stored = []
-                for c in chunks:
-                    with self._fetcher.fetch(objects_key, c.range()) as stream:
-                        stored.append(read_exactly(stream, c.transformed_size))
+                stored = self._fetch_stored(objects_key, chunks, contiguous)
             stored_bytes = sum(len(b) for b in stored)
             if fetch_span is not None:
                 fetch_span.attributes["bytes"] = stored_bytes
@@ -176,3 +176,23 @@ class DefaultChunkManager(ChunkManager):
                 (time.monotonic() - start) * 1000.0, sum(len(b) for b in out)
             )
         return out
+
+    def _fetch_stored(self, objects_key: ObjectKey, chunks, contiguous: bool) -> list[bytes]:
+        """Read the stored (transformed) bytes of a chunk window.
+
+        Self-contained and replay-safe — opens, fully reads, and closes its
+        own stream(s) — which is exactly the contract the hedger needs: a
+        discarded losing attempt cannot tear the winner's bytes."""
+        if contiguous:
+            # One ranged GET covering the window on the transformed side.
+            whole = BytesRange.of(
+                chunks[0].transformed_position,
+                chunks[-1].transformed_position + chunks[-1].transformed_size - 1,
+            )
+            with self._fetcher.fetch(objects_key, whole) as stream:
+                return [read_exactly(stream, c.transformed_size) for c in chunks]
+        stored = []
+        for c in chunks:
+            with self._fetcher.fetch(objects_key, c.range()) as stream:
+                stored.append(read_exactly(stream, c.transformed_size))
+        return stored

@@ -156,6 +156,35 @@ class Metrics:
         for tags in self._scopes(topic, partition):
             self._count_rate_total("upload-rollbacks", tags)
 
+    def record_hedge_win(self, ms: float) -> None:
+        """A hedged chunk fetch where the hedge beat the straggling primary;
+        `ms` is the full call latency (primary start → hedge completion)."""
+        self._time("hedge-win-time", {}, ms)
+        self._histogram("hedge-win-time", ms)
+
+    def record_admission_wait(self, ms: float) -> None:
+        """Time an admitted request spent in the bounded admission queue."""
+        self._time("admission-wait-time", {}, ms)
+        self._histogram("admission-wait-time", ms)
+
+    def latency_quantile(self, base: str, q: float) -> Optional[float]:
+        """Bucket-interpolated quantile (ms) of a `<base>-ms` histogram, or
+        None before any observation — the hedge delay's data source
+        (observed chunk-fetch p95 with a static config fallback)."""
+        for metric_name in self.registry.find(f"{base}-ms"):
+            stat = self.registry.stat(metric_name)
+            if isinstance(stat, Histogram) and stat.count > 0:
+                return stat.quantile(q)
+        return None
+
+    def histogram_count(self, base: str) -> int:
+        """Observation count of a `<base>-ms` histogram (0 when absent)."""
+        for metric_name in self.registry.find(f"{base}-ms"):
+            stat = self.registry.stat(metric_name)
+            if isinstance(stat, Histogram):
+                return stat.count
+        return 0
+
     def record_object_upload(
         self, topic: str, partition: int, object_type: str, n_bytes: int
     ) -> None:
@@ -175,13 +204,18 @@ def register_resilience_metrics(
     fault_schedule=None,
     chunk_cache=None,
     chunk_manager=None,
+    hedger=None,
+    retry_budget=None,
+    admission=None,
+    deadline_exceeded_supplier=None,
 ) -> None:
     """Publish resilience counters as gauges (group `resilience-metrics`).
 
-    Components keep plain int counters (storage/resilient.py CircuitBreaker,
-    faults/schedule.py FaultSchedule, fetch/cache ChunkCache,
-    fetch/chunk_manager.py DefaultChunkManager); the RSM registers whichever
-    are wired after configure(), and the docs generator registers all of them
+    Components keep plain int counters (storage/resilient.py CircuitBreaker
+    + RetryBudget, faults/schedule.py FaultSchedule, fetch/cache ChunkCache,
+    fetch/chunk_manager.py DefaultChunkManager, fetch/hedge.py Hedger,
+    utils/admission.py AdmissionController); the RSM registers whichever are
+    wired after configure(), and the docs generator registers all of them
     against throwaway instances.
     """
 
@@ -209,6 +243,34 @@ def register_resilience_metrics(
               lambda: float(chunk_manager.corruptions))
         gauge("quarantined-keys", lambda: float(chunk_manager.quarantined_keys),
               "Object keys currently quarantined after detransform failures")
+    if hedger is not None:
+        gauge("hedges-launched-total", lambda: float(hedger.launched),
+              "Second attempts issued for straggling chunk fetches")
+        gauge("hedges-won-total", lambda: float(hedger.wins),
+              "Hedged fetches where the hedge beat the primary")
+        gauge("hedges-suppressed-total", lambda: float(hedger.suppressed),
+              "Hedges skipped because the hedge budget was exhausted")
+        gauge("hedge-budget-balance", lambda: float(hedger.budget.balance))
+    if retry_budget is not None:
+        gauge("retry-budget-balance", lambda: float(retry_budget.balance))
+        gauge("retry-budget-spent-total", lambda: float(retry_budget.spent),
+              "Storage retries granted by the retry budget")
+        gauge("retry-budget-denied-total", lambda: float(retry_budget.denied),
+              "Storage retries denied (bucket empty) — the call failed with "
+              "its last error instead of amplifying the outage")
+    if admission is not None:
+        gauge("admission-active", lambda: float(admission.active),
+              "Requests currently executing past the admission gate")
+        gauge("admission-queued", lambda: float(admission.queued),
+              "Requests currently waiting in the bounded admission queue")
+        gauge("admission-admitted-total", lambda: float(admission.admitted_total))
+        gauge("admission-shed-total", lambda: float(admission.shed_total),
+              "Requests shed with 429/RESOURCE_EXHAUSTED at the entry gate")
+    if deadline_exceeded_supplier is not None:
+        gauge("deadline-exceeded-total",
+              lambda: float(deadline_exceeded_supplier()),
+              "Requests failed fast because their end-to-end deadline "
+              "expired (process-wide)")
 
 
 def register_tracer_metrics(registry: MetricsRegistry, tracer) -> None:

@@ -64,9 +64,21 @@ from tieredstorage_tpu.storage.core import (
     StorageBackend,
     StorageBackendException,
 )
-from tieredstorage_tpu.storage.resilient import CircuitBreaker, ResilientStorageBackend
+from tieredstorage_tpu.fetch.hedge import HedgeBudget, Hedger
+from tieredstorage_tpu.storage.resilient import (
+    CircuitBreaker,
+    ResilientStorageBackend,
+    RetryBudget,
+)
 from tieredstorage_tpu.transform.api import DetransformOptions, TransformOptions
 from tieredstorage_tpu.transform.pipeline import SegmentTransformation
+from tieredstorage_tpu.utils import deadline as deadline_util
+from tieredstorage_tpu.utils.admission import AdmissionController
+from tieredstorage_tpu.utils.deadline import (
+    DeadlineExceededException,
+    check_deadline,
+    ensure_deadline,
+)
 from tieredstorage_tpu.utils.ratelimit import RateLimitedStream, TokenBucket
 from tieredstorage_tpu.utils.tracing import NOOP_TRACER, Tracer
 from tieredstorage_tpu.utils.streams import ClosableStreamHolder
@@ -77,13 +89,20 @@ log = logging.getLogger(__name__)
 def _traced(name: str):
     """Span around an RSM operation, tagged with topic/partition (SURVEY §5:
     the reference only has SLF4J boundary logs; these spans also forward
-    into jax.profiler timelines when tracing.jax.profiler.enabled)."""
+    into jax.profiler timelines when tracing.jax.profiler.enabled).
+
+    Also the deadline entry point: the operation adopts the ambient
+    end-to-end Deadline (installed by the sidecar boundary from the caller's
+    x-deadline-ms) or starts one from `deadline.default.ms`, and an
+    already-expired budget fails fast here — before any storage work."""
 
     def deco(fn):
         @functools.wraps(fn)
         def wrapper(self, metadata, *args, **kwargs):
             tp = metadata.remote_log_segment_id.topic_id_partition.topic_partition
-            with self.tracer.span(name, topic=tp.topic, partition=tp.partition):
+            with ensure_deadline(self.default_deadline_s), \
+                    self.tracer.span(name, topic=tp.topic, partition=tp.partition):
+                check_deadline(name)
                 return fn(self, metadata, *args, **kwargs)
 
         return wrapper
@@ -106,10 +125,15 @@ class RemoteStorageManager:
         self._indexes_cache: Optional[MemorySegmentIndexesCache] = None
         self._metrics = None
         self._breaker: Optional[CircuitBreaker] = None
+        self._retry_budget: Optional[RetryBudget] = None
+        self._hedger: Optional[Hedger] = None
         self._fault_schedule = None
         self._scrubber = None
         self._scrub_scheduler = None
         self.tracer = NOOP_TRACER
+        #: Entry-gate admission controller (`admission.enabled`); the sidecar
+        #: boundaries (HTTP gateway + gRPC server) shed through this.
+        self.admission: Optional[AdmissionController] = None
 
     # ------------------------------------------------------------------ setup
     def configure(self, configs: Mapping[str, object]) -> None:
@@ -150,6 +174,7 @@ class RemoteStorageManager:
 
         self._chunk_manager = self._build_chunk_manager(backend)
         self._wire_fetch_observability()
+        self._wire_tail_tolerance(config)
 
         self._manifest_cache = MemorySegmentManifestCache()
         self._manifest_cache.configure(config.fetch_manifest_cache_configs())
@@ -218,6 +243,67 @@ class RemoteStorageManager:
             return {"enabled": False}
         return {"enabled": True, **self._scrub_scheduler.status()}
 
+    def _wire_tail_tolerance(self, config: RemoteStorageManagerConfig) -> None:
+        """Hedged chunk fetches (`hedge.*`) and entry admission control
+        (`admission.*`) — the tail-at-scale pair: hedge the stragglers,
+        shed the overload (Dean & Barroso 2013; DAGOR, SOSP 2018)."""
+        if config.hedge_enabled:
+            static_s = config.hedge_delay_ms / 1000.0
+            min_samples = config.hedge_delay_min_samples
+            metrics = self._metrics
+
+            def hedge_delay_s() -> float:
+                # Observed p95 of the chunk-fetch histogram (PR 2) once it
+                # holds enough samples; the static config value until then.
+                if metrics.histogram_count("chunk-fetch-time") >= min_samples:
+                    p95_ms = metrics.latency_quantile("chunk-fetch-time", 0.95)
+                    if p95_ms is not None:
+                        return p95_ms / 1000.0
+                return static_s
+
+            self._hedger = Hedger(
+                hedge_delay_s,
+                HedgeBudget(config.hedge_budget_percent),
+                tracer=self.tracer,
+                on_win=self._metrics.record_hedge_win,
+            )
+            cm = self._chunk_manager
+            inner = cm._delegate if isinstance(cm, ChunkCache) else cm
+            if isinstance(inner, DefaultChunkManager):
+                inner.hedger = self._hedger
+        if config.admission_enabled:
+            self.admission = AdmissionController(
+                config.admission_max_concurrent,
+                config.admission_max_queue,
+                queue_timeout_s=config.admission_queue_timeout_ms / 1000.0,
+                retry_after_s=config.admission_retry_after_ms / 1000.0,
+                on_wait=self._metrics.record_admission_wait,
+            )
+
+    @property
+    def default_deadline_s(self) -> Optional[float]:
+        """`deadline.default.ms` in seconds; the sidecar boundaries and the
+        _traced entry points install this when the caller sent no deadline."""
+        if self._config is None or self._config.deadline_default_ms is None:
+            return None
+        return self._config.deadline_default_ms / 1000.0
+
+    @property
+    def sidecar_grpc_max_workers(self) -> int:
+        """`sidecar.grpc.max.workers` (SidecarServer reads this when no
+        explicit max_workers is passed)."""
+        return (
+            self._config.sidecar_grpc_max_workers if self._config is not None else 8
+        )
+
+    @property
+    def hedger(self) -> Optional[Hedger]:
+        return self._hedger
+
+    @property
+    def retry_budget(self) -> Optional[RetryBudget]:
+        return self._retry_budget
+
     def _wire_fetch_observability(self) -> None:
         """Hand the configured tracer + latency hooks to the fetch tier so
         chunk-fetch/detransform/cache-get land in traces and histograms."""
@@ -234,8 +320,8 @@ class RemoteStorageManager:
         self, config: RemoteStorageManagerConfig, storage: StorageBackend
     ) -> StorageBackend:
         """Layering (innermost first): backend → fault injection (soak runs
-        only) → circuit breaker, so injected faults exercise the breaker the
-        same way real outages do."""
+        only) → circuit breaker + retry budget, so injected faults exercise
+        the breaker and the budgeted retries the same way real outages do."""
         if config.fault_injection_enabled:
             from tieredstorage_tpu.faults import FaultInjectingBackend, FaultSchedule
 
@@ -255,7 +341,20 @@ class RemoteStorageManager:
                     "storage.breaker.transition", from_state=old.name, to_state=new.name
                 ),
             )
-            storage = ResilientStorageBackend(storage, self._breaker)
+        if config.retry_budget_enabled:
+            self._retry_budget = RetryBudget(
+                config.retry_budget_percent,
+                capacity=float(config.retry_budget_capacity),
+            )
+        if self._breaker is not None or self._retry_budget is not None:
+            storage = ResilientStorageBackend(
+                storage,
+                self._breaker,
+                retry_budget=self._retry_budget,
+                max_attempts=config.retry_budget_max_attempts,
+                backoff_s=config.retry_budget_backoff_ms / 1000.0,
+                tracer=self.tracer,
+            )
         return storage
 
     def _register_resilience_metrics(self) -> None:
@@ -269,6 +368,10 @@ class RemoteStorageManager:
             fault_schedule=self._fault_schedule,
             chunk_cache=chunk_cache,
             chunk_manager=inner if isinstance(inner, DefaultChunkManager) else None,
+            hedger=self._hedger,
+            retry_budget=self._retry_budget,
+            admission=self.admission,
+            deadline_exceeded_supplier=deadline_util.exceeded_total,
         )
 
     def _register_cache_metrics(self) -> None:
@@ -365,7 +468,9 @@ class RemoteStorageManager:
                     log.warning(
                         "Failed to clean up partial upload for %s", metadata, exc_info=True
                     )
-            if isinstance(e, RemoteStorageException):
+            if isinstance(e, (RemoteStorageException, DeadlineExceededException)):
+                # DeadlineExceededException stays distinct end to end so the
+                # boundaries map it to 504 / DEADLINE_EXCEEDED.
                 raise
             raise RemoteStorageException(f"Failed to copy segment {metadata}") from e
 
@@ -610,7 +715,8 @@ class RemoteStorageManager:
                 topic, partition, (time.monotonic() - start) * 1000.0
             )
             return stream
-        except (RemoteStorageException, InvalidStartPosition):
+        except (RemoteStorageException, InvalidStartPosition,
+                DeadlineExceededException):
             raise
         except KeyNotFoundException as e:
             raise RemoteResourceNotFoundException(str(e)) from e
@@ -637,6 +743,8 @@ class RemoteStorageManager:
                     lambda: self._fetch_index_bytes(key, segment_index.range(), manifest),
                 )
             )
+        except DeadlineExceededException:
+            raise
         except KeyNotFoundException as e:
             raise RemoteResourceNotFoundException(str(e)) from e
         except StorageBackendException as e:
@@ -714,6 +822,8 @@ class RemoteStorageManager:
     def close(self) -> None:
         if self._scrub_scheduler is not None:
             self._scrub_scheduler.stop()
+        if self._hedger is not None:
+            self._hedger.close()
         if self._config is not None and self._config.tracing_export_path:
             try:
                 self.tracer.write_chrome_trace(self._config.tracing_export_path)

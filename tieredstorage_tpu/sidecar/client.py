@@ -76,13 +76,23 @@ class SidecarRsmClient:
                 response_deserializer=m.response.FromString,
             )
 
+    def _effective_timeout(self, timeout: Optional[float]) -> Optional[float]:
+        """Per-call gRPC timeout clamped to the ambient Deadline's remaining
+        budget, so a late call in a deadlined request can't take a full
+        fresh timeout (cross-layer deadline semantics)."""
+        from tieredstorage_tpu.utils.deadline import remaining_s
+
+        candidates = [t for t in (timeout or self._timeout, remaining_s())
+                      if t is not None]
+        return max(0.001, min(candidates)) if candidates else None
+
     def _invoke(self, name: str, req, timeout: Optional[float] = None):
-        """Unary call inside a client span, traceparent metadata attached
-        (computed INSIDE the span so the server parents under it)."""
+        """Unary call inside a client span; traceparent + deadline metadata
+        attached (computed INSIDE the span so the server parents under it)."""
         with self._tracer.span(f"client.{name}"):
             return self._stubs[name](
-                req, timeout=timeout or self._timeout,
-                metadata=rpc.trace_metadata(self._tracer),
+                req, timeout=self._effective_timeout(timeout),
+                metadata=rpc.invocation_metadata(self._tracer),
             )
 
     # ------------------------------------------------------------- surface
@@ -148,8 +158,8 @@ class SidecarRsmClient:
         try:
             with self._tracer.span(f"client.{name}") as span:
                 for chunk in self._stubs[name](
-                    req, timeout=self._timeout,
-                    metadata=rpc.trace_metadata(self._tracer),
+                    req, timeout=self._effective_timeout(None),
+                    metadata=rpc.invocation_metadata(self._tracer),
                 ):
                     buf.write(chunk.data)
                 if span is not None:

@@ -7,12 +7,21 @@ JDK. Runs inside the sidecar process; `python -m tieredstorage_tpu.sidecar
 --http-port N` starts it next to the gRPC listener.
 
 Error mapping (the shim translates back to KIP-405 exception types):
-404 RemoteResourceNotFoundException, 400 invalid argument, 500 the rest.
+404 RemoteResourceNotFoundException, 400 invalid argument,
+429 + Retry-After admission shed, 504 deadline exceeded, 500 the rest.
+
+Tail tolerance at this boundary (ISSUE 4): the ``x-deadline-ms`` header is
+adopted as the request's end-to-end Deadline (falling back to the RSM's
+``deadline.default.ms``), and every POST passes the RSM's
+AdmissionController — shedding happens BEFORE the request body is read, so
+an overloaded sidecar refuses cheaply instead of buffering segment uploads
+it will never serve.
 """
 
 from __future__ import annotations
 
 import contextlib
+import math
 import pathlib
 import tempfile
 import threading
@@ -23,6 +32,13 @@ from tieredstorage_tpu.errors import RemoteResourceNotFoundException
 from tieredstorage_tpu.manifest.segment_indexes import IndexType
 from tieredstorage_tpu.metadata import LogSegmentData
 from tieredstorage_tpu.sidecar import shimwire
+from tieredstorage_tpu.utils.admission import AdmissionRejectedException
+from tieredstorage_tpu.utils.deadline import (
+    DeadlineExceededException,
+    deadline_scope,
+    ensure_deadline,
+    parse_deadline_ms,
+)
 from tieredstorage_tpu.utils.tracing import NOOP_TRACER
 
 _STREAM_BLOCK = 1 << 20
@@ -130,8 +146,10 @@ class _Handler(BaseHTTPRequestHandler):
         out.seek(0)
         return out
 
-    def _reply(self, status: int, body: bytes = b"") -> None:
+    def _reply(self, status: int, body: bytes = b"", headers=None) -> None:
         self.send_response(status)
+        for name, value in (headers or {}).items():
+            self.send_header(name, value)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         if body:
@@ -161,13 +179,20 @@ class _Handler(BaseHTTPRequestHandler):
                 raise _StreamAborted() from exc
 
     def _fail(self, exc: Exception) -> None:
-        if isinstance(exc, RemoteResourceNotFoundException):
+        headers = None
+        if isinstance(exc, AdmissionRejectedException):
+            status = 429
+            headers = {"Retry-After": str(max(1, math.ceil(exc.retry_after_s)))}
+        elif isinstance(exc, DeadlineExceededException):
+            status = 504
+        elif isinstance(exc, RemoteResourceNotFoundException):
             status = 404
         elif isinstance(exc, (ValueError, KeyError)):
             status = 400
         else:
             status = 500
-        self._reply(status, f"{type(exc).__name__}: {exc}".encode("utf-8"))
+        self._reply(status, f"{type(exc).__name__}: {exc}".encode("utf-8"),
+                    headers=headers)
 
     # ------------------------------------------------------------- handlers
     def do_GET(self) -> None:
@@ -199,6 +224,26 @@ class _Handler(BaseHTTPRequestHandler):
         if handler is None:
             self._reply(404, b"no such endpoint")
             return
+        # Admission gate FIRST — an overloaded sidecar sheds before reading
+        # (and spooling) the request body. The unread body desyncs the
+        # keep-alive framing, so a shed reply also drops the connection.
+        admission = getattr(self.rsm, "admission", None)
+        tracer = getattr(self.rsm, "tracer", NOOP_TRACER)
+        if admission is not None:
+            try:
+                admission.acquire(self.path)
+            except AdmissionRejectedException as exc:
+                tracer.event("admission.shed", path=self.path)
+                self._fail(exc)
+                self.close_connection = True
+                return
+        try:
+            self._handle_admitted(handler, tracer)
+        finally:
+            if admission is not None:
+                admission.release()
+
+    def _handle_admitted(self, handler, tracer) -> None:
         try:
             body = self._body()
         except _BodyTooLarge:
@@ -215,13 +260,24 @@ class _Handler(BaseHTTPRequestHandler):
         # Join the caller's trace (W3C traceparent header, sent by the JVM
         # shim or a Python client) and record the gateway leg as one span —
         # the span covers the streamed response too, so time-to-last-byte of
-        # a fetch is the gateway span's extent.
-        tracer = getattr(self.rsm, "tracer", NOOP_TRACER)
+        # a fetch is the gateway span's extent. The caller's deadline
+        # (x-deadline-ms, remaining budget) is adopted the same way; absent
+        # one, the RSM's configured default applies. The scope covers the
+        # streamed drain, so chunk fetches during the response also honor it.
+        wire_deadline = parse_deadline_ms(self.headers.get(shimwire.DEADLINE_HEADER))
         try:
             with contextlib.closing(body), \
+                    deadline_scope(wire_deadline), \
+                    ensure_deadline(getattr(self.rsm, "default_deadline_s", None)) as deadline, \
                     tracer.continue_trace(
                         self.headers.get(shimwire.TRACEPARENT_HEADER)), \
-                    tracer.span("gateway" + self.path.replace("/v1/", ".")):
+                    tracer.span(
+                        "gateway" + self.path.replace("/v1/", "."),
+                        **(
+                            {"deadline_ms": round(deadline.remaining_s() * 1000.0, 1)}
+                            if deadline is not None else {}
+                        ),
+                    ):
                 handler(body)
         except _StreamAborted:
             # Response already committed; the only safe move is dropping

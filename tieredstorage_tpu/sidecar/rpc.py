@@ -46,12 +46,28 @@ METHODS = {
 #: twin of the HTTP gateway's `traceparent` header (shimwire.TRACEPARENT_HEADER).
 TRACEPARENT_KEY = "traceparent"
 
+#: gRPC invocation-metadata key carrying the remaining end-to-end budget in
+#: integer milliseconds — the gRPC twin of shimwire.DEADLINE_HEADER.
+DEADLINE_KEY = "x-deadline-ms"
+
 
 def trace_metadata(tracer) -> Optional[tuple[tuple[str, str], ...]]:
     """Invocation metadata joining a call to the active trace, or None when
     there is nothing to propagate (tracing disabled / no active span)."""
     traceparent = tracer.current_traceparent() if tracer is not None else None
     return ((TRACEPARENT_KEY, traceparent),) if traceparent else None
+
+
+def invocation_metadata(tracer) -> Optional[tuple[tuple[str, str], ...]]:
+    """Trace + deadline invocation metadata for an outgoing sidecar call;
+    None when neither is active."""
+    from tieredstorage_tpu.utils.deadline import current_deadline
+
+    out = list(trace_metadata(tracer) or ())
+    deadline = current_deadline()
+    if deadline is not None:
+        out.append((DEADLINE_KEY, deadline.header_value()))
+    return tuple(out) or None
 
 
 #: Per-message ceiling for unary payloads (whole segments ride CopyRequest).

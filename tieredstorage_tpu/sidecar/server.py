@@ -27,15 +27,27 @@ from tieredstorage_tpu.manifest.segment_indexes import IndexType
 from tieredstorage_tpu.metadata import LogSegmentData
 from tieredstorage_tpu.sidecar import rpc
 from tieredstorage_tpu.sidecar import sidecar_pb2 as pb
+from tieredstorage_tpu.utils.admission import AdmissionRejectedException
+from tieredstorage_tpu.utils.deadline import (
+    DeadlineExceededException,
+    deadline_scope,
+    ensure_deadline,
+    parse_deadline_ms,
+)
 from tieredstorage_tpu.utils.tracing import NOOP_TRACER
 
 
 class SidecarServer:
     def __init__(
-        self, rsm, *, port: int = 0, host: str = "127.0.0.1", max_workers: int = 8
+        self, rsm, *, port: int = 0, host: str = "127.0.0.1",
+        max_workers: Optional[int] = None,
     ):
         self._rsm = rsm
         self._tracer = getattr(rsm, "tracer", NOOP_TRACER)
+        if max_workers is None:
+            # `sidecar.grpc.max.workers` (config/rsm_config.py); 8 matches
+            # the previously hardcoded pool for unconfigured RSM doubles.
+            max_workers = getattr(rsm, "sidecar_grpc_max_workers", 8)
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers),
             options=rpc.channel_options(),
@@ -79,41 +91,86 @@ class SidecarServer:
         return grpc.method_handlers_generic_handler(rpc.SERVICE, handlers)
 
     def _guard(self, fn, *, name: str, streaming: bool):
-        """Map RSM exceptions to gRPC status codes (also mid-stream), and
-        join the caller's trace: `traceparent` invocation metadata (sent by
-        SidecarRsmClient) parents the server-side span under the client's."""
+        """Map RSM exceptions to gRPC status codes (also mid-stream), join
+        the caller's trace (`traceparent` invocation metadata parents the
+        server-side span under the client's), adopt the caller's deadline
+        (`x-deadline-ms` metadata, remaining budget — falling back to the
+        RSM's `deadline.default.ms`), and gate every RPC through the RSM's
+        AdmissionController: excess load is shed with RESOURCE_EXHAUSTED +
+        a `retry-after` trailer before any storage work happens."""
         tracer = self._tracer
+        rsm = self._rsm
 
         def classify(exc: Exception):
+            if isinstance(exc, DeadlineExceededException):
+                return grpc.StatusCode.DEADLINE_EXCEEDED
             if isinstance(exc, RemoteResourceNotFoundException):
                 return grpc.StatusCode.NOT_FOUND
             if isinstance(exc, (ValueError, KeyError)):
                 return grpc.StatusCode.INVALID_ARGUMENT
             return grpc.StatusCode.INTERNAL
 
-        def traceparent_of(context):
+        def metadata_value(context, wanted_key):
             for key, value in context.invocation_metadata() or ():
-                if key == rpc.TRACEPARENT_KEY:
+                if key == wanted_key:
                     return value
             return None
 
+        def admit(context):
+            """Admission slot, or None after aborting with RESOURCE_EXHAUSTED."""
+            admission = getattr(rsm, "admission", None)
+            if admission is None:
+                return lambda: None
+            try:
+                admission.acquire(name)
+            except AdmissionRejectedException as exc:
+                tracer.event("admission.shed", method=name)
+                context.set_trailing_metadata(
+                    (("retry-after", str(max(1, round(exc.retry_after_s)))),)
+                )
+                context.abort(
+                    grpc.StatusCode.RESOURCE_EXHAUSTED,
+                    f"{type(exc).__name__}: {exc}",
+                )
+            return admission.release
+
         if streaming:
             def wrapped(request, context):
-                with tracer.continue_trace(traceparent_of(context)), \
-                        tracer.span(f"sidecar.{name}"):
-                    try:
-                        yield from fn(request, context)
-                    except Exception as exc:  # noqa: BLE001 — boundary translation
-                        context.abort(classify(exc), f"{type(exc).__name__}: {exc}")
+                release = admit(context)
+                try:
+                    with deadline_scope(
+                            parse_deadline_ms(
+                                metadata_value(context, rpc.DEADLINE_KEY))), \
+                            ensure_deadline(
+                                getattr(rsm, "default_deadline_s", None)), \
+                            tracer.continue_trace(
+                                metadata_value(context, rpc.TRACEPARENT_KEY)), \
+                            tracer.span(f"sidecar.{name}"):
+                        try:
+                            yield from fn(request, context)
+                        except Exception as exc:  # noqa: BLE001 — boundary translation
+                            context.abort(classify(exc), f"{type(exc).__name__}: {exc}")
+                finally:
+                    release()
 
         else:
             def wrapped(request, context):
-                with tracer.continue_trace(traceparent_of(context)), \
-                        tracer.span(f"sidecar.{name}"):
-                    try:
-                        return fn(request, context)
-                    except Exception as exc:  # noqa: BLE001 — boundary translation
-                        context.abort(classify(exc), f"{type(exc).__name__}: {exc}")
+                release = admit(context)
+                try:
+                    with deadline_scope(
+                            parse_deadline_ms(
+                                metadata_value(context, rpc.DEADLINE_KEY))), \
+                            ensure_deadline(
+                                getattr(rsm, "default_deadline_s", None)), \
+                            tracer.continue_trace(
+                                metadata_value(context, rpc.TRACEPARENT_KEY)), \
+                            tracer.span(f"sidecar.{name}"):
+                        try:
+                            return fn(request, context)
+                        except Exception as exc:  # noqa: BLE001 — boundary translation
+                            context.abort(classify(exc), f"{type(exc).__name__}: {exc}")
+                finally:
+                    release()
 
         return wrapped
 

@@ -70,12 +70,32 @@ VERSION = 1
 #: (see module docstring: headers, not frame bytes, carry trace identity).
 TRACEPARENT_HEADER = "traceparent"
 
+#: Remaining end-to-end budget in integer milliseconds — the deadline twin
+#: of the traceparent header (utils/deadline.py). Like trace context, it
+#: rides a header rather than the binary frame so wire version 1 stays
+#: byte-stable and older shims interoperate unchanged.
+DEADLINE_HEADER = "x-deadline-ms"
+
 
 def trace_headers(tracer) -> dict[str, str]:
     """Headers a shim-wire client should attach to join the active trace;
     empty when there is nothing to propagate (tracing disabled / no span)."""
     traceparent = tracer.current_traceparent() if tracer is not None else None
     return {TRACEPARENT_HEADER: traceparent} if traceparent else {}
+
+
+def deadline_headers() -> dict[str, str]:
+    """Header propagating the ambient Deadline's remaining budget; empty
+    when the calling context is unconstrained."""
+    from tieredstorage_tpu.utils.deadline import current_deadline
+
+    deadline = current_deadline()
+    return {DEADLINE_HEADER: deadline.header_value()} if deadline else {}
+
+
+def request_headers(tracer) -> dict[str, str]:
+    """Everything a shim-wire client should attach: trace + deadline."""
+    return {**trace_headers(tracer), **deadline_headers()}
 
 
 COPY_SECTIONS = (

@@ -36,6 +36,12 @@ from email.utils import parsedate_to_datetime
 from typing import BinaryIO, Callable, Mapping, Optional
 from urllib.parse import urlsplit
 
+from tieredstorage_tpu.utils.deadline import (
+    DeadlineExceededException,
+    check_deadline,
+    current_deadline,
+)
+
 
 class HttpError(Exception):
     """Transport-level failure (connect/read), not an HTTP status."""
@@ -268,11 +274,8 @@ class HttpClient:
         replay_safe = (
             idempotent if idempotent is not None else method in self._IDEMPOTENT
         )
-        deadline = (
-            time.monotonic() + policy.total_deadline_s
-            if policy.total_deadline_s is not None
-            else None
-        )
+        check_deadline(f"{method} {path_and_query}")
+        deadline = self._effective_deadline(policy)
         retry_number = 0
         while True:
             try:
@@ -281,10 +284,13 @@ class HttpClient:
                     budget=None if deadline is None else deadline - time.monotonic(),
                 )
             except HttpError:
+                self._raise_if_deadline_spent(method, path_and_query)
                 if not replay_safe or retry_number >= policy.max_attempts - 1:
                     raise
                 delay = policy.backoff_s(retry_number)
                 if deadline is not None and time.monotonic() + delay > deadline:
+                    # The remaining budget can't fit the backoff, let alone
+                    # another attempt: stop retrying.
                     raise
                 time.sleep(delay)
                 retry_number += 1
@@ -347,11 +353,8 @@ class HttpClient:
         (the fetch path re-requests with an adjusted Range rather than
         replaying a partially consumed body)."""
         policy = self.retry if method in self._IDEMPOTENT else NO_RETRY
-        deadline = (
-            time.monotonic() + policy.total_deadline_s
-            if policy.total_deadline_s is not None
-            else None
-        )
+        check_deadline(f"{method} {path_and_query}")
+        deadline = self._effective_deadline(policy)
         retry_number = 0
         while True:
             try:
@@ -360,6 +363,7 @@ class HttpClient:
                     budget=None if deadline is None else deadline - time.monotonic(),
                 )
             except HttpError:
+                self._raise_if_deadline_spent(method, path_and_query)
                 if retry_number >= policy.max_attempts - 1:
                     raise
                 delay = policy.backoff_s(retry_number)
@@ -398,6 +402,31 @@ class HttpClient:
         return resp.status, hdrs, _StreamedBody(resp, conn)
 
     _IDEMPOTENT = frozenset({"GET", "HEAD", "PUT", "DELETE"})
+
+    @staticmethod
+    def _effective_deadline(policy: RetryPolicy) -> Optional[float]:
+        """Absolute monotonic deadline for the whole call: the tighter of the
+        policy's total deadline and the ambient end-to-end Deadline (the
+        cross-layer budget installed at the RSM/gateway entry)."""
+        candidates = []
+        if policy.total_deadline_s is not None:
+            candidates.append(time.monotonic() + policy.total_deadline_s)
+        ambient = current_deadline()
+        if ambient is not None:
+            candidates.append(ambient.at_monotonic)
+        return min(candidates) if candidates else None
+
+    @staticmethod
+    def _raise_if_deadline_spent(method: str, path_and_query: str) -> None:
+        """An attempt that failed AFTER the end-to-end deadline expired
+        surfaces as DeadlineExceededException, not a transport error: the
+        caller's budget is gone, so the distinct type must reach the
+        boundary (504 / DEADLINE_EXCEEDED) instead of a generic failure."""
+        ambient = current_deadline()
+        if ambient is not None and ambient.expired:
+            raise DeadlineExceededException(
+                f"Deadline exceeded during {method} {path_and_query}"
+            )
 
     def _apply_timeout(self, conn, budget) -> None:
         """Effective per-attempt socket timeout = min(client timeout,

@@ -1,15 +1,28 @@
-"""Per-backend circuit breaker: fail fast while the backend is down.
+"""Per-backend circuit breaker + retry budget: fail fast, retry bounded.
 
 The HTTP transport already retries transient 5xx/429 with jittered backoff
 (storage/httpclient.py); this layer sits above it and contains *sustained*
-backend outages: after `failure.threshold` consecutive
-StorageBackendExceptions the breaker opens and every call fails immediately
-with CircuitOpenException (no network), until a `cooldown.ms` period passes
-and a single half-open probe is allowed through — success closes the
-breaker, failure re-opens it. KeyNotFoundException / InvalidRangeException
-are contract responses from a healthy backend and count as successes.
+backend outages two ways:
 
-Wired by the RSM behind the `breaker.enabled` config flag
+- **Circuit breaker**: after `failure.threshold` consecutive
+  StorageBackendExceptions the breaker opens and every call fails
+  immediately with CircuitOpenException (no network), until a `cooldown.ms`
+  period passes and a single half-open probe is allowed through — success
+  closes the breaker, failure re-opens it. KeyNotFoundException /
+  InvalidRangeException are contract responses from a healthy backend and
+  count as successes.
+- **Retry budget** (`retry.budget.*`): a token bucket that earns a fraction
+  of a token per *successful* call and spends one whole token per retry, so
+  the cluster-wide retry amplification factor is capped at
+  1 + percent/100 (plus a fixed initial allowance). Unbounded per-call retry
+  policies multiply: during an outage every caller retries, turning a
+  backend brownout into a self-sustaining retry storm ("Overload Control for
+  Scaling WeChat Microservices", SOSP 2018 measures exactly this spiral). A
+  budget makes retries a *shared, earned* resource: when nothing succeeds,
+  the bucket drains and the layer degrades to single attempts — which is
+  what lets the breaker see the true failure rate and open.
+
+Both are wired by the RSM behind `breaker.enabled` / `retry.budget.enabled`
 (config/rsm_config.py); state and counters are exported as gauges via
 metrics/rsm_metrics.register_resilience_metrics and transitions are recorded
 as tracing events.
@@ -18,6 +31,7 @@ as tracing events.
 from __future__ import annotations
 
 import enum
+import random
 import threading
 import time
 from typing import BinaryIO, Callable, Mapping, Optional
@@ -30,6 +44,7 @@ from tieredstorage_tpu.storage.core import (
     StorageBackend,
     StorageBackendException,
 )
+from tieredstorage_tpu.utils.deadline import DeadlineExceededException, remaining_s
 
 
 class BreakerState(enum.Enum):
@@ -109,6 +124,13 @@ class CircuitBreaker:
             self._probe_in_flight = False
             self._transition_locked(BreakerState.CLOSED)
 
+    def on_neutral(self) -> None:
+        """The call neither proves nor indicts the backend (e.g. the caller's
+        deadline expired client-side): release a half-open probe slot without
+        moving the state machine either way."""
+        with self._lock:
+            self._probe_in_flight = False
+
     def on_failure(self) -> None:
         with self._lock:
             self._consecutive_failures += 1
@@ -121,12 +143,72 @@ class CircuitBreaker:
                 self._transition_locked(BreakerState.OPEN)
 
 
-class ResilientStorageBackend(StorageBackend):
-    """StorageBackend decorator routing every call through a CircuitBreaker."""
+class RetryBudget:
+    """Token bucket capping retry amplification across the whole backend.
 
-    def __init__(self, delegate: StorageBackend, breaker: CircuitBreaker) -> None:
+    Earns ``percent/100`` tokens per successful call (capped at `capacity`,
+    which is also the initial balance — a fixed allowance so cold starts and
+    short blips can still retry), spends one token per retry. With ratio r,
+    long-run retries ≤ r × successes + capacity: under a sustained 100%
+    outage the bucket drains and stays empty, so amplification converges to
+    exactly 1.0 instead of `max_attempts`."""
+
+    def __init__(self, percent: int, capacity: float = 10.0) -> None:
+        if not 0 < percent <= 100:
+            raise ValueError(f"retry budget percent must be in (0, 100], got {percent}")
+        self._earn = percent / 100.0
+        self._capacity = max(1.0, capacity)
+        self._balance = self._capacity
+        self._lock = threading.Lock()
+        #: Retries granted / denied (exported as resilience gauges).
+        self.spent = 0
+        self.denied = 0
+
+    @property
+    def balance(self) -> float:
+        with self._lock:
+            return self._balance
+
+    def deposit(self) -> None:
+        with self._lock:
+            self._balance = min(self._capacity, self._balance + self._earn)
+
+    def try_spend(self) -> bool:
+        with self._lock:
+            if self._balance >= 1.0:
+                self._balance -= 1.0
+                self.spent += 1
+                return True
+            self.denied += 1
+            return False
+
+
+class ResilientStorageBackend(StorageBackend):
+    """StorageBackend decorator: circuit breaker + budgeted retries.
+
+    Layering per call (replay-safe ops only — upload streams are consumed by
+    the first attempt and are never replayed here; the RSM's orphan cleanup
+    + broker re-copy own that path): breaker gate → delegate call → on
+    failure, retry only while the budget has tokens, the deadline has room
+    for the backoff, and `max_attempts` isn't exhausted. Each retry re-takes
+    the breaker gate, so a retry storm can never bypass an opening breaker."""
+
+    def __init__(
+        self,
+        delegate: StorageBackend,
+        breaker: Optional[CircuitBreaker] = None,
+        *,
+        retry_budget: Optional[RetryBudget] = None,
+        max_attempts: int = 3,
+        backoff_s: float = 0.01,
+        tracer=None,
+    ) -> None:
         self._delegate = delegate
         self.breaker = breaker
+        self.retry_budget = retry_budget
+        self._max_attempts = max(1, max_attempts)
+        self._backoff_s = backoff_s
+        self._tracer = tracer
 
     @property
     def delegate(self) -> StorageBackend:
@@ -135,22 +217,66 @@ class ResilientStorageBackend(StorageBackend):
     def configure(self, configs: Mapping[str, object]) -> None:
         self._delegate.configure(configs)
 
-    def _call(self, fn, *args):
-        self.breaker.acquire()
+    def _attempt(self, fn, *args):
+        """One breaker-accounted delegate call."""
+        if self.breaker is not None:
+            self.breaker.acquire()
         try:
             result = fn(*args)
         except (KeyNotFoundException, InvalidRangeException):
             # The backend answered; the request was just unsatisfiable.
-            self.breaker.on_success()
+            if self.breaker is not None:
+                self.breaker.on_success()
+            raise
+        except DeadlineExceededException:
+            # Caller impatience, not backend failure: opening the breaker on
+            # tight-deadline traffic would turn slow callers into an outage.
+            if self.breaker is not None:
+                self.breaker.on_neutral()
             raise
         except Exception:
-            self.breaker.on_failure()
+            if self.breaker is not None:
+                self.breaker.on_failure()
             raise
-        self.breaker.on_success()
+        if self.breaker is not None:
+            self.breaker.on_success()
         return result
 
+    def _call(self, fn, *args, replayable: bool = True):
+        attempt = 0
+        while True:
+            try:
+                result = self._attempt(fn, *args)
+            except (KeyNotFoundException, InvalidRangeException):
+                if self.retry_budget is not None:
+                    self.retry_budget.deposit()  # contract answer = healthy
+                raise
+            except (CircuitOpenException, DeadlineExceededException):
+                raise  # fast-fail paths are never retried
+            except StorageBackendException:
+                if (
+                    not replayable
+                    or self.retry_budget is None
+                    or attempt >= self._max_attempts - 1
+                    or not self.retry_budget.try_spend()
+                ):
+                    raise
+                delay = random.uniform(0.0, self._backoff_s * (2**attempt))
+                budget = remaining_s()
+                if budget is not None and delay >= budget:
+                    raise  # the deadline can't fit another attempt + backoff
+                if self._tracer is not None:
+                    self._tracer.event("storage.retry", attempt=attempt + 1)
+                time.sleep(delay)
+                attempt += 1
+                continue
+            if self.retry_budget is not None:
+                self.retry_budget.deposit()
+            return result
+
     def upload(self, input_stream: BinaryIO, key: ObjectKey) -> int:
-        return self._call(self._delegate.upload, input_stream, key)
+        # Not replayable: the first attempt consumes the stream.
+        return self._call(self._delegate.upload, input_stream, key, replayable=False)
 
     def fetch(self, key: ObjectKey, byte_range: Optional[BytesRange] = None) -> BinaryIO:
         return self._call(self._delegate.fetch, key, byte_range)
@@ -159,7 +285,8 @@ class ResilientStorageBackend(StorageBackend):
         return self._call(self._delegate.delete, key)
 
     def delete_all(self, keys) -> None:
-        return self._call(self._delegate.delete_all, keys)
+        # Materialized so a budgeted replay re-deletes the same key list.
+        return self._call(self._delegate.delete_all, list(keys))
 
     def list_objects(self, prefix: str = ""):
         # Materialized under the breaker so mid-iteration page failures count

@@ -1,0 +1,103 @@
+"""Admission control: bounded concurrency + bounded queue at the entry point.
+
+The sidecar previously accepted unlimited concurrent work: the HTTP gateway
+is a ThreadingHTTPServer (a thread per connection) and the gRPC server has a
+worker pool but an unbounded accept queue, so overload manifested as
+ever-growing queues, memory growth, and every request timing out together —
+the classic congestion-collapse shape. DAGOR ("Overload Control for Scaling
+WeChat Microservices", SOSP 2018) is explicit that shedding must happen at
+the *entry* of the service, before any real work (here: before the request
+body is even read), and that rejected callers must be told to back off.
+
+``AdmissionController`` is that gate: at most ``max_concurrent`` requests
+execute, at most ``max_queue`` more wait (bounded, with a wait deadline),
+and everything beyond that is shed immediately with
+``AdmissionRejectedException`` carrying a Retry-After hint — the boundaries
+translate it to HTTP 429 + ``Retry-After`` and gRPC ``RESOURCE_EXHAUSTED``.
+Counters are plain ints exported as resilience gauges; ``on_wait`` feeds the
+admission-wait-time histogram.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+
+class AdmissionRejectedException(Exception):
+    """The request was shed at the entry gate; retry after `retry_after_s`."""
+
+    def __init__(self, message: str, retry_after_s: float) -> None:
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class AdmissionController:
+    def __init__(
+        self,
+        max_concurrent: int,
+        max_queue: int,
+        *,
+        queue_timeout_s: float = 1.0,
+        retry_after_s: float = 1.0,
+        on_wait: Optional[Callable[[float], None]] = None,
+    ) -> None:
+        if max_concurrent < 1:
+            raise ValueError("max_concurrent must be >= 1")
+        if max_queue < 0:
+            raise ValueError("max_queue must be >= 0")
+        self._max_concurrent = max_concurrent
+        self._max_queue = max_queue
+        self._queue_timeout_s = queue_timeout_s
+        self.retry_after_s = retry_after_s
+        self.on_wait = on_wait
+        self._cond = threading.Condition()
+        #: Requests currently executing / currently queued (gauges).
+        self.active = 0
+        self.queued = 0
+        #: Cumulative admissions and sheds (gauges).
+        self.admitted_total = 0
+        self.shed_total = 0
+
+    def acquire(self, what: str = "") -> None:
+        """Admit or shed. Blocks at most `queue_timeout_s` in the bounded
+        queue; raises AdmissionRejectedException when the queue is full or
+        the wait times out. Pair with release() in a finally block."""
+        start = time.monotonic()
+        with self._cond:
+            if self.active < self._max_concurrent:
+                self.active += 1
+                self.admitted_total += 1
+                return
+            if self.queued >= self._max_queue:
+                self.shed_total += 1
+                raise AdmissionRejectedException(
+                    f"admission queue full ({self.active} active, "
+                    f"{self.queued} queued): {what or 'request'} shed",
+                    self.retry_after_s,
+                )
+            self.queued += 1
+            try:
+                deadline = start + self._queue_timeout_s
+                while self.active >= self._max_concurrent:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        self.shed_total += 1
+                        raise AdmissionRejectedException(
+                            f"queued {self._queue_timeout_s * 1000:.0f} ms without "
+                            f"a slot: {what or 'request'} shed",
+                            self.retry_after_s,
+                        )
+                    self._cond.wait(remaining)
+                self.active += 1
+                self.admitted_total += 1
+            finally:
+                self.queued -= 1
+        if self.on_wait is not None:
+            self.on_wait((time.monotonic() - start) * 1000.0)
+
+    def release(self) -> None:
+        with self._cond:
+            self.active -= 1
+            self._cond.notify()
