@@ -1,7 +1,7 @@
 # Developer entry points (counterpart of /root/reference/Makefile).
 PYTHON ?= python
 
-.PHONY: test test-e2e chaos bench demo trace-demo scrub-demo tail-demo docs docker lint mutation clean
+.PHONY: test test-e2e chaos bench demo trace-demo scrub-demo tail-demo failover-demo docs docker lint mutation clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q --ignore=tests/e2e
@@ -44,6 +44,17 @@ scrub-demo:
 # artifacts/tail_report.json.
 tail-demo:
 	$(PYTHON) tools/tail_demo.py --out artifacts/tail_report.json
+
+# Replication gate: a 2-replica store under seeded traffic, the primary
+# hard-killed mid-run by a *:raise@from=N fault schedule. 100% of fetches
+# must succeed with byte-identical payloads (health-probed failover, p99
+# inside the deadline budget), a write during the outage must miss the
+# quorum and roll back with ZERO orphans on the surviving replica, and one
+# anti-entropy pass must converge the revived replica (chunkChecksums
+# arbitration for the corrupt copy; second pass reports zero diffs). Writes
+# and re-validates artifacts/failover_report.json.
+failover-demo:
+	$(PYTHON) tools/failover_demo.py --out artifacts/failover_report.json
 
 docs:
 	$(PYTHON) -m tieredstorage_tpu.docs.configs_docs > docs/configs.rst

@@ -148,3 +148,28 @@ class StorageContract:
         backend.upload(io.BytesIO(b"v"), b)
         backend.delete(a)
         assert [k.value for k in backend.list_objects("list/")] == ["list/b"]
+
+
+class ListPaginationContract:
+    """Opt-in >1000-key pagination section: cloud listings page at 1000 keys
+    (S3 ListObjectsV2, GCS, Azure markers), so any backend or decorator that
+    enumerates — scrubber, anti-entropy, replicated stores — must chain
+    pages transparently and preserve global lexicographic order across page
+    boundaries. Mixed into suites whose seeding is cheap (in-memory children,
+    emulator state injection via `seed_keys`); emulator-backed suites with
+    expensive uploads keep their dedicated pagination tests."""
+
+    PAGINATION_KEYS = 1050
+
+    def seed_keys(self, backend, keys):
+        """Put one empty object per key; override to inject state directly."""
+        for k in keys:
+            backend.upload(io.BytesIO(b""), ObjectKey(k))
+
+    def test_list_objects_beyond_one_page(self, backend):
+        keys = [f"page/{i:06d}" for i in range(self.PAGINATION_KEYS)]
+        self.seed_keys(backend, keys)
+        self.seed_keys(backend, ["other/x"])
+        listed = [k.value for k in backend.list_objects("page/")]
+        assert listed == keys
+        assert len(list(backend.list_objects())) == self.PAGINATION_KEYS + 1

@@ -159,6 +159,65 @@ def test_keystream_pallas_gate_defaults_off_on_cpu(monkeypatch):
     assert not _use_pallas_circuit(1 << 20)
 
 
+class TestForcedPathCrosscheck:
+    """TIEREDSTORAGE_TPU_PALLAS=1 bypasses the preflight, so the forced
+    gate must run the TSTPU_AES_R OUTPUT cross-check itself (not just the
+    import-time range check): a behaviorally mistiled kernel body has to
+    fail loud at first use, never corrupt keystream silently."""
+
+    def test_forced_gate_runs_and_memoizes_the_crosscheck(self, monkeypatch):
+        from tieredstorage_tpu.ops import aes_bitsliced, aes_pallas
+
+        monkeypatch.setenv("TIEREDSTORAGE_TPU_PALLAS", "1")
+        monkeypatch.setattr(aes_bitsliced, "_FORCED_CROSSCHECK", [])
+        calls = []
+        real = aes_pallas.kernel_body_reference
+
+        def counting(rk, state):
+            calls.append(1)
+            return real(rk, state)
+
+        monkeypatch.setattr(aes_pallas, "kernel_body_reference", counting)
+        assert aes_bitsliced._use_pallas_circuit(8)
+        assert aes_bitsliced._use_pallas_circuit(1 << 20)
+        # One cross-check per process, verdict memoized.
+        assert len(calls) == 1
+
+    def test_mistiled_kernel_fails_loud_not_silent(self, monkeypatch):
+        """A kernel body whose output diverges (what a mistiled R produces)
+        must raise on the forced path — NOT return False and quietly fall
+        back, and NOT return True and corrupt keystream."""
+        from tieredstorage_tpu.ops import aes_bitsliced, aes_pallas
+
+        monkeypatch.setenv("TIEREDSTORAGE_TPU_PALLAS", "1")
+        monkeypatch.setattr(aes_bitsliced, "_FORCED_CROSSCHECK", [])
+        real = aes_pallas.kernel_body_reference
+        monkeypatch.setattr(
+            aes_pallas,
+            "kernel_body_reference",
+            lambda rk, state: real(rk, state) ^ jnp.uint32(1),  # one flipped bit
+        )
+        with pytest.raises(RuntimeError, match="diverges"):
+            aes_bitsliced._use_pallas_circuit(8)
+        # The bad verdict stays memoized: every later use keeps failing loud.
+        with pytest.raises(RuntimeError, match="diverges"):
+            aes_bitsliced._use_pallas_circuit(1 << 20)
+
+    def test_kernel_body_reference_matches_circuit(self):
+        """The shared evaluator the cross-check runs is itself bit-exact
+        against the XLA circuit on the configured R."""
+        from tieredstorage_tpu.ops import aes_pallas
+        from tieredstorage_tpu.ops.aes_bitsliced import aes_encrypt_planes
+
+        rng = np.random.default_rng(9)
+        rk = jnp.asarray(make_rk_planes(KEY))
+        w = aes_pallas.WORDS_PER_STEP
+        state = jnp.asarray(rng.integers(0, 2**32, (16, 8, w), dtype=np.uint32))
+        got = np.asarray(aes_pallas.kernel_body_reference(rk, state))
+        expected = np.asarray(jax.jit(aes_encrypt_planes)(rk, state))
+        np.testing.assert_array_equal(got, expected)
+
+
 def test_preflight_failure_degrades_to_xla_circuit(monkeypatch):
     """A Mosaic lowering/runtime failure must disable the kernel, not raise:
     the unattended round-end bench warms this path and an exception there

@@ -1,0 +1,578 @@
+"""Replicated multi-backend storage: quorum writes, failover, anti-entropy.
+
+Four legs:
+
+1. The FULL storage contract (tests/storage_contract.py, including the
+   >1000-key pagination section) must hold over ReplicatedStorageBackend
+   with 2 and 3 replicas — replication is a decorator, not a new contract.
+2. The same contract under faults: an independent FaultSchedule per replica
+   with the primary hard-down for every fetch (`fetch:raise@every=1`) must
+   surface ZERO errors — every read is served by the secondary.
+3. Quorum-write semantics: sub-quorum writes roll back the copies that did
+   land (zero orphans on the surviving replicas) and raise; met-quorum
+   writes succeed with a degraded replica.
+4. Health scoring/probing, replica-aware hedging, and anti-entropy repair
+   (missing copies, divergent copies, chunkChecksums arbitration for .log
+   objects, convergence to zero diffs).
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import json
+import threading
+import time
+
+import pytest
+
+from tests.storage_contract import KEY, ListPaginationContract, StorageContract
+from tieredstorage_tpu.faults import FaultInjectingBackend, FaultSchedule
+from tieredstorage_tpu.faults.schedule import FaultInjectedException
+from tieredstorage_tpu.fetch.chunk_manager import DefaultChunkManager
+from tieredstorage_tpu.fetch.hedge import HedgeBudget, Hedger
+from tieredstorage_tpu.manifest.chunk_index import (
+    FixedSizeChunkIndex,
+    chunk_index_to_json,
+)
+from tieredstorage_tpu.ops.crc32c import crc32c_host
+from tieredstorage_tpu.scrub.antientropy import (
+    AntiEntropyRepairer,
+    AntiEntropyScheduler,
+)
+from tieredstorage_tpu.storage.core import (
+    KeyNotFoundException,
+    ObjectKey,
+    StorageBackendException,
+)
+from tieredstorage_tpu.storage.memory import InMemoryStorage
+from tieredstorage_tpu.storage.replicated import (
+    AllReplicasFailedException,
+    HealthProber,
+    QuorumWriteException,
+    ReplicatedStorageBackend,
+    ReplicaState,
+)
+from tieredstorage_tpu.storage.resilient import CircuitBreaker, ResilientStorageBackend
+from tieredstorage_tpu.utils.deadline import Deadline, deadline_scope
+from tieredstorage_tpu.utils.tracing import Tracer
+
+
+def mem() -> InMemoryStorage:
+    b = InMemoryStorage()
+    b.configure({})
+    return b
+
+
+def replicated(n: int, **kwargs) -> ReplicatedStorageBackend:
+    return ReplicatedStorageBackend(
+        [(f"r{i}", mem()) for i in range(n)], **kwargs
+    )
+
+
+# --------------------------------------------------------- contract, 2 and 3
+class TestReplicated2Contract(StorageContract, ListPaginationContract):
+    @pytest.fixture
+    def backend(self):
+        return replicated(2)
+
+
+class TestReplicated3Contract(StorageContract, ListPaginationContract):
+    @pytest.fixture
+    def backend(self):
+        return replicated(3)
+
+
+class TestReplicatedMixedContract(StorageContract):
+    """Heterogeneous children: one in-memory, one filesystem."""
+
+    @pytest.fixture
+    def backend(self, tmp_storage_root):
+        from tieredstorage_tpu.storage.filesystem import FileSystemStorage
+
+        fs = FileSystemStorage()
+        fs.configure({"root": str(tmp_storage_root), "overwrite.enabled": True})
+        return ReplicatedStorageBackend([("mem", mem()), ("fs", fs)])
+
+
+# ------------------------------------------------- contract under faults
+@pytest.mark.chaos
+class TestReplicatedContractPrimaryDown(StorageContract):
+    """Primary hard-down for EVERY fetch: an independent schedule per
+    replica, reads all served by the secondary, zero errors surfaced."""
+
+    @pytest.fixture
+    def backend(self):
+        primary = FaultInjectingBackend(
+            mem(), FaultSchedule.parse("fetch:raise@every=1", seed=1)
+        )
+        secondary = FaultInjectingBackend(mem(), FaultSchedule.parse([], seed=2))
+        return ReplicatedStorageBackend(
+            [("primary", primary), ("secondary", secondary)]
+        )
+
+
+@pytest.mark.chaos
+class TestReplicatedContractListFaults(StorageContract):
+    """Listing faults on the primary fail over the same way fetches do."""
+
+    @pytest.fixture
+    def backend(self):
+        primary = FaultInjectingBackend(
+            mem(), FaultSchedule.parse("list:raise@every=1; fetch:delay=1@every=5", seed=3)
+        )
+        return ReplicatedStorageBackend([("primary", primary), ("secondary", mem())])
+
+
+@pytest.mark.chaos
+class TestReplicatedFailoverServesEveryRead:
+    def test_zero_errors_and_byte_identical_under_primary_outage(self):
+        primary = FaultInjectingBackend(
+            mem(), FaultSchedule.parse("fetch:raise@every=1", seed=11)
+        )
+        rep = ReplicatedStorageBackend([("p", primary), ("s", mem())])
+        payloads = {
+            f"seg/{i:04d}.log": bytes([i % 256]) * (100 + i) for i in range(40)
+        }
+        for k, v in payloads.items():
+            rep.upload(io.BytesIO(v), ObjectKey(k))
+        for k, v in payloads.items():
+            with rep.fetch(ObjectKey(k)) as s:
+                assert s.read() == v
+        # The first read(s) failed over off the dead primary; once its error
+        # EWMA sinks, reads go secondary-first without paying the failed
+        # attempt — both paths must surface zero errors.
+        assert rep.failovers >= 1
+        assert primary.schedule.calls("fetch") >= 1
+
+
+# --------------------------------------------------------------- quorum
+class TestQuorumWrites:
+    def _down(self) -> FaultInjectingBackend:
+        return FaultInjectingBackend(
+            mem(), FaultSchedule.parse("upload:raise@every=1")
+        )
+
+    def test_default_quorum_is_all_replicas(self):
+        rep = replicated(3)
+        assert rep.write_quorum == 3
+
+    def test_sub_quorum_rolls_back_and_raises(self):
+        good = mem()
+        rep = ReplicatedStorageBackend(
+            [("good", good), ("down", self._down())], write_quorum=2
+        )
+        with pytest.raises(QuorumWriteException):
+            rep.upload(io.BytesIO(b"payload"), KEY)
+        # Zero orphans on the surviving replica.
+        assert good.keys() == []
+        assert rep.quorum_failures == 1
+
+    def test_met_quorum_succeeds_with_replica_down(self):
+        good = mem()
+        rep = ReplicatedStorageBackend(
+            [("good", good), ("down", self._down())], write_quorum=1
+        )
+        assert rep.upload(io.BytesIO(b"payload"), KEY) == 7
+        assert good.object(KEY.value) == b"payload"
+        assert rep.quorum_failures == 0
+
+    def test_quorum_larger_than_replicas_rejected(self):
+        with pytest.raises(ValueError):
+            ReplicatedStorageBackend([("a", mem())], write_quorum=2)
+
+    def test_each_replica_gets_independent_stream(self):
+        """One consumed source stream must still reach every replica."""
+        rep = replicated(3)
+        data = bytes(range(256)) * 100
+        rep.upload(io.BytesIO(data), KEY)
+        for state in rep.replica_states:
+            assert state.backend.object(KEY.value) == data
+
+    def test_delete_converges_or_raises(self):
+        flaky = FaultInjectingBackend(
+            mem(), FaultSchedule.parse("delete:raise@1")
+        )
+        rep = ReplicatedStorageBackend([("ok", mem()), ("flaky", flaky)])
+        rep.upload(io.BytesIO(b"x"), KEY)
+        with pytest.raises(StorageBackendException):
+            rep.delete(KEY)
+        # Idempotent retry converges once the replica recovers.
+        rep.delete(KEY)
+        for state in rep.replica_states:
+            with pytest.raises(KeyNotFoundException):
+                state.backend.fetch(KEY)
+
+
+# ----------------------------------------------------------- read failover
+class TestReadFailover:
+    def test_contract_answers_win_over_replica_errors(self):
+        """A replica outage must not mask a KeyNotFound answer from the
+        replica that could actually be consulted."""
+        down = FaultInjectingBackend(
+            mem(), FaultSchedule.parse("fetch:raise@every=1")
+        )
+        rep = ReplicatedStorageBackend([("down", down), ("ok", mem())])
+        with pytest.raises(KeyNotFoundException):
+            rep.fetch(ObjectKey("no/such/key"))
+
+    def test_key_only_on_secondary_is_served(self):
+        """Divergent replicas: a key missing on the healthiest replica is
+        consulted on the others before KeyNotFound is surfaced."""
+        a, b = mem(), mem()
+        rep = ReplicatedStorageBackend([("a", a), ("b", b)])
+        b.upload(io.BytesIO(b"only-on-b"), KEY)
+        with rep.fetch(KEY) as s:
+            assert s.read() == b"only-on-b"
+        assert rep.failovers == 1
+
+    def test_all_replicas_down_raises_aggregate(self):
+        rep = ReplicatedStorageBackend([
+            ("a", FaultInjectingBackend(mem(), FaultSchedule.parse("fetch:raise"))),
+            ("b", FaultInjectingBackend(mem(), FaultSchedule.parse("fetch:raise"))),
+        ])
+        with pytest.raises(AllReplicasFailedException):
+            rep.fetch(KEY)
+
+    def test_failover_events_and_histogram_hook(self):
+        tracer = Tracer(enabled=True)
+        down = FaultInjectingBackend(
+            mem(), FaultSchedule.parse("fetch:raise@every=1")
+        )
+        rep = ReplicatedStorageBackend(
+            [("down", down), ("ok", mem())], tracer=tracer
+        )
+        wins: list[float] = []
+        rep.on_failover = wins.append
+        rep.upload(io.BytesIO(b"x"), KEY)
+        with rep.fetch(KEY) as s:
+            assert s.read() == b"x"
+        assert len(wins) == 1 and wins[0] >= 0.0
+        events = [s for s in tracer.spans("storage.failover")]
+        assert events and events[0].attributes["to_replica"] == "ok"
+
+    def test_expired_deadline_stops_failover(self):
+        from tieredstorage_tpu.utils.deadline import DeadlineExceededException
+
+        down = FaultInjectingBackend(
+            mem(), FaultSchedule.parse("fetch:raise@every=1")
+        )
+        rep = ReplicatedStorageBackend([("down", down), ("ok", mem())])
+        rep.upload(io.BytesIO(b"x"), KEY)
+        expired = Deadline.after(-1.0)
+        with deadline_scope(expired), pytest.raises(DeadlineExceededException):
+            rep.fetch(KEY)
+
+
+# ------------------------------------------------------------------ health
+class TestHealthScoring:
+    def test_errors_lower_the_score(self):
+        state = ReplicaState("a", mem())
+        healthy = state.health_score()
+        for _ in range(5):
+            state.record(ok=False, latency_ms=1.0)
+        assert state.health_score() < healthy
+
+    def test_open_breaker_floors_the_score(self):
+        breaker = CircuitBreaker(failure_threshold=1)
+        backend = ResilientStorageBackend(mem(), breaker)
+        state = ReplicaState("a", backend)
+        assert state.health_score() > 0.5
+        breaker.on_failure()
+        assert state.health_score() == 0.0
+
+    def test_reads_prefer_the_healthy_replica(self):
+        flaky = FaultInjectingBackend(
+            mem(), FaultSchedule.parse("fetch:raise@every=1")
+        )
+        rep = ReplicatedStorageBackend([("flaky", flaky), ("steady", mem())])
+        rep.upload(io.BytesIO(b"x"), KEY)
+        for _ in range(5):
+            with rep.fetch(KEY) as s:
+                assert s.read() == b"x"
+        # After the flaky replica accumulated errors, reads go steady-first:
+        # the flaky fetch counter stops advancing.
+        calls_before = flaky.schedule.calls("fetch")
+        for _ in range(5):
+            with rep.fetch(KEY) as s:
+                assert s.read() == b"x"
+        assert flaky.schedule.calls("fetch") == calls_before
+        assert rep.replica_health()["steady"] > rep.replica_health()["flaky"]
+
+    def test_prober_marks_dark_replica(self):
+        dark = FaultInjectingBackend(
+            mem(), FaultSchedule.parse("list:raise@every=1")
+        )
+        rep = ReplicatedStorageBackend([("dark", dark), ("lit", mem())])
+        prober = HealthProber(rep.replica_states, 3600.0)
+        prober.probe_once()
+        prober.probe_once()
+        health = rep.replica_health()
+        assert health["lit"] > health["dark"]
+        dark_state = next(s for s in rep.replica_states if s.name == "dark")
+        assert dark_state.probes == 2 and dark_state.probe_failures == 2
+
+    def test_prober_thread_runs_and_stops(self):
+        rep = replicated(2, probe_interval_s=0.01)
+        try:
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if all(s.probes >= 2 for s in rep.replica_states):
+                    break
+                time.sleep(0.01)
+            assert all(s.probes >= 2 for s in rep.replica_states)
+        finally:
+            rep.close()
+        assert rep.prober is None
+
+
+# ---------------------------------------------------- replica-aware hedging
+class TestReplicaAwareHedging:
+    def test_hedge_fn_races_distinct_callable(self):
+        budget = HedgeBudget(100)
+        hedger = Hedger(lambda: 0.01, budget, max_workers=4)
+        release = threading.Event()
+
+        def slow_primary():
+            release.wait(timeout=5.0)
+            return "primary"
+
+        try:
+            result = hedger.call(slow_primary, hedge_fn=lambda: "replica-2")
+            assert result == "replica-2"
+            assert hedger.wins == 1 and hedger.launched == 1
+        finally:
+            release.set()
+            hedger.close()
+
+    def test_chunk_manager_builds_distinct_replica_hedge(self):
+        """The hedge attempt for a replicated fetcher reads the SAME window
+        from the second-healthiest replica directly."""
+        a, b = mem(), mem()
+        rep = ReplicatedStorageBackend([("a", a), ("b", b)])
+        data = b"0123456789abcdef"
+        rep.upload(io.BytesIO(data), KEY)
+        cm = DefaultChunkManager(rep, None)
+        index = FixedSizeChunkIndex(8, len(data), 8, 8)
+        chunks = index.chunks()
+        hedge = cm._hedge_attempt(KEY, chunks, contiguous=True)
+        assert hedge is not None
+        # Erase the object from the primary-ordered replica only: the hedge
+        # must still succeed because it reads the OTHER replica.
+        ordered = rep.read_fetchers()
+        with ordered[0]._lock:
+            ordered[0]._objects.pop(KEY.value)
+        assert b"".join(hedge()) == data
+
+    def test_single_store_fetcher_has_no_distinct_hedge(self):
+        cm = DefaultChunkManager(mem(), None)
+        assert cm._hedge_attempt(KEY, [], contiguous=True) is None
+
+
+# ------------------------------------------------------------- anti-entropy
+class TestAntiEntropy:
+    def test_missing_copy_is_restored(self):
+        rep = replicated(2)
+        rep.upload(io.BytesIO(b"payload"), KEY)
+        rep.replica_states[1].backend.delete(KEY)
+        repairer = AntiEntropyRepairer(rep)
+        report = repairer.run_once()
+        assert report.missing_copies == 1 and report.repairs == 1
+        assert rep.replica_states[1].backend.object(KEY.value) == b"payload"
+        assert repairer.run_once().in_sync
+
+    def test_divergent_copy_majority_wins_with_three_replicas(self):
+        rep = replicated(3)
+        rep.upload(io.BytesIO(b"correct"), KEY)
+        rogue = rep.replica_states[2].backend
+        rogue.upload(io.BytesIO(b"stale!!"), KEY)
+        report = AntiEntropyRepairer(rep).run_once()
+        assert report.divergent_keys == 1 and report.repairs == 1
+        for state in rep.replica_states:
+            assert state.backend.object(KEY.value) == b"correct"
+
+    def test_log_divergence_arbitrated_by_chunk_checksums(self):
+        """A 2-replica split is a 1-1 majority tie; the manifest's
+        chunkChecksums must pick the intact copy even when the CORRUPT copy
+        sits on the healthier replica."""
+        rep = replicated(2)
+        good = b"A" * 64 + b"B" * 64
+        bad = b"A" * 64 + b"X" * 64
+        log_key = "seg/00000000000000000000.log"
+        manifest_key = "seg/00000000000000000000.rsm-manifest"
+        index = FixedSizeChunkIndex(64, 128, 64, 64)
+        checksums = [crc32c_host(good[:64]), crc32c_host(good[64:])]
+        manifest = json.dumps({
+            "version": "1",
+            "chunkIndex": chunk_index_to_json(index),
+            "chunkChecksums": base64.b64encode(
+                b"".join(c.to_bytes(4, "big") for c in checksums)
+            ).decode("ascii"),
+            "compression": False,
+            "segmentIndexes": {},
+        }).encode()
+        first, second = (s.backend for s in rep.replica_states)
+        # The corrupt copy lands on the replica anti-entropy would otherwise
+        # prefer (health tie → first in order).
+        first.upload(io.BytesIO(bad), ObjectKey(log_key))
+        second.upload(io.BytesIO(good), ObjectKey(log_key))
+        for backend in (first, second):
+            backend.upload(io.BytesIO(manifest), ObjectKey(manifest_key))
+        report = AntiEntropyRepairer(rep).run_once()
+        assert report.divergent_keys == 1
+        assert first.object(log_key) == good
+        assert second.object(log_key) == good
+
+    def test_pass_survives_unlistable_replica(self):
+        dark = FaultInjectingBackend(
+            mem(), FaultSchedule.parse("list:raise@every=1")
+        )
+        rep = ReplicatedStorageBackend([("lit", mem()), ("dark", dark)])
+        rep.replica_states[0].backend.upload(io.BytesIO(b"x"), KEY)
+        report = AntiEntropyRepairer(rep).run_once()
+        assert report.unreadable_replicas == 1
+        assert report.keys_checked == 1
+
+    def test_scheduler_runs_and_reports(self):
+        rep = replicated(2)
+        rep.upload(io.BytesIO(b"v"), KEY)
+        rep.replica_states[0].backend.delete(KEY)
+        repairer = AntiEntropyRepairer(rep)
+        scheduler = AntiEntropyScheduler(repairer, interval_ms=3_600_000).start()
+        try:
+            scheduler.run_now()
+            deadline = time.monotonic() + 5.0
+            while repairer.passes == 0 and time.monotonic() < deadline:
+                time.sleep(0.01)
+        finally:
+            scheduler.stop()
+        assert repairer.passes >= 1 and repairer.repairs_total == 1
+        status = scheduler.status()
+        assert status["repairs_total"] == 1 and status["last_pass"]["in_sync"] is False
+
+
+# ------------------------------------------------------ reflective config
+class TestReflectiveConfig:
+    def test_configure_builds_children_from_config(self, tmp_storage_root):
+        rep = ReplicatedStorageBackend()
+        rep.configure({
+            "replication.replicas": "a,b",
+            "replication.replica.a.backend.class":
+                "tieredstorage_tpu.storage.memory.InMemoryStorage",
+            "replication.replica.b.backend.class":
+                "tieredstorage_tpu.storage.filesystem.FileSystemStorage",
+            "replication.replica.b.root": str(tmp_storage_root),
+            "replication.replica.b.overwrite.enabled": True,
+            "replication.write.quorum": 2,
+            "replication.probe.interval.ms": None,
+        })
+        rep.upload(io.BytesIO(b"abc"), KEY)
+        assert rep.write_quorum == 2
+        assert [s.name for s in rep.replica_states] == ["a", "b"]
+        with rep.fetch(KEY) as s:
+            assert s.read() == b"abc"
+        assert (tmp_storage_root / KEY.value).read_bytes() == b"abc"
+
+    def test_missing_child_class_rejected(self):
+        rep = ReplicatedStorageBackend()
+        with pytest.raises(ValueError):
+            rep.configure({"replication.replicas": "a"})
+
+    def test_fault_injecting_child_composes(self):
+        rep = ReplicatedStorageBackend()
+        rep.configure({
+            "replication.replicas": "p,s",
+            "replication.replica.p.backend.class":
+                "tieredstorage_tpu.faults.backend.FaultInjectingBackend",
+            "replication.replica.p.fault.delegate.class":
+                "tieredstorage_tpu.storage.memory.InMemoryStorage",
+            "replication.replica.p.fault.schedule": "fetch:raise@every=1",
+            "replication.replica.s.backend.class":
+                "tieredstorage_tpu.storage.memory.InMemoryStorage",
+            "replication.probe.interval.ms": None,
+        })
+        rep.upload(io.BytesIO(b"zz"), KEY)
+        with rep.fetch(KEY) as s:
+            assert s.read() == b"zz"
+        assert rep.failovers == 1
+
+
+# ----------------------------------------------------------- RSM wiring
+class TestRsmReplicationWiring:
+    def _configure(self, **extra):
+        from tieredstorage_tpu.rsm import RemoteStorageManager
+
+        rsm = RemoteStorageManager()
+        rsm.configure({
+            "storage.backend.class":
+                "tieredstorage_tpu.storage.replicated.ReplicatedStorageBackend",
+            "storage.replication.replicas": "a,b",
+            "storage.replication.replica.a.backend.class":
+                "tieredstorage_tpu.storage.memory.InMemoryStorage",
+            "storage.replication.replica.b.backend.class":
+                "tieredstorage_tpu.storage.memory.InMemoryStorage",
+            "storage.replication.probe.interval.ms": None,
+            "chunk.size": 1024,
+            **extra,
+        })
+        return rsm
+
+    def test_replicated_backend_discovered_through_wrappers(self):
+        rsm = self._configure(**{"breaker.enabled": True})
+        try:
+            assert rsm.replicated_storage is not None
+            assert [s.name for s in rsm.replicated_storage.replica_states] == ["a", "b"]
+        finally:
+            rsm.close()
+
+    def test_replication_metrics_registered(self):
+        rsm = self._configure(**{"replication.antientropy.enabled": True,
+                                 "replication.antientropy.interval.ms": 3_600_000})
+        try:
+            names = {m.name for m in rsm.metrics.registry.metric_names}
+            assert {"replica-health-score", "replica-failovers-total",
+                    "quorum-write-failures-total", "antientropy-repairs-total",
+                    "antientropy-passes-total"} <= names
+            assert rsm.antientropy is not None
+            assert rsm.antientropy_scheduler is not None
+        finally:
+            rsm.close()
+
+    def test_upload_fetch_round_trip_through_replicas(self, tmp_path):
+        from tests.test_rsm_lifecycle import (
+            SEGMENT_SIZE,
+            make_segment_bytes,
+            make_segment_data,
+            make_segment_metadata,
+        )
+
+        rsm = self._configure()
+        try:
+            metadata = make_segment_metadata()
+            data = make_segment_data(tmp_path, with_txn=False)
+            rsm.copy_log_segment_data(metadata, data)
+            for state in rsm.replicated_storage.replica_states:
+                assert len(state.backend.keys()) == 3  # log, indexes, manifest
+            with rsm.fetch_log_segment(metadata, 0) as s:
+                fetched = s.read()
+            assert fetched == make_segment_bytes() and len(fetched) == SEGMENT_SIZE
+        finally:
+            rsm.close()
+
+
+# --------------------------------------------------------- @from trigger
+class TestFromTrigger:
+    def test_fires_from_nth_call_onward(self):
+        schedule = FaultSchedule.parse("fetch:raise@from=3")
+        backend = FaultInjectingBackend(mem(), schedule)
+        backend.upload(io.BytesIO(b"x"), KEY)
+        for _ in range(2):
+            with backend.fetch(KEY) as s:
+                assert s.read() == b"x"
+        for _ in range(3):
+            with pytest.raises(FaultInjectedException):
+                backend.fetch(KEY)
+
+    def test_invalid_from_rejected(self):
+        with pytest.raises(ValueError):
+            FaultSchedule.parse("fetch:raise@from=0")

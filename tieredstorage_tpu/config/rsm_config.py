@@ -174,6 +174,8 @@ def _base_def() -> ConfigDef:
         doc="Deterministic fault rules 'op:action[=arg][@trigger]' with op in "
             "[upload, fetch, delete, list, *], action in [raise, key-not-found, "
             "delay, truncate, corrupt], trigger '@N' (Nth call), '@every=K', "
+            "'@from=N' (every call from the Nth onward — a hard failure that "
+            "starts mid-run and never recovers), "
             "or '@p=P' (seeded probability). delay accepts a jittered range "
             "'delay=lo..hi' (uniform seeded draw per firing, in ms) for "
             "realistic tail-latency distributions. E.g. 'upload:raise@3, "
@@ -316,6 +318,26 @@ def _base_def() -> ConfigDef:
         doc="Thread pool size of the gRPC sidecar server (was hardcoded at "
             "8). Size to the expected broker fetch parallelism; admission "
             "control sheds what the pool cannot absorb.",
+    ))
+    d.define(ConfigKey(
+        "replication.antientropy.enabled", "bool", default=False, importance="medium",
+        doc="Run the background anti-entropy repairer when the storage "
+            "backend is a ReplicatedStorageBackend: periodic passes diff "
+            "the replicas by prefix, arbitrate divergent copies (manifest "
+            "chunkChecksums for .log objects, majority/health otherwise), "
+            "and copy missing/divergent objects back toward quorum.",
+    ))
+    d.define(ConfigKey(
+        "replication.antientropy.interval.ms", "long", default=600_000,
+        validator=in_range(1, None), importance="medium",
+        doc="Period between anti-entropy passes.",
+    ))
+    d.define(ConfigKey(
+        "replication.antientropy.rate.bytes", "int", default=8 * 1024 * 1024,
+        validator=null_or(in_range(16 * 1024, INT_MAX)), importance="low",
+        doc="Anti-entropy read/copy budget in bytes/s (token bucket) so "
+            "replica diffing never starves foreground traffic; null "
+            "disables throttling.",
     ))
     d.define(ConfigKey(
         "scrub.enabled", "bool", default=False, importance="medium",
@@ -576,6 +598,18 @@ class RemoteStorageManagerConfig:
     @property
     def sidecar_grpc_max_workers(self) -> int:
         return self._values["sidecar.grpc.max.workers"]
+
+    @property
+    def replication_antientropy_enabled(self) -> bool:
+        return self._values["replication.antientropy.enabled"]
+
+    @property
+    def replication_antientropy_interval_ms(self) -> int:
+        return self._values["replication.antientropy.interval.ms"]
+
+    @property
+    def replication_antientropy_rate_bytes(self) -> Optional[int]:
+        return self._values["replication.antientropy.rate.bytes"]
 
     @property
     def scrub_enabled(self) -> bool:

@@ -97,6 +97,18 @@ def generate() -> str:
             )
         )
     )
+    out += _section("ReplicatedStorageBackendConfig (prefix: storage.)")
+    from tieredstorage_tpu.storage import replicated
+
+    out.extend([
+        "Each name in ``replication.replicas`` additionally defines the",
+        "dynamic key family ``replication.replica.<name>.backend.class``",
+        "plus that backend's own keys under the",
+        "``replication.replica.<name>.`` prefix (passed through with the",
+        "prefix stripped).",
+        "",
+    ])
+    out.append(render_config_def(replicated._definition()))
     out += _section("S3StorageConfig (prefix: storage.)")
     out.append(render_config_def(S3StorageConfig.DEFINITION))
     out += _section("GcsStorageConfig (prefix: storage.)")

@@ -28,6 +28,7 @@ def _collect_rsm() -> dict[str, list[str]]:
     m.record_upload_rollback("topic", 0)
     m.record_hedge_win(1.0)
     m.record_admission_wait(1.0)
+    m.record_replica_failover(1.0)
     return _group_names(m.registry)
 
 
@@ -69,6 +70,28 @@ def _collect_resilience() -> dict[str, list[str]]:
         return _group_names(registry)
     finally:
         hedger.close()
+
+
+def _collect_replication() -> dict[str, list[str]]:
+    from tieredstorage_tpu.metrics.core import MetricsRegistry
+    from tieredstorage_tpu.metrics.rsm_metrics import register_replication_metrics
+    from tieredstorage_tpu.scrub.antientropy import AntiEntropyRepairer
+    from tieredstorage_tpu.storage.memory import InMemoryStorage
+    from tieredstorage_tpu.storage.replicated import ReplicatedStorageBackend
+
+    registry = MetricsRegistry()
+    replicated = ReplicatedStorageBackend(
+        [("a", InMemoryStorage()), ("b", InMemoryStorage())]
+    )
+    try:
+        register_replication_metrics(
+            registry,
+            replicated=replicated,
+            antientropy=AntiEntropyRepairer(replicated),
+        )
+        return _group_names(registry)
+    finally:
+        replicated.close()
 
 
 def _collect_scrub() -> dict[str, list[str]]:
@@ -178,6 +201,7 @@ def generate() -> str:
         ("RemoteStorageManager metrics", _collect_rsm()),
         ("Cache and thread-pool metrics", _collect_caches()),
         ("Resilience metrics", _collect_resilience()),
+        ("Replication metrics", _collect_replication()),
         ("Scrubber metrics", _collect_scrub()),
         ("Tracer metrics", _collect_tracer()),
         ("Storage backend client metrics", _collect_backends()),

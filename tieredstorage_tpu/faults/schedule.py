@@ -19,6 +19,9 @@ A schedule is a list of rules, each written as
 - trigger:
     - ``@N`` — fire on the Nth call of that op (1-based)
     - ``@every=K`` — fire on every Kth call of that op
+    - ``@from=N`` — fire on EVERY call from the Nth onward (1-based): a
+      hard failure that starts mid-run and never recovers, e.g. killing a
+      replica partway through a workload (``fetch:raise@from=20``)
     - ``@p=P`` — fire with probability P, drawn from the schedule's seeded
       RNG (deterministic for a given seed and call sequence)
     - absent — fire on every call
@@ -67,6 +70,8 @@ class FaultRule:
     arg: Optional[int] = None
     nth: Optional[int] = None
     every: Optional[int] = None
+    #: Fire on every call from the Nth onward (permanent failure mid-run).
+    from_nth: Optional[int] = None
     probability: Optional[float] = None
     #: Upper bound of a jittered ``delay=lo..hi`` range (delay only); the
     #: actual sleep is drawn per firing from the schedule's seeded RNG.
@@ -85,6 +90,8 @@ class FaultRule:
             raise ValueError("every must be >= 1")
         if self.nth is not None and self.nth < 1:
             raise ValueError("nth must be >= 1")
+        if self.from_nth is not None and self.from_nth < 1:
+            raise ValueError("from must be >= 1")
         if self.probability is not None and not (0.0 <= self.probability <= 1.0):
             raise ValueError("probability must be in [0, 1]")
         if self.arg_hi is not None:
@@ -103,7 +110,7 @@ class FaultRule:
             raise ValueError(
                 f"Invalid fault rule {text!r}; expected op:action[=arg][@trigger]"
             )
-        nth = every = None
+        nth = every = from_nth = None
         probability = None
         trigger = m.group("trigger")
         if trigger is not None:
@@ -111,11 +118,14 @@ class FaultRule:
                 nth = int(trigger)
             elif trigger.startswith("every="):
                 every = int(trigger[len("every="):])
+            elif trigger.startswith("from="):
+                from_nth = int(trigger[len("from="):])
             elif trigger.startswith("p="):
                 probability = float(trigger[len("p="):])
             else:
                 raise ValueError(
-                    f"Invalid fault trigger {trigger!r}; expected N, every=K, or p=P"
+                    f"Invalid fault trigger {trigger!r}; expected N, every=K, "
+                    "from=N, or p=P"
                 )
         arg = m.group("arg")
         arg_lo = arg_hi = None
@@ -131,6 +141,7 @@ class FaultRule:
             arg=arg_lo,
             nth=nth,
             every=every,
+            from_nth=from_nth,
             probability=probability,
             arg_hi=arg_hi,
         )
@@ -202,6 +213,8 @@ class FaultSchedule:
             return call_no == rule.nth
         if rule.every is not None:
             return call_no % rule.every == 0
+        if rule.from_nth is not None:
+            return call_no >= rule.from_nth
         if rule.probability is not None:
             return self._rng.random() < rule.probability
         return True

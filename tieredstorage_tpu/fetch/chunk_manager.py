@@ -146,6 +146,7 @@ class DefaultChunkManager(ChunkManager):
                 stored = self.hedger.call(
                     lambda: self._fetch_stored(objects_key, chunks, contiguous),
                     what=objects_key.value,
+                    hedge_fn=self._hedge_attempt(objects_key, chunks, contiguous),
                 )
             else:
                 stored = self._fetch_stored(objects_key, chunks, contiguous)
@@ -177,22 +178,45 @@ class DefaultChunkManager(ChunkManager):
             )
         return out
 
-    def _fetch_stored(self, objects_key: ObjectKey, chunks, contiguous: bool) -> list[bytes]:
+    def _hedge_attempt(self, objects_key: ObjectKey, chunks, contiguous: bool):
+        """Replica-aware hedge: when the fetcher is replicated
+        (ReplicatedStorageBackend.read_fetchers), the hedge reads the same
+        window from the second-healthiest replica DIRECTLY, so a straggling
+        primary replica is raced by a distinct one instead of being hit
+        twice. Single-store fetchers return None (the hedge replays `fn`)."""
+        read_fetchers = getattr(self._fetcher, "read_fetchers", None)
+        if read_fetchers is None:
+            return None
+        ordered = read_fetchers()
+        if len(ordered) < 2:
+            return None
+        alternate = ordered[1]
+        return lambda: self._fetch_stored(
+            objects_key, chunks, contiguous, fetcher=alternate
+        )
+
+    def _fetch_stored(
+        self, objects_key: ObjectKey, chunks, contiguous: bool, *, fetcher=None
+    ) -> list[bytes]:
         """Read the stored (transformed) bytes of a chunk window.
 
         Self-contained and replay-safe — opens, fully reads, and closes its
         own stream(s) — which is exactly the contract the hedger needs: a
-        discarded losing attempt cannot tear the winner's bytes."""
+        discarded losing attempt cannot tear the winner's bytes.
+        `fetcher` overrides the configured fetcher for replica-aware hedge
+        attempts."""
+        if fetcher is None:
+            fetcher = self._fetcher
         if contiguous:
             # One ranged GET covering the window on the transformed side.
             whole = BytesRange.of(
                 chunks[0].transformed_position,
                 chunks[-1].transformed_position + chunks[-1].transformed_size - 1,
             )
-            with self._fetcher.fetch(objects_key, whole) as stream:
+            with fetcher.fetch(objects_key, whole) as stream:
                 return [read_exactly(stream, c.transformed_size) for c in chunks]
         stored = []
         for c in chunks:
-            with self._fetcher.fetch(objects_key, c.range()) as stream:
+            with fetcher.fetch(objects_key, c.range()) as stream:
                 stored.append(read_exactly(stream, c.transformed_size))
         return stored

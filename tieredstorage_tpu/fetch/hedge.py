@@ -113,22 +113,34 @@ class Hedger:
     def close(self) -> None:
         self._pool.shutdown(wait=True, cancel_futures=True)
 
-    def call(self, fn: Callable[[], T], *, what: str = "") -> T:
-        """Run `fn`, hedging with a second identical run after the delay.
+    def call(
+        self,
+        fn: Callable[[], T],
+        *,
+        what: str = "",
+        hedge_fn: Optional[Callable[[], T]] = None,
+    ) -> T:
+        """Run `fn`, hedging with a second run after the delay.
 
         `fn` must be self-contained and replay-safe (a ranged GET that reads
         and closes its own stream) — both attempts may run to completion, and
         exactly one result is returned. The ambient Deadline and the caller's
         trace identity do NOT cross into the pool threads automatically; the
-        deadline is re-installed explicitly (it must bound both attempts)."""
+        deadline is re-installed explicitly (it must bound both attempts).
+
+        `hedge_fn`, when given, is what the hedge runs instead of a second
+        `fn` — replica-aware hedging hands the equivalent read against a
+        *distinct* replica here (ReplicatedStorageBackend.read_fetchers), so
+        a straggling replica is raced by a different one rather than being
+        hit twice. It must return byte-identical results to `fn`."""
         with self._lock:
             self.primaries += 1
         self._budget.deposit()
         deadline = current_deadline()
 
-        def run() -> T:
+        def run(attempt_fn: Callable[[], T] = fn) -> T:
             with deadline_scope(deadline):
-                return fn()
+                return attempt_fn()
 
         start = time.monotonic()
         primary = self._pool.submit(run)
@@ -144,8 +156,9 @@ class Hedger:
             return primary.result()
         with self._lock:
             self.launched += 1
-        self.tracer.event("fetch.hedged", what=what)
-        hedge = self._pool.submit(run)
+        distinct = hedge_fn is not None
+        self.tracer.event("fetch.hedged", what=what, distinct_replica=distinct)
+        hedge = self._pool.submit(run, hedge_fn) if distinct else self._pool.submit(run)
         pending = {primary, hedge}
         last_error: Optional[BaseException] = None
         while pending:

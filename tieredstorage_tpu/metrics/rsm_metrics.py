@@ -50,6 +50,7 @@ from tieredstorage_tpu.metrics.core import (
 METRIC_GROUP = "remote-storage-manager-metrics"
 RESILIENCE_METRIC_GROUP = "resilience-metrics"
 TRACER_METRIC_GROUP = "tracer-metrics"
+REPLICATION_METRIC_GROUP = "replication-metrics"
 
 
 class Metrics:
@@ -167,6 +168,13 @@ class Metrics:
         self._time("admission-wait-time", {}, ms)
         self._histogram("admission-wait-time", ms)
 
+    def record_replica_failover(self, ms: float) -> None:
+        """A read served by a non-first replica after the healthier one(s)
+        failed; `ms` is the full call latency including the failed
+        attempt(s) — the user-visible cost of the failover."""
+        self._time("replica-failover-time", {}, ms)
+        self._histogram("replica-failover-time", ms)
+
     def latency_quantile(self, base: str, q: float) -> Optional[float]:
         """Bucket-interpolated quantile (ms) of a `<base>-ms` histogram, or
         None before any observation — the hedge delay's data source
@@ -271,6 +279,55 @@ def register_resilience_metrics(
               lambda: float(deadline_exceeded_supplier()),
               "Requests failed fast because their end-to-end deadline "
               "expired (process-wide)")
+
+
+def register_replication_metrics(
+    registry: MetricsRegistry,
+    *,
+    replicated=None,
+    antientropy=None,
+) -> None:
+    """Replication health as gauges (group `replication-metrics`):
+    per-replica health scores (tagged ``replica=<name>``), failover and
+    quorum-failure counters from the ReplicatedStorageBackend, and
+    anti-entropy pass/repair counters from the AntiEntropyRepairer."""
+
+    def gauge(name: str, supplier, description: str = "", tags=None) -> None:
+        registry.add_gauge(
+            MetricName.of(
+                name, REPLICATION_METRIC_GROUP, description, tags=tags or {}
+            ),
+            supplier,
+        )
+
+    if replicated is not None:
+        for rep in replicated.replica_states:
+            tags = {"replica": rep.name}
+            gauge(
+                "replica-health-score",
+                (lambda r=rep: float(r.health_score())),
+                "EWMA health in (0, 1]: 1 = fast and error-free; an OPEN "
+                "circuit breaker floors it to 0",
+                tags=tags,
+            )
+            gauge("replica-errors-total", (lambda r=rep: float(r.errors)),
+                  "Failed calls observed against this replica", tags=tags)
+            gauge("replica-probe-failures-total",
+                  (lambda r=rep: float(r.probe_failures)),
+                  "Background health probes this replica failed", tags=tags)
+        gauge("replica-failovers-total", lambda: float(replicated.failovers),
+              "Reads served by a non-first replica after failover")
+        gauge("quorum-write-failures-total",
+              lambda: float(replicated.quorum_failures),
+              "Writes that missed the write quorum and were rolled back")
+    if antientropy is not None:
+        gauge("antientropy-passes-total", lambda: float(antientropy.passes))
+        gauge("antientropy-repairs-total",
+              lambda: float(antientropy.repairs_total),
+              "Missing/divergent object copies healed by anti-entropy")
+        gauge("antientropy-diffs-total", lambda: float(antientropy.diffs_total),
+              "Replica differences (missing copies + divergent keys) "
+              "observed across all passes")
 
 
 def register_tracer_metrics(registry: MetricsRegistry, tracer) -> None:

@@ -421,6 +421,48 @@ def _pallas_preflight_ok() -> bool:
     )
 
 
+_FORCED_CROSSCHECK: list[bool] = []  # memoized forced-path verdict
+
+
+def _forced_crosscheck_ok() -> bool:
+    """Output cross-check for the TIEREDSTORAGE_TPU_PALLAS=1 forced path.
+
+    The forced path bypasses the preflight gate, so the import-time range
+    check on TSTPU_AES_R used to be the ONLY guard — and a range-valid but
+    behaviorally mistiled kernel (or a future tiling regression) would
+    corrupt keystream silently. This runs the kernel BODY once per process
+    with plain-array stand-ins (aes_pallas.kernel_body_reference — the
+    R-dependent ShiftRows un-stack slicing included, no Mosaic needed, so
+    it is cheap even on CPU where the forced path runs interpreted) against
+    the XLA circuit on a position-distinct input, and fails LOUD on
+    divergence: the caller explicitly forced the kernel, so silently
+    falling back would mask exactly the corruption being guarded against."""
+    if _FORCED_CROSSCHECK:
+        if not _FORCED_CROSSCHECK[0]:
+            raise RuntimeError(
+                "Pallas AES kernel output diverges from the XLA circuit for "
+                f"TSTPU_AES_R; refusing the forced TIEREDSTORAGE_TPU_PALLAS=1 "
+                "path (keystream would be corrupted)"
+            )
+        return True
+    from tieredstorage_tpu.ops import aes_pallas
+
+    with jax.ensure_compile_time_eval():
+        rk = rk_planes_from_round_keys(jnp.asarray(key_expansion(bytes(range(32)))))
+        w = aes_pallas.WORDS_PER_STEP
+        # Position-distinct, word-distinct input: a wrong un-stack slice
+        # cannot alias to the right answer the way an all-zero state could.
+        state = (
+            jnp.arange(16 * 8 * w, dtype=jnp.uint32).reshape(16, 8, w)
+            * jnp.uint32(2654435761)
+        )
+        got = jax.block_until_ready(aes_pallas.kernel_body_reference(rk, state))
+        ref = jax.block_until_ready(aes_encrypt_planes(rk, state))
+        ok = bool(jnp.all(got == ref))
+    _FORCED_CROSSCHECK.append(ok)
+    return _forced_crosscheck_ok()
+
+
 def _use_pallas_circuit(n_words: int) -> bool:
     """Route the cipher through the fused Pallas kernel on real TPUs.
 
@@ -436,7 +478,12 @@ def _use_pallas_circuit(n_words: int) -> bool:
 
     forced = os.environ.get("TIEREDSTORAGE_TPU_PALLAS")
     if forced is not None:
-        return forced not in ("0", "false", "off")
+        if forced in ("0", "false", "off"):
+            return False
+        # The forced path skips the preflight, so it must run the output
+        # cross-check itself — a mistiled TSTPU_AES_R fails loud here
+        # instead of corrupting keystream silently.
+        return _forced_crosscheck_ok()
     if n_words < 1024:  # one kernel step; smaller batches aren't worth a pad
         return False
     try:

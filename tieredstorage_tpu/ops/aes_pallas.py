@@ -42,9 +42,11 @@ from tieredstorage_tpu.ops.aes_bitsliced import _sbox_planes, _tower
 def _validated_r(raw: str) -> int:
     """The ShiftRows un-stack slices the (16R, 128) sublane stack at R-row
     boundaries; an R that isn't a power-of-two multiple of 8 mis-tiles those
-    slices and — on the TIEREDSTORAGE_TPU_PALLAS=1 forced path, which skips
-    the preflight cross-check — would corrupt keystream silently. Fail loud
-    at import instead."""
+    slices. Fail loud at import; the TIEREDSTORAGE_TPU_PALLAS=1 forced path
+    (which skips the preflight) additionally runs a behavioral output
+    cross-check of the kernel body at first use
+    (aes_bitsliced._forced_crosscheck_ok), so even a range-valid but
+    mistiled kernel cannot corrupt keystream silently."""
     try:
         r = int(raw)
     except ValueError as e:
@@ -123,6 +125,49 @@ def _aes_kernel(rk_ref, in_ref, out_ref):
     for p in range(16):
         for b in range(8):
             out_ref[p, b] = st[p][b]
+
+
+class _ArrayRef:
+    """Read-only stand-in for a Pallas ref backed by a plain array."""
+
+    def __init__(self, arr):
+        self._arr = arr
+
+    def __getitem__(self, idx):
+        return self._arr[idx]
+
+
+class _CollectRef:
+    """Write-only stand-in collecting kernel outputs."""
+
+    def __init__(self):
+        self.out = {}
+
+    def __setitem__(self, idx, val):
+        self.out[idx] = val
+
+
+def kernel_body_reference(rk_planes: jnp.ndarray, state: jnp.ndarray) -> jnp.ndarray:
+    """Evaluate `_aes_kernel` for ONE grid step with plain-array stand-ins
+    for the refs — identical math (including the R-dependent ShiftRows
+    un-stack slicing), no Mosaic or interpreter in the loop, ~1 s eager on
+    CPU. This is what the forced-path `TSTPU_AES_R` output cross-check and
+    the kernel-body tests both run: any mis-tiling of the (16R, 128)
+    sublane stack shows up here exactly as it would on device.
+
+    rk_planes: uint32[15, 16, 8] masks; state: uint32[16, 8, WORDS_PER_STEP].
+    """
+    out_ref = _CollectRef()
+    _aes_kernel(
+        _ArrayRef(rk_planes.reshape(_NR + 1, 128)),
+        _ArrayRef(state.reshape(16, 8, R, 128)),
+        out_ref,
+    )
+    rows = [
+        jnp.stack([out_ref.out[(p, b)] for b in range(8)], axis=0)
+        for p in range(16)
+    ]
+    return jnp.stack(rows, axis=0).reshape(16, 8, state.shape[2])
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))

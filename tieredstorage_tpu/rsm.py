@@ -65,6 +65,7 @@ from tieredstorage_tpu.storage.core import (
     StorageBackendException,
 )
 from tieredstorage_tpu.fetch.hedge import HedgeBudget, Hedger
+from tieredstorage_tpu.storage.replicated import ReplicatedStorageBackend
 from tieredstorage_tpu.storage.resilient import (
     CircuitBreaker,
     ResilientStorageBackend,
@@ -130,6 +131,9 @@ class RemoteStorageManager:
         self._fault_schedule = None
         self._scrubber = None
         self._scrub_scheduler = None
+        self._replicated: Optional[ReplicatedStorageBackend] = None
+        self._antientropy = None
+        self._antientropy_scheduler = None
         self.tracer = NOOP_TRACER
         #: Entry-gate admission controller (`admission.enabled`); the sidecar
         #: boundaries (HTTP gateway + gRPC server) shed through this.
@@ -183,7 +187,76 @@ class RemoteStorageManager:
         self._register_cache_metrics()
         self._register_resilience_metrics()
         register_tracer_metrics(self._metrics.registry, self.tracer)
+        self._wire_replication(config)
         self._wire_scrubber(config)
+
+    def _wire_replication(self, config: RemoteStorageManagerConfig) -> None:
+        """When the configured storage backend is (or wraps) a
+        ReplicatedStorageBackend: hand it the tracer and the failover-time
+        histogram hook, export replication-metrics gauges, and start the
+        anti-entropy repair daemon (`replication.antientropy.*`)."""
+        self._replicated = self._find_replicated(self._storage)
+        if self._replicated is None:
+            return
+        from tieredstorage_tpu.metrics.rsm_metrics import register_replication_metrics
+
+        self._replicated.tracer = self.tracer
+        self._replicated.on_failover = self._metrics.record_replica_failover
+        if config.replication_antientropy_enabled:
+            from tieredstorage_tpu.scrub.antientropy import (
+                AntiEntropyRepairer,
+                AntiEntropyScheduler,
+            )
+
+            bucket = (
+                TokenBucket(config.replication_antientropy_rate_bytes)
+                if config.replication_antientropy_rate_bytes is not None
+                else None
+            )
+            self._antientropy = AntiEntropyRepairer(
+                self._replicated,
+                prefix=config.key_prefix,
+                rate_bucket=bucket,
+                tracer=self.tracer,
+            )
+            self._antientropy_scheduler = AntiEntropyScheduler(
+                self._antientropy,
+                interval_ms=config.replication_antientropy_interval_ms,
+            ).start()
+            log.info(
+                "Anti-entropy repair enabled: interval=%dms rate=%s",
+                config.replication_antientropy_interval_ms,
+                config.replication_antientropy_rate_bytes,
+            )
+        register_replication_metrics(
+            self._metrics.registry,
+            replicated=self._replicated,
+            antientropy=self._antientropy,
+        )
+
+    @staticmethod
+    def _find_replicated(storage) -> Optional[ReplicatedStorageBackend]:
+        """Unwrap the resilience/fault decorators (each exposes `delegate`)
+        down to a ReplicatedStorageBackend, if one is in the stack."""
+        seen = 0
+        while storage is not None and seen < 8:
+            if isinstance(storage, ReplicatedStorageBackend):
+                return storage
+            storage = getattr(storage, "delegate", None)
+            seen += 1
+        return None
+
+    @property
+    def replicated_storage(self) -> Optional[ReplicatedStorageBackend]:
+        return self._replicated
+
+    @property
+    def antientropy(self):
+        return self._antientropy
+
+    @property
+    def antientropy_scheduler(self):
+        return self._antientropy_scheduler
 
     def _wire_scrubber(self, config: RemoteStorageManagerConfig) -> None:
         """Background integrity scrubbing (scrub/): enumerate + verify +
@@ -820,8 +893,12 @@ class RemoteStorageManager:
             ) from failures[0][1]
 
     def close(self) -> None:
+        if self._antientropy_scheduler is not None:
+            self._antientropy_scheduler.stop()
         if self._scrub_scheduler is not None:
             self._scrub_scheduler.stop()
+        if self._replicated is not None:
+            self._replicated.close()
         if self._hedger is not None:
             self._hedger.close()
         if self._config is not None and self._config.tracing_export_path:

@@ -14,8 +14,14 @@ from tieredstorage_tpu.storage.core import (
     StorageBackend,
     StorageBackendException,
 )
+from tieredstorage_tpu.storage.replicated import (
+    AllReplicasFailedException,
+    QuorumWriteException,
+    ReplicatedStorageBackend,
+)
 
 __all__ = [
+    "AllReplicasFailedException",
     "BytesRange",
     "InvalidRangeException",
     "KeyNotFoundException",
@@ -23,6 +29,8 @@ __all__ = [
     "ObjectFetcher",
     "ObjectKey",
     "ObjectUploader",
+    "QuorumWriteException",
+    "ReplicatedStorageBackend",
     "StorageBackend",
     "StorageBackendException",
 ]
