@@ -1,7 +1,7 @@
 # Developer entry points (counterpart of /root/reference/Makefile).
 PYTHON ?= python
 
-.PHONY: test test-e2e chaos bench demo trace-demo scrub-demo tail-demo failover-demo docs docker lint mutation clean
+.PHONY: test test-e2e chaos bench demo trace-demo scrub-demo tail-demo failover-demo fleet-demo docs docker lint mutation clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q --ignore=tests/e2e
@@ -55,6 +55,18 @@ tail-demo:
 # and re-validates artifacts/failover_report.json.
 failover-demo:
 	$(PYTHON) tools/failover_demo.py --out artifacts/failover_report.json
+
+# Fleet-mode gate: 3 in-process sharded gateways (consistent-hash routing +
+# peer chunk-cache tier + cross-instance single-flight) over one shared
+# store. 24 concurrent cold fetches of a Zipfian hot chunk must cost EXACTLY
+# ONE backend read; >= 80% of the zipf workload must be served by the
+# owner/peer cache tier; one instance is hard-killed mid-run (storage dead
+# via fetch:raise@from=N, gateway stopped, survivors re-ring) with ZERO byte
+# diffs across all responses; and a greedy tenant saturating the admission
+# gate is shed 429 while a polite tenant is served. Writes and re-validates
+# artifacts/fleet_report.json.
+fleet-demo:
+	$(PYTHON) tools/fleet_demo.py --out artifacts/fleet_report.json
 
 docs:
 	$(PYTHON) -m tieredstorage_tpu.docs.configs_docs > docs/configs.rst

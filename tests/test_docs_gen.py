@@ -38,6 +38,13 @@ def test_configs_rst_covers_all_config_classes():
         "``retry.budget.percent``",
         "``admission.max.concurrent``",
         "``sidecar.grpc.max.workers``",
+        "``sidecar.http.max.workers``",
+        "``fleet.enabled``",
+        "``fleet.instance.id``",
+        "``fleet.instances``",
+        "``fleet.vnodes``",
+        "``fleet.forward.timeout.ms``",
+        "``fleet.peer.down.cooldown.ms``",
     ):
         assert key in rst
     # Required keys render as required, defaulted ones with their default.
@@ -58,6 +65,7 @@ def test_metrics_rst_covers_all_groups():
         "cache-metrics",
         "thread-pool-metrics",
         "resilience-metrics",
+        "fleet-metrics",
         "s3-client-metrics",
         "gcs-client-metrics",
         "azure-blob-client-metrics",
@@ -77,6 +85,10 @@ def test_metrics_rst_covers_all_groups():
         "deadline-exceeded-total",
         "hedge-win-time-ms",
         "admission-wait-time-ms",
+        "fleet-local-ownership",
+        "fleet-peer-hits-total",
+        "fleet-coalesced-fetches-total",
+        "fleet-forward-time-ms",
         "get-object-requests-total",
         "object-download-requests-total",
         "blob-upload-requests-total",

@@ -1,0 +1,807 @@
+"""Fleet mode suite (ISSUE 6): ring, single-flight, peer tier, gateway route.
+
+Layers under test, bottom-up:
+- HashRing / FleetRouter: deterministic ownership, ~1/N balance with vnodes,
+  BOUNDED key movement under membership change (the consistent-hashing
+  contract: only keys on the joining/leaving instance's arcs move);
+- SingleFlight: N concurrent identical calls -> one execution, error shared
+  with all joiners, no leaked slots, deadline-bounded follower waits;
+- PeerChunkCache: forward-to-owner hit, 404/transport fallback to the local
+  backend path, down-marking with cooldown, pinned keys never re-forward,
+  frame codec hardening;
+- the gateway GET /chunk route + RSM wiring: two real instances over one
+  shared store — non-owner reads resolve via the owner's cache, the route
+  maps errors (400/404/504), and killing the owner falls back byte-identically;
+- the bounded gateway worker pool (sidecar.http.max.workers);
+- AdmissionController per-tenant fair share at saturation.
+"""
+
+from __future__ import annotations
+
+import http.client
+import threading
+import time
+
+import pytest
+
+from tests.test_rsm_lifecycle import make_segment_data, make_segment_metadata
+from tieredstorage_tpu.config.configdef import ConfigException
+from tieredstorage_tpu.config.rsm_config import RemoteStorageManagerConfig
+from tieredstorage_tpu.fleet import (
+    FleetRouter,
+    HashRing,
+    PeerChunkCache,
+    SingleFlight,
+    decode_chunk_frames,
+    encode_chunk_frames,
+    parse_instances,
+)
+from tieredstorage_tpu.object_key import ObjectKeyFactory, Suffix
+from tieredstorage_tpu.rsm import RemoteStorageManager
+from tieredstorage_tpu.sidecar.http_gateway import SidecarHttpGateway
+from tieredstorage_tpu.storage.core import ObjectKey
+from tieredstorage_tpu.utils.admission import (
+    AdmissionController,
+    AdmissionRejectedException,
+)
+from tieredstorage_tpu.utils.deadline import (
+    Deadline,
+    DeadlineExceededException,
+    deadline_scope,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+# ----------------------------------------------------------------- hash ring
+class TestHashRing:
+    def test_deterministic_across_instances(self):
+        a = HashRing(["g0", "g1", "g2"], vnodes=64)
+        b = HashRing(["g2", "g0", "g1"], vnodes=64)  # order-independent
+        for i in range(200):
+            key = f"fleet/topic-{i}/0/{i:020d}.log"
+            assert a.owner(key) == b.owner(key)
+
+    def test_ownership_roughly_balanced(self):
+        ring = HashRing(["g0", "g1", "g2"], vnodes=128)
+        fractions = [ring.ownership_fraction(n) for n in ("g0", "g1", "g2")]
+        assert abs(sum(fractions) - 1.0) < 1e-9
+        for f in fractions:
+            assert 0.15 < f < 0.55  # ~1/3 each with 128 vnodes
+
+    def test_membership_add_moves_keys_only_to_the_joiner(self):
+        before = HashRing(["g0", "g1", "g2"], vnodes=64)
+        after = HashRing(["g0", "g1", "g2", "g3"], vnodes=64)
+        keys = [f"seg/{i:020d}.log" for i in range(500)]
+        moved = 0
+        for key in keys:
+            old, new = before.owner(key), after.owner(key)
+            if old != new:
+                moved += 1
+                # The consistent-hashing contract: a key only changes owner
+                # TO the joining instance.
+                assert new == "g3", f"{key} moved {old}->{new}, not to g3"
+        assert 0 < moved < len(keys) / 2  # ~1/4 expected, never a reshuffle
+
+    def test_membership_remove_moves_only_the_leavers_keys(self):
+        before = HashRing(["g0", "g1", "g2"], vnodes=64)
+        after = HashRing(["g0", "g1"], vnodes=64)
+        for i in range(500):
+            key = f"seg/{i:020d}.log"
+            old, new = before.owner(key), after.owner(key)
+            if old != "g2":
+                assert new == old  # survivors' keys never move
+
+    def test_owners_walk_is_distinct_preference_order(self):
+        ring = HashRing(["g0", "g1", "g2"], vnodes=16)
+        order = ring.owners("some/key.log", 3)
+        assert sorted(order) == ["g0", "g1", "g2"]
+        assert order[0] == ring.owner("some/key.log")
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            HashRing([], vnodes=4)
+        with pytest.raises(ValueError):
+            HashRing(["a"], vnodes=0)
+
+
+class TestParseInstances:
+    def test_names_and_urls(self):
+        parsed = parse_instances(["g0=http://h0:1", "g1=http://h1:2", "me"])
+        assert parsed == {
+            "g0": "http://h0:1", "g1": "http://h1:2", "me": None,
+        }
+
+    @pytest.mark.parametrize("bad", [["=http://x"], ["a", "a"]])
+    def test_rejects_malformed(self, bad):
+        with pytest.raises(ValueError):
+            parse_instances(bad)
+
+
+class TestFleetRouter:
+    def test_solo_ring_routes_local(self):
+        router = FleetRouter("me", vnodes=8)
+        owner, url = router.route("any/key.log")
+        assert owner == "me" and url is None
+        assert router.is_local("any/key.log")
+
+    def test_membership_and_routing(self):
+        router = FleetRouter("g0", vnodes=64)
+        router.set_membership({"g0": None, "g1": "http://h1:1", "g2": "http://h2:2"})
+        assert router.generation == 2
+        seen = set()
+        for i in range(100):
+            owner, url = router.route(f"k/{i:020d}.log")
+            seen.add(owner)
+            if owner == "g0":
+                assert url is None
+            else:
+                assert url == router.peer_url(owner)
+        assert seen == {"g0", "g1", "g2"}
+
+    def test_remove_instance_is_bounded_and_keeps_self(self):
+        router = FleetRouter("g0", vnodes=64)
+        router.set_membership({"g0": None, "g1": "u1", "g2": "u2"})
+        before = {f"k{i}": router.owner(f"k{i}") for i in range(200)}
+        router.remove_instance("g2")
+        for key, old in before.items():
+            if old != "g2":
+                assert router.owner(key) == old
+        router.remove_instance("g0")  # removing self is refused
+        assert "g0" in router.instances
+
+
+# -------------------------------------------------------------- single-flight
+class TestSingleFlight:
+    def test_concurrent_callers_one_execution(self):
+        flight = SingleFlight()
+        calls = []
+        barrier = threading.Barrier(8)
+        release = threading.Event()
+
+        def work():
+            calls.append(1)
+            release.wait(timeout=5)
+            return "answer"
+
+        results = []
+
+        def caller():
+            barrier.wait()
+            results.append(flight.do("k", work))
+
+        threads = [threading.Thread(target=caller) for _ in range(8)]
+        for t in threads:
+            t.start()
+        time.sleep(0.1)  # everyone past the barrier, leader inside work()
+        release.set()
+        for t in threads:
+            t.join(timeout=5)
+        assert results == ["answer"] * 8
+        assert len(calls) == 1
+        assert flight.leaders == 1 and flight.coalesced == 7
+        assert flight.pending == 0
+
+    def test_leader_error_propagates_to_followers_and_slot_clears(self):
+        flight = SingleFlight()
+        entered = threading.Event()
+        release = threading.Event()
+
+        def boom():
+            entered.set()
+            release.wait(timeout=5)
+            raise OSError("backend down")
+
+        errors = []
+
+        def leader():
+            try:
+                flight.do("k", boom)
+            except OSError as e:
+                errors.append(("leader", str(e)))
+
+        def follower():
+            entered.wait(timeout=5)
+            try:
+                flight.do("k", boom)
+            except OSError as e:
+                errors.append(("follower", str(e)))
+
+        t1 = threading.Thread(target=leader)
+        t2 = threading.Thread(target=follower)
+        t1.start()
+        t2.start()
+        time.sleep(0.1)
+        release.set()
+        t1.join(timeout=5)
+        t2.join(timeout=5)
+        assert sorted(e[0] for e in errors) == ["follower", "leader"]
+        assert flight.failures == 1 and flight.pending == 0
+        # Next call starts a FRESH flight (failures are retryable).
+        assert flight.do("k", lambda: "recovered") == "recovered"
+
+    def test_distinct_keys_do_not_coalesce(self):
+        flight = SingleFlight()
+        assert flight.do("a", lambda: 1) == 1
+        assert flight.do("b", lambda: 2) == 2
+        assert flight.leaders == 2 and flight.coalesced == 0
+
+    def test_follower_wait_is_deadline_bounded(self):
+        flight = SingleFlight()
+        entered = threading.Event()
+        release = threading.Event()
+
+        def slow():
+            entered.set()
+            release.wait(timeout=5)
+            return 1
+
+        t = threading.Thread(target=lambda: flight.do("k", slow))
+        t.start()
+        entered.wait(timeout=5)
+        try:
+            with deadline_scope(Deadline.after(0.05)):
+                with pytest.raises(DeadlineExceededException):
+                    flight.do("k", slow)
+        finally:
+            release.set()
+            t.join(timeout=5)
+        assert flight.pending == 0
+
+
+# -------------------------------------------------------------- frame codec
+class TestChunkFrames:
+    def test_roundtrip(self):
+        chunks = [b"", b"a", b"x" * 1000]
+        assert decode_chunk_frames(encode_chunk_frames(chunks), expected=3) == chunks
+
+    @pytest.mark.parametrize("mangle", [
+        lambda b: b[:-1],                      # truncated body
+        lambda b: b[:3],                       # truncated count
+        lambda b: b + b"\x00",                 # trailing bytes
+    ])
+    def test_torn_frames_rejected(self, mangle):
+        blob = encode_chunk_frames([b"abc", b"defg"])
+        with pytest.raises(ValueError):
+            decode_chunk_frames(mangle(blob), expected=2)
+
+    def test_count_mismatch_rejected(self):
+        blob = encode_chunk_frames([b"abc"])
+        with pytest.raises(ValueError):
+            decode_chunk_frames(blob, expected=2)
+
+
+# --------------------------------------------------------- peer cache (unit)
+class _RecordingManager:
+    """Fake delegate ChunkManager: returns per-chunk fill bytes."""
+
+    def __init__(self):
+        self.calls = []
+
+    def get_chunks(self, key, manifest, chunk_ids):
+        self.calls.append((key.value, tuple(chunk_ids)))
+        return [bytes([cid % 251]) * 16 for cid in chunk_ids]
+
+    def get_chunk(self, key, manifest, chunk_id):
+        raise NotImplementedError
+
+
+class _PeerStub:
+    """Minimal HTTP peer serving scripted /chunk responses."""
+
+    def __init__(self, status=200, chunks=None, capture=None):
+        import http.server
+
+        stub = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if capture is not None:
+                    capture.append((self.path, dict(self.headers)))
+                body = (
+                    encode_chunk_frames(stub.chunks)
+                    if stub.status == 200 else b"nope"
+                )
+                self.send_response(stub.status)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.status = status
+        self.chunks = chunks or []
+        self._server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._server.server_address[1]
+        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def _peer_router(owner_url: str) -> FleetRouter:
+    """Router whose every key maps to peer 'owner' at `owner_url`."""
+    router = FleetRouter("me", vnodes=4)
+    router.set_membership({"owner": owner_url})
+
+    class _AllOwner:
+        instances = ("me", "owner")
+
+        def owner(self, key):
+            return "owner"
+
+    router._ring = _AllOwner()  # deterministic: every key is peer-owned
+    return router
+
+
+class TestPeerChunkCache:
+    def test_forward_hit_serves_peer_bytes(self):
+        chunks = [b"A" * 16, b"B" * 16]
+        capture: list = []
+        stub = _PeerStub(chunks=chunks, capture=capture)
+        delegate = _RecordingManager()
+        cache = PeerChunkCache(
+            delegate, _peer_router(f"http://127.0.0.1:{stub.port}")
+        )
+        try:
+            got = cache.get_chunks(ObjectKey("seg/a.log"), None, [0, 1])
+            assert got == chunks
+            assert delegate.calls == []  # never touched the backend
+            assert (cache.forwards, cache.peer_hits) == (1, 1)
+            path, headers = capture[0]
+            assert path.startswith("/chunk?key=seg%2Fa.log&chunks=0-1")
+        finally:
+            stub.stop()
+            cache.close()
+
+    def test_forward_propagates_deadline_header(self):
+        capture: list = []
+        stub = _PeerStub(chunks=[b"x"], capture=capture)
+        cache = PeerChunkCache(
+            _RecordingManager(), _peer_router(f"http://127.0.0.1:{stub.port}")
+        )
+        try:
+            with deadline_scope(Deadline.after(5.0)):
+                cache.get_chunks(ObjectKey("seg/a.log"), None, [0])
+            _, headers = capture[0]
+            assert 0 < int(headers["x-deadline-ms"]) <= 5000
+        finally:
+            stub.stop()
+            cache.close()
+
+    def test_peer_404_falls_back_to_local(self):
+        stub = _PeerStub(status=404)
+        delegate = _RecordingManager()
+        cache = PeerChunkCache(
+            delegate, _peer_router(f"http://127.0.0.1:{stub.port}")
+        )
+        try:
+            got = cache.get_chunks(ObjectKey("seg/a.log"), None, [3])
+            assert got == [bytes([3]) * 16]
+            assert delegate.calls == [("seg/a.log", (3,))]
+            assert cache.peer_misses == 1
+            assert cache.peers_down == 0  # a miss is not unhealth
+        finally:
+            stub.stop()
+            cache.close()
+
+    def test_dead_peer_marked_down_with_cooldown(self):
+        stub = _PeerStub()
+        url = f"http://127.0.0.1:{stub.port}"
+        stub.stop()  # connection refused from here on
+        delegate = _RecordingManager()
+        clock = [0.0]
+        cache = PeerChunkCache(
+            delegate, _peer_router(url),
+            down_cooldown_s=5.0, forward_timeout_s=0.5,
+            time_source=lambda: clock[0],
+        )
+        try:
+            got = cache.get_chunks(ObjectKey("seg/a.log"), None, [1])
+            assert got == [bytes([1]) * 16]  # served by local fallback
+            assert cache.forward_failures == 1 and cache.peers_down == 1
+            # Within the cooldown: straight to local, no forward attempt.
+            cache.get_chunks(ObjectKey("seg/a.log"), None, [2])
+            assert cache.forwards == 1
+            # Past the cooldown: the next read probes the peer again.
+            clock[0] = 6.0
+            cache.get_chunks(ObjectKey("seg/a.log"), None, [4])
+            assert cache.forwards == 2
+        finally:
+            cache.close()
+
+    def test_pinned_key_never_forwards(self):
+        stub = _PeerStub(chunks=[b"peer"])
+        delegate = _RecordingManager()
+        cache = PeerChunkCache(
+            delegate, _peer_router(f"http://127.0.0.1:{stub.port}")
+        )
+        try:
+            with cache.serving_locally("seg/a.log"):
+                cache.get_chunks(ObjectKey("seg/a.log"), None, [0])
+            assert cache.forwards == 0
+            assert delegate.calls == [("seg/a.log", (0,))]
+            # Unpinned again afterwards.
+            cache.get_chunks(ObjectKey("seg/a.log"), None, [1])
+            assert cache.forwards == 1
+        finally:
+            stub.stop()
+            cache.close()
+
+    def test_torn_peer_frame_falls_back_and_marks_down(self):
+        stub = _PeerStub(chunks=[b"only-one"])  # peer answers 1 chunk for a 2-window
+        delegate = _RecordingManager()
+        cache = PeerChunkCache(
+            delegate, _peer_router(f"http://127.0.0.1:{stub.port}")
+        )
+        try:
+            got = cache.get_chunks(ObjectKey("seg/a.log"), None, [0, 1])
+            assert got == [bytes([0]) * 16, bytes([1]) * 16]
+            assert cache.forward_failures == 1 and cache.peers_down == 1
+        finally:
+            stub.stop()
+            cache.close()
+
+    def test_concurrent_identical_windows_coalesce_to_one_forward(self):
+        requests: list = []
+        gate = threading.Event()
+
+        import http.server
+
+        class SlowHandler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                requests.append(self.path)
+                gate.wait(timeout=5)
+                body = encode_chunk_frames([b"hot" * 4])
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), SlowHandler)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        delegate = _RecordingManager()
+        cache = PeerChunkCache(
+            delegate, _peer_router(f"http://127.0.0.1:{server.server_address[1]}")
+        )
+        try:
+            results = []
+            barrier = threading.Barrier(6)
+
+            def read():
+                barrier.wait()
+                results.append(
+                    cache.get_chunks(ObjectKey("seg/hot.log"), None, [0])
+                )
+
+            threads = [threading.Thread(target=read) for _ in range(6)]
+            for t in threads:
+                t.start()
+            time.sleep(0.2)  # all blocked behind the leader's forward
+            gate.set()
+            for t in threads:
+                t.join(timeout=5)
+            assert results == [[b"hot" * 4]] * 6
+            assert len(requests) == 1  # one forward for six concurrent reads
+            assert cache.singleflight.coalesced == 5
+        finally:
+            server.shutdown()
+            server.server_close()
+            cache.close()
+
+
+# ------------------------------------------------------ config + RSM wiring
+class TestFleetConfig:
+    BASE = {
+        "storage.backend.class": "tieredstorage_tpu.storage.memory.InMemoryStorage",
+        "chunk.size": 1024,
+    }
+
+    def test_fleet_requires_instance_id(self):
+        with pytest.raises(ConfigException, match="fleet.instance.id"):
+            RemoteStorageManagerConfig({**self.BASE, "fleet.enabled": True})
+
+    def test_fleet_instances_validated(self):
+        with pytest.raises(ConfigException):
+            RemoteStorageManagerConfig({
+                **self.BASE, "fleet.enabled": True, "fleet.instance.id": "a",
+                "fleet.instances": ["a", "a"],
+            })
+
+    def test_defaults(self):
+        config = RemoteStorageManagerConfig(self.BASE)
+        assert config.fleet_enabled is False
+        assert config.fleet_vnodes == 64
+        assert config.sidecar_http_max_workers == 32
+
+    def test_rsm_wires_router_peer_cache_and_metrics(self):
+        rsm = RemoteStorageManager()
+        rsm.configure({
+            **self.BASE,
+            "fleet.enabled": True,
+            "fleet.instance.id": "g0",
+            "fleet.instances": ["g0", "g1=http://127.0.0.1:1"],
+        })
+        try:
+            assert rsm.fleet_router is not None
+            assert rsm.fleet_router.instance_id == "g0"
+            assert sorted(rsm.fleet_router.instances) == ["g0", "g1"]
+            assert rsm.peer_chunk_cache is not None
+            names = {mn.name for mn in rsm.metrics.registry.metric_names
+                     if mn.group == "fleet-metrics"}
+            assert {"fleet-instances", "fleet-local-ownership",
+                    "fleet-peer-hits-total", "fleet-coalesced-fetches-total",
+                    "fleet-forwards-total"} <= names
+        finally:
+            rsm.close()
+
+    def test_non_fleet_rsm_has_no_router(self):
+        rsm = RemoteStorageManager()
+        rsm.configure(self.BASE)
+        try:
+            assert rsm.fleet_router is None
+            assert rsm.peer_chunk_cache is None
+        finally:
+            rsm.close()
+
+
+def _make_fleet(tmp_path, names=("a", "b")):
+    store = tmp_path / "store"
+    store.mkdir(exist_ok=True)
+    rsms = {}
+    for name in names:
+        rsm = RemoteStorageManager()
+        rsm.configure({
+            "storage.backend.class":
+                "tieredstorage_tpu.storage.filesystem.FileSystemStorage",
+            "storage.root": str(store),
+            "chunk.size": 1024,
+            "key.prefix": "fleet/",
+            "fetch.chunk.cache.class":
+                "tieredstorage_tpu.fetch.cache.memory.MemoryChunkCache",
+            "fetch.chunk.cache.size": -1,
+            "fleet.enabled": True,
+            "fleet.instance.id": name,
+            "fleet.vnodes": 32,
+        })
+        rsms[name] = rsm
+    gateways = {n: SidecarHttpGateway(r).start() for n, r in rsms.items()}
+    peers = {n: f"http://127.0.0.1:{g.port}" for n, g in gateways.items()}
+    for r in rsms.values():
+        r.set_fleet_peers(peers)
+    return rsms, gateways
+
+
+class TestGatewayChunkRoute:
+    @pytest.fixture
+    def fleet(self, tmp_path):
+        rsms, gateways = _make_fleet(tmp_path)
+        md = make_segment_metadata()
+        rsms["a"].copy_log_segment_data(
+            md, make_segment_data(tmp_path, with_txn=False)
+        )
+        key = ObjectKeyFactory("fleet/", False).key(md, Suffix.LOG).value
+        yield rsms, gateways, md, key
+        for g in gateways.values():
+            g.stop()
+        for r in rsms.values():
+            r.close()
+
+    def _get(self, port, path, headers=None):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("GET", path, headers=headers or {})
+        resp = conn.getresponse()
+        body = resp.read()
+        conn.close()
+        return resp.status, body
+
+    def test_owner_serves_framed_chunks(self, fleet):
+        rsms, gateways, md, key = fleet
+        owner = rsms["a"].fleet_router.owner(key)
+        from urllib.parse import quote
+
+        status, body = self._get(
+            gateways[owner].port, f"/chunk?key={quote(key, safe='')}&chunks=0-1"
+        )
+        assert status == 200
+        chunks = decode_chunk_frames(body, expected=2)
+        assert sum(len(c) for c in chunks) == 2048
+
+    def test_bad_params_400_unknown_key_404_expired_deadline_504(self, fleet):
+        rsms, gateways, md, key = fleet
+        port = next(iter(gateways.values())).port
+        assert self._get(port, "/chunk?key=only")[0] == 400
+        assert self._get(port, "/chunk?key=a.log&chunks=x-y")[0] == 400
+        from urllib.parse import quote
+
+        missing = quote("fleet/none-0/0/00000000000000000000-x.log", safe="")
+        owner_port = gateways[
+            rsms["a"].fleet_router.owner(
+                "fleet/none-0/0/00000000000000000000-x.log")
+        ].port
+        assert self._get(owner_port, f"/chunk?key={missing}&chunks=0-0")[0] == 404
+        status, body = self._get(
+            port, f"/chunk?key={quote(key, safe='')}&chunks=0-0",
+            headers={"x-deadline-ms": "0"},
+        )
+        assert status == 504 and b"DeadlineExceededException" in body
+
+    def test_window_beyond_segment_is_400(self, fleet):
+        rsms, gateways, md, key = fleet
+        from urllib.parse import quote
+
+        owner = rsms["a"].fleet_router.owner(key)
+        status, body = self._get(
+            gateways[owner].port,
+            f"/chunk?key={quote(key, safe='')}&chunks=0-999",
+        )
+        assert status == 400 and b"beyond" in body
+
+    def test_fleet_disabled_route_is_404(self, tmp_path):
+        rsm = RemoteStorageManager()
+        rsm.configure({
+            "storage.backend.class":
+                "tieredstorage_tpu.storage.memory.InMemoryStorage",
+            "chunk.size": 1024,
+        })
+        gateway = SidecarHttpGateway(rsm).start()
+        try:
+            status, body = self._get(gateway.port, "/chunk?key=a.log&chunks=0-0")
+            assert status == 404 and b"fleet" in body
+        finally:
+            gateway.stop()
+            rsm.close()
+
+    def test_non_owner_resolves_via_peer_tier(self, fleet):
+        rsms, gateways, md, key = fleet
+        owner = rsms["a"].fleet_router.owner(key)
+        other = next(n for n in rsms if n != owner)
+        with rsms[other].fetch_log_segment(md, 0) as stream:
+            payload = stream.read()
+        assert len(payload) == md.segment_size_in_bytes
+        assert rsms[other].peer_chunk_cache.peer_hits > 0
+        assert rsms[owner].peer_chunk_cache.forwards == 0
+
+    def test_dead_owner_falls_back_byte_identically(self, fleet):
+        rsms, gateways, md, key = fleet
+        owner = rsms["a"].fleet_router.owner(key)
+        other = next(n for n in rsms if n != owner)
+        with rsms[owner].fetch_log_segment(md, 0) as stream:
+            expected = stream.read()
+        gateways[owner].stop()  # hard kill before the non-owner ever read it
+        with rsms[other].fetch_log_segment(md, 0) as stream:
+            got = stream.read()
+        assert got == expected
+        cache = rsms[other].peer_chunk_cache
+        assert cache.forward_failures > 0 and cache.peers_down == 1
+
+
+# ------------------------------------------------- bounded gateway worker pool
+class _BlockingRsm:
+    """Fake RSM whose /scrub handler blocks, counting concurrent entries."""
+
+    tracer = None
+    admission = None
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.entered = 0
+        self.peak = 0
+        self._lock = threading.Lock()
+
+    def scrub_status(self):
+        with self._lock:
+            self.entered += 1
+            self.peak = max(self.peak, self.entered)
+        try:
+            self.release.wait(timeout=10)
+            return {"enabled": False}
+        finally:
+            with self._lock:
+                self.entered -= 1
+
+
+class TestBoundedWorkerPool:
+    def test_worker_count_from_config(self, tmp_path):
+        rsm = RemoteStorageManager()
+        rsm.configure({
+            "storage.backend.class":
+                "tieredstorage_tpu.storage.memory.InMemoryStorage",
+            "chunk.size": 1024,
+            "sidecar.http.max.workers": 5,
+        })
+        gateway = SidecarHttpGateway(rsm).start()
+        try:
+            assert gateway.max_workers == 5
+        finally:
+            gateway.stop()
+            rsm.close()
+
+    def test_concurrency_capped_at_max_workers(self):
+        rsm = _BlockingRsm()
+        gateway = SidecarHttpGateway(rsm, max_workers=2).start()
+        results = []
+
+        def hit():
+            conn = http.client.HTTPConnection("127.0.0.1", gateway.port, timeout=15)
+            conn.request("GET", "/scrub")
+            results.append(conn.getresponse().status)
+            conn.close()
+
+        threads = [threading.Thread(target=hit) for _ in range(5)]
+        try:
+            for t in threads:
+                t.start()
+            time.sleep(0.3)  # let the pool saturate
+            assert rsm.peak <= 2  # the bound held; excess connections queued
+            rsm.release.set()
+            for t in threads:
+                t.join(timeout=15)
+            assert results == [200] * 5  # everyone eventually served
+        finally:
+            rsm.release.set()
+            gateway.stop()
+
+
+# ------------------------------------------------- per-tenant fair share
+class TestTenantFairShare:
+    def test_greedy_tenant_shed_at_saturation_polite_queues(self):
+        controller = AdmissionController(4, 8, queue_timeout_s=5.0)
+        for _ in range(4):
+            controller.acquire("flood", tenant="greedy")
+        # Saturated and over share (4/4 with one active tenant): immediate shed.
+        with pytest.raises(AdmissionRejectedException, match="fair share"):
+            controller.acquire("more", tenant="greedy")
+        assert controller.tenant_sheds["greedy"] == 1
+        # A polite tenant under its share queues and is admitted on release.
+        admitted = threading.Event()
+
+        def polite():
+            controller.acquire("polite-req", tenant="polite")
+            admitted.set()
+
+        t = threading.Thread(target=polite)
+        t.start()
+        time.sleep(0.05)
+        assert not admitted.is_set() and controller.queued == 1
+        controller.release(tenant="greedy")
+        t.join(timeout=5)
+        assert admitted.is_set()
+        assert controller.tenant_sheds.get("polite", 0) == 0
+        controller.release(tenant="polite")
+        for _ in range(3):
+            controller.release(tenant="greedy")
+        assert controller.active == 0
+
+    def test_share_splits_across_active_tenants(self):
+        controller = AdmissionController(4, 0)
+        controller.acquire("a1", tenant="a")
+        controller.acquire("a2", tenant="a")
+        controller.acquire("b1", tenant="b")
+        controller.acquire("b2", tenant="b")
+        # share = ceil(4/2) = 2; both tenants at their split: both shed.
+        for tenant in ("a", "b"):
+            with pytest.raises(AdmissionRejectedException):
+                controller.acquire("x", tenant=tenant)
+
+    def test_untenanted_requests_keep_legacy_behavior(self):
+        controller = AdmissionController(2, 0, retry_after_s=3.0)
+        controller.acquire("a")
+        controller.acquire("b")
+        with pytest.raises(AdmissionRejectedException) as exc_info:
+            controller.acquire("c")
+        assert exc_info.value.retry_after_s == 3.0
+        assert not controller.tenant_sheds
+        controller.release()
+        controller.acquire("d")
+
+    def test_under_saturation_a_tenant_may_use_every_slot(self):
+        controller = AdmissionController(4, 0)
+        for _ in range(4):
+            controller.acquire("burst", tenant="solo")  # no shed below the limit
+        assert controller.tenant_active("solo") == 4

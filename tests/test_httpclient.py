@@ -105,3 +105,139 @@ def test_no_replay_of_sent_post_on_reused_connection(monkeypatch):
     assert client2.request("GET", "/warmup").status == 200
     assert client2.request("GET", "/again").status == 200
     assert sends["n"] == 3
+
+
+# --------------------------------------------------- bounded connection pool
+class _OkResp:
+    def __init__(self, drained=True):
+        self._drained = drained
+        self.status = 200
+
+    def read(self, *a):
+        return b"" if self._drained else b"x"
+
+    def isclosed(self):
+        return self._drained
+
+    def getheaders(self):
+        return []
+
+    def close(self):
+        pass
+
+
+def _counting_factory(created, resp_factory=_OkResp):
+    class Conn:
+        def __init__(self):
+            created.append(self)
+
+        def request(self, *a, **k):
+            pass
+
+        def getresponse(self):
+            return resp_factory()
+
+        def close(self):
+            pass
+
+    return Conn
+
+
+class TestConnectionPool:
+    def test_keepalive_reuse_across_sequential_requests(self, monkeypatch):
+        # One socket serves many sequential requests from any thread — the
+        # per-thread design paid one handshake per worker thread instead.
+        client = HttpClient("http://test.invalid")
+        created = []
+        monkeypatch.setattr(client, "_new_connection", _counting_factory(created))
+        for _ in range(5):
+            assert client.request("GET", "/k").status == 200
+        assert len(created) == 1
+        assert client.pool.idle == 1 and client.pool.in_use == 0
+
+    def test_bound_blocks_until_slot_freed(self, monkeypatch):
+        import threading
+
+        from tieredstorage_tpu.storage.httpclient import NO_RETRY
+
+        client = HttpClient(
+            "http://test.invalid", retry=NO_RETRY, max_connections=1,
+            pool_wait_timeout_s=5.0,
+        )
+        release = threading.Event()
+        in_flight = []
+
+        class SlowResp(_OkResp):
+            def read(self, *a):
+                release.wait(timeout=5)
+                return b""
+
+        class Conn:
+            def __init__(self):
+                in_flight.append(self)
+
+            def request(self, *a, **k):
+                pass
+
+            def getresponse(self):
+                return SlowResp()
+
+            def close(self):
+                pass
+
+        monkeypatch.setattr(client, "_new_connection", Conn)
+        results = []
+        threads = [
+            threading.Thread(
+                target=lambda: results.append(client.request("GET", "/k").status)
+            )
+            for _ in range(3)
+        ]
+        for t in threads:
+            t.start()
+        release.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert results == [200, 200, 200]
+        # The bound held: only one connection ever existed.
+        assert len(in_flight) == 1
+
+    def test_pool_exhausted_raises_http_error(self, monkeypatch):
+        from tieredstorage_tpu.storage.httpclient import NO_RETRY
+
+        client = HttpClient(
+            "http://test.invalid", retry=NO_RETRY, max_connections=1,
+            pool_wait_timeout_s=0.05,
+        )
+        created = []
+        monkeypatch.setattr(client, "_new_connection", _counting_factory(created))
+        client.pool.acquire()  # hold the only slot
+        with pytest.raises(HttpError, match="pool exhausted"):
+            client.request("GET", "/k")
+        assert client.pool.exhausted_total == 1
+
+    def test_drained_stream_returns_connection_for_reuse(self, monkeypatch):
+        client = HttpClient("http://test.invalid")
+        created = []
+        monkeypatch.setattr(client, "_new_connection", _counting_factory(created))
+        for _ in range(3):
+            status, _, stream = client.request_stream("GET", "/k")
+            assert status == 200
+            stream.read()
+            stream.close()
+        assert len(created) == 1  # drained bodies recycle their socket
+
+    def test_abandoned_stream_discards_connection(self, monkeypatch):
+        client = HttpClient("http://test.invalid")
+        created = []
+        monkeypatch.setattr(
+            client, "_new_connection",
+            _counting_factory(created, lambda: _OkResp(drained=False)),
+        )
+        status, _, stream = client.request_stream("GET", "/k")
+        assert status == 200
+        stream.close()  # body NOT drained: framing desynced, socket useless
+        assert client.pool.idle == 0 and client.pool.in_use == 0
+        # Next request mints a fresh connection.
+        client.request_stream("GET", "/k")[2].close()
+        assert len(created) == 2

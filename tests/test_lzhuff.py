@@ -335,3 +335,28 @@ class TestBackendDispatch:
         _codec_id("compression.codec", "tpu-lzhuff-v1")
         with pytest.raises(ConfigException):
             _codec_id("compression.codec", "tpu-lzhuff-v2")
+
+    def test_configuring_lzhuff_warns_deprecation(self):
+        """ISSUE 6 satellite: tpu-lzhuff-v1 is demoted behind tpu-huff-v1
+        (BENCH_r05: 0.001 GiB/s compress, 435 ms ranged-fetch p99) — still
+        readable/usable, but explicitly configuring it warns."""
+        import warnings
+
+        from tieredstorage_tpu.config.rsm_config import RemoteStorageManagerConfig
+
+        base = {
+            "storage.backend.class":
+                "tieredstorage_tpu.storage.memory.InMemoryStorage",
+            "chunk.size": 1024,
+            "compression.enabled": True,
+        }
+        with pytest.warns(DeprecationWarning, match="tpu-lzhuff-v1"):
+            config = RemoteStorageManagerConfig(
+                {**base, "compression.codec": "tpu-lzhuff-v1"}
+            )
+        assert config.compression_codec == "tpu-lzhuff-v1"  # still honored
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # the demoted-to codec is silent
+            RemoteStorageManagerConfig(
+                {**base, "compression.codec": "tpu-huff-v1"}
+            )

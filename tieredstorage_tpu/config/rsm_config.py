@@ -46,12 +46,27 @@ _valid_recording_level.description = "[INFO, DEBUG]"
 
 
 def _codec_id(name: str, value) -> None:
+    import warnings
+
     from tieredstorage_tpu.transform.api import THUFF, TLZHUFF, ZSTD
 
     if value not in (ZSTD, THUFF, TLZHUFF):
         raise ConfigException(
             f"Invalid value {value!r} for configuration {name}: "
             f"must be one of [{ZSTD!r}, {THUFF!r}, {TLZHUFF!r}]"
+        )
+    if value == TLZHUFF:
+        # Demoted behind tpu-huff-v1 (BENCH_r05: 0.001 GiB/s compress,
+        # 435 ms ranged-fetch p99 — two orders below every alternative).
+        # Still supported for reading existing manifests; new uploads should
+        # use tpu-huff-v1 until the parallelized LZ match kernel lands.
+        warnings.warn(
+            f"{TLZHUFF!r} is deprecated as a configured codec: its device LZ "
+            f"stage is two orders of magnitude slower than every alternative "
+            f"(BENCH_r05). Use {THUFF!r} (device) or {ZSTD!r} (host) instead; "
+            f"existing {TLZHUFF!r} segments remain readable.",
+            DeprecationWarning,
+            stacklevel=2,
         )
 
 
@@ -66,6 +81,17 @@ def _parse_fault_rules(value) -> None:
 
 _valid_fault_schedule = parseable_by(
     _parse_fault_rules, "fault rules 'op:action[=arg][@trigger]'"
+)
+
+
+def _parse_fleet_instances(value) -> None:
+    from tieredstorage_tpu.fleet.ring import parse_instances
+
+    parse_instances(value)
+
+
+_valid_fleet_instances = parseable_by(
+    _parse_fleet_instances, "fleet members 'name[=http://host:port]'"
 )
 
 
@@ -108,8 +134,12 @@ def _base_def() -> ConfigDef:
         "compression.codec", "string", default="zstd", importance="medium",
         validator=_codec_id,
         doc="Compression codec id recorded in the manifest: 'zstd' "
-            "(reference-compatible), 'tpu-huff-v1' (order-0 device codec), "
-            "or 'tpu-lzhuff-v1' (device LZ + Huffman).",
+            "(reference-compatible) or 'tpu-huff-v1' (order-0 device codec, "
+            "the preferred device choice). 'tpu-lzhuff-v1' (device LZ + "
+            "Huffman) is DEPRECATED — demoted behind tpu-huff-v1 after "
+            "BENCH_r05 measured it two orders of magnitude slower on both "
+            "compress and ranged fetch; configuring it emits a "
+            "DeprecationWarning, existing segments remain readable.",
     ))
     d.define(ConfigKey(
         "tracing.enabled", "bool", default=False, importance="low",
@@ -320,6 +350,65 @@ def _base_def() -> ConfigDef:
             "control sheds what the pool cannot absorb.",
     ))
     d.define(ConfigKey(
+        "sidecar.http.max.workers", "int", default=32,
+        validator=in_range(1, None), importance="low",
+        doc="Bounded worker pool of the HTTP shim-wire gateway. Connections "
+            "are accepted eagerly but handled by at most this many threads; "
+            "excess connections queue in the executor instead of spawning "
+            "an unbounded thread per connection. Size to the expected "
+            "broker fetch parallelism plus fleet peer traffic; admission "
+            "control sheds what the pool cannot absorb.",
+    ))
+    d.define(ConfigKey(
+        "fleet.enabled", "bool", default=False, importance="medium",
+        doc="Run this sidecar as a member of a gateway fleet: segment object "
+            "keys route to owner instances on a consistent-hash ring "
+            "(fleet/ring.py), non-owner chunk misses are resolved with one "
+            "hop to the owner's chunk cache over the shim-wire GET /chunk "
+            "route before falling back to remote storage, and concurrent "
+            "duplicate fetches coalesce to one backend read. Requires "
+            "fleet.instance.id.",
+    ))
+    d.define(ConfigKey(
+        "fleet.instance.id", "string", default=None,
+        validator=non_empty_string, importance="medium",
+        doc="This instance's name on the fleet ring (must be unique across "
+            "the fleet and stable across restarts — the ring is derived "
+            "from names, so renaming an instance moves its keys).",
+    ))
+    d.define(ConfigKey(
+        "fleet.instances", "list", default=[],
+        validator=_valid_fleet_instances, importance="medium",
+        doc="Static fleet membership: entries 'name=http://host:port' (a "
+            "routable peer gateway) or bare 'name' (address unknown — "
+            "typically this instance itself). Every member must configure "
+            "the same list so all rings agree. Empty means a solo ring "
+            "until FleetRouter.set_membership / --fleet-peers supplies "
+            "addresses (ports are often only known after gateways bind).",
+    ))
+    d.define(ConfigKey(
+        "fleet.vnodes", "int", default=64,
+        validator=in_range(1, 4096), importance="low",
+        doc="Virtual nodes per instance on the consistent-hash ring; more "
+            "vnodes smooth per-instance ownership toward 1/N at the cost "
+            "of a larger (static) ring table.",
+    ))
+    d.define(ConfigKey(
+        "fleet.forward.timeout.ms", "long", default=2_000,
+        validator=in_range(1, None), importance="low",
+        doc="Socket timeout for one peer GET /chunk forward; the ambient "
+            "end-to-end deadline clamps it further. A forward that times "
+            "out marks the peer down and the read falls back to remote "
+            "storage.",
+    ))
+    d.define(ConfigKey(
+        "fleet.peer.down.cooldown.ms", "long", default=5_000,
+        validator=in_range(1, None), importance="low",
+        doc="How long a peer stays marked down after a failed forward "
+            "(reads route straight to remote storage meanwhile); the next "
+            "forward after the cooldown is the health probe.",
+    ))
+    d.define(ConfigKey(
         "replication.antientropy.enabled", "bool", default=False, importance="medium",
         doc="Run the background anti-entropy repairer when the storage "
             "backend is a ReplicatedStorageBackend: periodic passes diff "
@@ -400,6 +489,10 @@ class RemoteStorageManagerConfig:
             # Reference: RemoteStorageManagerConfig.java:308-313.
             raise ConfigException(
                 "compression.enabled must be enabled if compression.heuristic.enabled is"
+            )
+        if self._values["fleet.enabled"] and not self._values["fleet.instance.id"]:
+            raise ConfigException(
+                "fleet.instance.id must be provided if fleet.enabled is"
             )
         if self.encryption_enabled:
             if not self._values["encryption.key.pair.id"]:
@@ -598,6 +691,34 @@ class RemoteStorageManagerConfig:
     @property
     def sidecar_grpc_max_workers(self) -> int:
         return self._values["sidecar.grpc.max.workers"]
+
+    @property
+    def sidecar_http_max_workers(self) -> int:
+        return self._values["sidecar.http.max.workers"]
+
+    @property
+    def fleet_enabled(self) -> bool:
+        return self._values["fleet.enabled"]
+
+    @property
+    def fleet_instance_id(self) -> Optional[str]:
+        return self._values["fleet.instance.id"]
+
+    @property
+    def fleet_instances(self) -> list[str]:
+        return self._values["fleet.instances"]
+
+    @property
+    def fleet_vnodes(self) -> int:
+        return self._values["fleet.vnodes"]
+
+    @property
+    def fleet_forward_timeout_ms(self) -> int:
+        return self._values["fleet.forward.timeout.ms"]
+
+    @property
+    def fleet_peer_down_cooldown_ms(self) -> int:
+        return self._values["fleet.peer.down.cooldown.ms"]
 
     @property
     def replication_antientropy_enabled(self) -> bool:

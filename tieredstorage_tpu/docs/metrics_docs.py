@@ -94,6 +94,26 @@ def _collect_replication() -> dict[str, list[str]]:
         replicated.close()
 
 
+def _collect_fleet() -> dict[str, list[str]]:
+    from tieredstorage_tpu.fleet import (
+        FleetMetrics,
+        FleetRouter,
+        PeerChunkCache,
+        register_fleet_metrics,
+    )
+    from tieredstorage_tpu.metrics.core import MetricsRegistry
+
+    registry = MetricsRegistry()
+    router = FleetRouter("docs", vnodes=4)
+    peer_cache = PeerChunkCache(None, router)
+    try:
+        register_fleet_metrics(registry, router=router, peer_cache=peer_cache)
+        FleetMetrics(registry).record_forward(1.0)
+        return _group_names(registry)
+    finally:
+        peer_cache.close()
+
+
 def _collect_scrub() -> dict[str, list[str]]:
     from tieredstorage_tpu.metrics.core import MetricsRegistry
     from tieredstorage_tpu.scrub.metrics import ScrubMetrics, register_scrub_metrics
@@ -202,6 +222,7 @@ def generate() -> str:
         ("Cache and thread-pool metrics", _collect_caches()),
         ("Resilience metrics", _collect_resilience()),
         ("Replication metrics", _collect_replication()),
+        ("Fleet metrics", _collect_fleet()),
         ("Scrubber metrics", _collect_scrub()),
         ("Tracer metrics", _collect_tracer()),
         ("Storage backend client metrics", _collect_backends()),

@@ -54,12 +54,19 @@ class ChunkManagerFactory:
         self._config = ChunkManagerFactoryConfig(configs)
 
     def init_chunk_manager(
-        self, fetcher: ObjectFetcher, transform_backend: TransformBackend
+        self, fetcher: ObjectFetcher, transform_backend: TransformBackend,
+        inner_wrapper=None,
     ) -> ChunkManager:
+        """`inner_wrapper`, when given, wraps the DefaultChunkManager BELOW
+        the cache (fleet mode inserts the PeerChunkCache tier there: local
+        cache first, then route-to-owner, then backend)."""
         default = DefaultChunkManager(fetcher, transform_backend)
+        inner: ChunkManager = (
+            inner_wrapper(default) if inner_wrapper is not None else default
+        )
         cache_class = self._config.chunk_cache_class
         if cache_class is None:
-            return default
-        cache: ChunkCache = cache_class(default)
+            return inner
+        cache: ChunkCache = cache_class(inner)
         cache.configure(self._config.chunk_cache_configs())
         return cache

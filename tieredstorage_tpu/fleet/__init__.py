@@ -1,0 +1,37 @@
+"""Fleet mode: sharded gateway instances behind consistent-hash routing.
+
+The scale-out tier (ROADMAP item 3): N sidecar instances form a fleet —
+``fleet/ring.py`` maps every segment object key to one owner instance on a
+consistent-hash ring (virtual nodes, bounded key movement under membership
+change), ``fleet/peer_cache.py`` resolves non-owner misses with one hop to
+the owner's chunk cache over the shim-wire gateway (``GET /chunk``), and
+``fleet/singleflight.py`` collapses concurrent duplicate fetches — local or
+forwarded — to exactly one backend read. ``fleet/metrics.py`` exports the
+``fleet-metrics`` group. See docs/fleet.rst.
+"""
+
+from tieredstorage_tpu.fleet.metrics import (
+    FLEET_METRIC_GROUP,
+    FleetMetrics,
+    register_fleet_metrics,
+)
+from tieredstorage_tpu.fleet.peer_cache import (
+    PeerChunkCache,
+    decode_chunk_frames,
+    encode_chunk_frames,
+)
+from tieredstorage_tpu.fleet.ring import FleetRouter, HashRing, parse_instances
+from tieredstorage_tpu.fleet.singleflight import SingleFlight
+
+__all__ = [
+    "FLEET_METRIC_GROUP",
+    "FleetMetrics",
+    "FleetRouter",
+    "HashRing",
+    "PeerChunkCache",
+    "SingleFlight",
+    "decode_chunk_frames",
+    "encode_chunk_frames",
+    "parse_instances",
+    "register_fleet_metrics",
+]

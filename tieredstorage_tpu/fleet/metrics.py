@@ -1,0 +1,101 @@
+"""Fleet-mode observability: ring ownership, peer tier, coalescing, forwards.
+
+Group ``fleet-metrics``, same conventions as the resilience gauges
+(metrics/rsm_metrics.py): components keep plain counters, this module
+publishes them as supplier gauges, plus one latency family —
+``fleet-forward-time`` avg/max with the log-scale ``fleet-forward-time-ms``
+histogram — fed through ``FleetMetrics.record_forward`` (wired as
+``PeerChunkCache.on_forward`` by the RSM).
+"""
+
+from __future__ import annotations
+
+from tieredstorage_tpu.metrics.core import (
+    Avg,
+    Histogram,
+    Max,
+    MetricName,
+    MetricsRegistry,
+)
+
+FLEET_METRIC_GROUP = "fleet-metrics"
+
+
+class FleetMetrics:
+    """Recorder for the forward-latency family (the only non-gauge)."""
+
+    def __init__(self, registry: MetricsRegistry) -> None:
+        self.registry = registry
+
+    def record_forward(self, ms: float) -> None:
+        """One completed peer forward (request out -> framed chunks in)."""
+        self.registry.sensor("fleet-forward-time").ensure_stats(lambda: [
+            (MetricName.of("fleet-forward-time-avg", FLEET_METRIC_GROUP), Avg()),
+            (MetricName.of("fleet-forward-time-max", FLEET_METRIC_GROUP), Max()),
+            (
+                MetricName.of(
+                    "fleet-forward-time-ms", FLEET_METRIC_GROUP,
+                    "peer forward latency histogram (ms, log-scale buckets)",
+                ),
+                Histogram(),
+            ),
+        ]).record(ms)
+
+
+def register_fleet_metrics(
+    registry: MetricsRegistry,
+    *,
+    router=None,
+    peer_cache=None,
+) -> None:
+    """Publish fleet counters as gauges (group ``fleet-metrics``)."""
+
+    def gauge(name: str, supplier, description: str = "", tags=None) -> None:
+        registry.add_gauge(
+            MetricName.of(name, FLEET_METRIC_GROUP, description, tags=tags or {}),
+            supplier,
+        )
+
+    if router is not None:
+        gauge("fleet-instances", lambda: float(len(router.instances)),
+              "Fleet members in the current consistent-hash ring")
+        gauge("fleet-vnodes", lambda: float(router.vnodes),
+              "Virtual nodes per instance on the ring")
+        gauge("fleet-membership-generation", lambda: float(router.generation),
+              "Membership changes applied (starts at 1)")
+        gauge(
+            "fleet-local-ownership",
+            lambda: float(router.local_ownership_fraction()),
+            "Fraction of the hash circle owned by this instance (~1/N)",
+        )
+    if peer_cache is not None:
+        gauge("fleet-forwards-total", lambda: float(peer_cache.forwards),
+              "Chunk windows forwarded to their owner instance")
+        gauge("fleet-peer-hits-total", lambda: float(peer_cache.peer_hits),
+              "Forwards answered by the owner's chunk tier")
+        gauge("fleet-peer-misses-total", lambda: float(peer_cache.peer_misses),
+              "Forwards the owner could not serve (local fallback)")
+        gauge(
+            "fleet-forward-failures-total",
+            lambda: float(peer_cache.forward_failures),
+            "Forwards that failed in transport (peer marked down)",
+        )
+        gauge("fleet-peers-down", lambda: float(peer_cache.peers_down),
+              "Peers currently in the down cooldown")
+        flight = peer_cache.singleflight
+        gauge(
+            "fleet-singleflight-leaders-total",
+            lambda: float(flight.leaders),
+            "Chunk windows actually resolved (one forward or backend read)",
+        )
+        gauge(
+            "fleet-coalesced-fetches-total",
+            lambda: float(flight.coalesced),
+            "Concurrent duplicate fetches served by a leader's single "
+            "resolve",
+        )
+        gauge(
+            "fleet-singleflight-failures-total",
+            lambda: float(flight.failures),
+            "Flights whose leader failed (error shared by all joiners)",
+        )

@@ -1,0 +1,289 @@
+"""Peer chunk cache tier: ask the owning sibling before remote storage.
+
+The memcache-at-Facebook shape (Nishtala et al., NSDI '13): consistent-hash
+routing (fleet/ring.py) concentrates each segment's chunks in exactly one
+instance's chunk cache, so a non-owner resolves a miss with ONE cheap hop to
+the owner instead of a remote-storage ranged GET + detransform. The owner
+serves the forwarded window through its own full chunk path (local cache,
+then single-flight backend fetch), so a fleet-wide thundering herd on a hot
+chunk still causes exactly one backend read — the owner's.
+
+Layering (owner and non-owner identical):
+
+    ChunkCache (local, per-instance)
+      -> PeerChunkCache (this module: route -> forward | local)
+        -> SingleFlight -> DefaultChunkManager -> remote storage
+
+Failure semantics: forwarding is an OPTIMIZATION, never a dependency. A
+forward that fails (connect/timeout/5xx) marks the peer down for
+``fleet.peer.down.cooldown.ms`` and the read falls back to the local
+backend path — byte-identical result, one extra backend read, no error. A
+404 from the owner (object unknown there) falls back the same way so the
+authoritative error comes from this instance's own storage stack. Forwards
+propagate the ambient Deadline (``x-deadline-ms``) and trace context
+(``traceparent``), and the wire is the existing shim-wire gateway (new
+``GET /chunk`` route) — no new listener, no new protocol.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import struct
+import threading
+import time
+from typing import BinaryIO, Optional, Sequence
+from urllib.parse import quote
+
+from tieredstorage_tpu.fetch.chunk_manager import ChunkManager
+from tieredstorage_tpu.fleet.ring import FleetRouter
+from tieredstorage_tpu.fleet.singleflight import SingleFlight
+from tieredstorage_tpu.manifest.segment_manifest import SegmentManifestV1
+from tieredstorage_tpu.storage.core import ObjectKey
+from tieredstorage_tpu.storage.httpclient import HttpClient, HttpError, NO_RETRY
+from tieredstorage_tpu.utils.deadline import DEADLINE_HEADER, current_deadline
+from tieredstorage_tpu.utils.tracing import TRACEPARENT_HEADER, NOOP_TRACER
+
+
+def encode_chunk_frames(chunks: Sequence[bytes]) -> bytes:
+    """Peer-wire framing of a chunk window: u32 count, then per chunk
+    u32 length | bytes (big-endian, shim-wire style). Plaintext chunks are
+    variable-length (compression), so the frame carries explicit sizes."""
+    out = io.BytesIO()
+    out.write(struct.pack(">I", len(chunks)))
+    for chunk in chunks:
+        out.write(struct.pack(">I", len(chunk)))
+        out.write(chunk)
+    return out.getvalue()
+
+
+def decode_chunk_frames(blob: bytes, *, expected: int) -> list[bytes]:
+    """Inverse of encode_chunk_frames; raises ValueError on any mismatch
+    (a torn/truncated peer response must fall back, not serve short bytes)."""
+    view = memoryview(blob)
+    if len(view) < 4:
+        raise ValueError("peer chunk response truncated (no count)")
+    (count,) = struct.unpack_from(">I", view, 0)
+    if count != expected:
+        raise ValueError(f"peer returned {count} chunks, wanted {expected}")
+    offset = 4
+    chunks: list[bytes] = []
+    for _ in range(count):
+        if len(view) - offset < 4:
+            raise ValueError("peer chunk response truncated (length)")
+        (length,) = struct.unpack_from(">I", view, offset)
+        offset += 4
+        if len(view) - offset < length:
+            raise ValueError("peer chunk response truncated (body)")
+        chunks.append(bytes(view[offset : offset + length]))
+        offset += length
+    if offset != len(view):
+        raise ValueError("peer chunk response has trailing bytes")
+    return chunks
+
+
+class PeerChunkCache(ChunkManager):
+    """ChunkManager tier that routes misses to the owning fleet sibling."""
+
+    def __init__(
+        self,
+        delegate: ChunkManager,
+        router: FleetRouter,
+        *,
+        forward_timeout_s: float = 2.0,
+        down_cooldown_s: float = 5.0,
+        tracer=NOOP_TRACER,
+        on_forward=None,
+        time_source=time.monotonic,
+    ) -> None:
+        self._delegate = delegate
+        self._router = router
+        self._flight = SingleFlight(tracer=tracer)
+        self.tracer = tracer
+        #: Optional `(elapsed_ms)` hook per completed forward; the RSM wires
+        #: it to the fleet-forward-time histogram.
+        self.on_forward = on_forward
+        self.forward_timeout_s = forward_timeout_s
+        self.down_cooldown_s = down_cooldown_s
+        self._now = time_source
+        self._lock = threading.Lock()
+        self._clients: dict[str, HttpClient] = {}
+        self._down_until: dict[str, float] = {}
+        #: Keys this instance is currently serving AS the owner (forwarded
+        #: requests pin their key so the serving path can never re-forward,
+        #: even across the chunk cache's loader pool threads).
+        self._pinned: dict[str, int] = {}
+        # Counters (exported as fleet-metrics gauges).
+        self.forwards = 0
+        self.peer_hits = 0
+        self.peer_misses = 0
+        self.forward_failures = 0
+
+    @property
+    def delegate(self) -> ChunkManager:
+        return self._delegate
+
+    @property
+    def singleflight(self) -> SingleFlight:
+        return self._flight
+
+    @property
+    def router(self) -> FleetRouter:
+        return self._router
+
+    @property
+    def peers_down(self) -> int:
+        now = self._now()
+        with self._lock:
+            return sum(1 for until in self._down_until.values() if until > now)
+
+    def close(self) -> None:
+        with self._lock:
+            clients = list(self._clients.values())
+            self._clients.clear()
+        for client in clients:
+            client.close()
+        if hasattr(self._delegate, "close"):
+            self._delegate.close()
+
+    # -------------------------------------------------------------- pinning
+    @contextlib.contextmanager
+    def serving_locally(self, key_value: str):
+        """Pin `key_value` to the local path for the duration of the block —
+        the loop guard for forwarded requests. Keyed (not thread-local) so it
+        holds across the chunk cache's loader pool threads."""
+        with self._lock:
+            self._pinned[key_value] = self._pinned.get(key_value, 0) + 1
+        try:
+            yield
+        finally:
+            with self._lock:
+                count = self._pinned.get(key_value, 1) - 1
+                if count <= 0:
+                    self._pinned.pop(key_value, None)
+                else:
+                    self._pinned[key_value] = count
+
+    def _is_pinned(self, key_value: str) -> bool:
+        with self._lock:
+            return key_value in self._pinned
+
+    # ---------------------------------------------------------- peer health
+    def _is_down(self, peer: str) -> bool:
+        with self._lock:
+            return self._down_until.get(peer, 0.0) > self._now()
+
+    def _mark_down(self, peer: str, reason: str) -> None:
+        with self._lock:
+            self._down_until[peer] = self._now() + self.down_cooldown_s
+        self.tracer.event("fleet.peer_down", peer=peer, reason=reason)
+
+    def _client(self, peer: str, url: str) -> HttpClient:
+        with self._lock:
+            client = self._clients.get(peer)
+            if client is None or client.base_url != url:
+                if client is not None:
+                    client.close()
+                # Single attempt: the local backend path IS the retry, and a
+                # struggling peer must not absorb backoff sleeps.
+                client = HttpClient(
+                    url, timeout=self.forward_timeout_s, retry=NO_RETRY
+                )
+                self._clients[peer] = client
+            return client
+
+    # ----------------------------------------------------------------- reads
+    def get_chunk(
+        self, objects_key: ObjectKey, manifest: SegmentManifestV1, chunk_id: int
+    ) -> BinaryIO:
+        return io.BytesIO(self.get_chunks(objects_key, manifest, [chunk_id])[0])
+
+    def get_chunks(
+        self, objects_key: ObjectKey, manifest: SegmentManifestV1, chunk_ids: Sequence[int]
+    ) -> list[bytes]:
+        if not chunk_ids:
+            return []
+        # The flight wraps the WHOLE resolve (forward or backend): N
+        # concurrent identical windows produce at most one forward on a
+        # non-owner and exactly one backend read on the owner. Keyed by the
+        # exact id list, not endpoints: windows [0,2] and [0,1,2] must not
+        # share a flight (their results have different shapes).
+        flight_key = f"{objects_key.value}#{','.join(map(str, chunk_ids))}"
+        return self._flight.do(
+            flight_key,
+            lambda: self._resolve(objects_key, manifest, chunk_ids),
+            what=objects_key.value,
+        )
+
+    def _resolve(
+        self, objects_key: ObjectKey, manifest: SegmentManifestV1, chunk_ids: Sequence[int]
+    ) -> list[bytes]:
+        owner, url = self._router.route(objects_key.value)
+        if (
+            url is not None
+            and not self._is_pinned(objects_key.value)
+            and not self._is_down(owner)
+        ):
+            forwarded = self._try_forward(owner, url, objects_key, chunk_ids)
+            if forwarded is not None:
+                return forwarded
+        return self._delegate.get_chunks(objects_key, manifest, list(chunk_ids))
+
+    def _try_forward(
+        self, owner: str, url: str, objects_key: ObjectKey, chunk_ids: Sequence[int]
+    ) -> Optional[list[bytes]]:
+        """One GET /chunk against the owner; None means 'serve locally'
+        (miss, peer down, torn frame) — never an error."""
+        self.forwards += 1
+        self.tracer.event(
+            "fleet.forward", peer=owner, key=objects_key.value,
+            chunks=len(chunk_ids),
+        )
+        # The wire carries a contiguous lo-hi window; a sparse id list (the
+        # cache's missing-subset can have gaps) over-fetches the covering
+        # range and subselects — one round trip beats per-gap requests.
+        lo, hi = chunk_ids[0], chunk_ids[-1]
+        path = (
+            f"/chunk?key={quote(objects_key.value, safe='')}"
+            f"&chunks={lo}-{hi}"
+        )
+        headers: dict[str, str] = {}
+        traceparent = self.tracer.current_traceparent()
+        if traceparent:
+            headers[TRACEPARENT_HEADER] = traceparent
+        deadline = current_deadline()
+        if deadline is not None:
+            headers[DEADLINE_HEADER] = deadline.header_value()
+        start = time.monotonic()
+        try:
+            resp = self._client(owner, url).request("GET", path, headers=headers)
+        except HttpError as e:
+            self.forward_failures += 1
+            self._mark_down(owner, f"{type(e).__name__}")
+            return None
+        elapsed_ms = (time.monotonic() - start) * 1000.0
+        if resp.status == 200:
+            try:
+                window = decode_chunk_frames(resp.body, expected=hi - lo + 1)
+            except ValueError as e:
+                self.forward_failures += 1
+                self._mark_down(owner, str(e))
+                return None
+            chunks = [window[cid - lo] for cid in chunk_ids]
+            self.peer_hits += 1
+            if self.on_forward is not None:
+                self.on_forward(elapsed_ms)
+            self.tracer.event(
+                "fleet.peer_hit", peer=owner, key=objects_key.value,
+                chunks=len(chunks),
+            )
+            return chunks
+        if resp.status == 404:
+            # The owner cannot serve this key (not uploaded / already
+            # deleted there): the authoritative answer comes from the local
+            # storage stack.
+            self.peer_misses += 1
+            return None
+        self.forward_failures += 1
+        self._mark_down(owner, f"http {resp.status}")
+        return None

@@ -13,6 +13,7 @@ the reference's per-chunk Enumeration chain.
 
 from __future__ import annotations
 
+import contextlib
 import io
 import functools
 import logging
@@ -65,6 +66,13 @@ from tieredstorage_tpu.storage.core import (
     StorageBackendException,
 )
 from tieredstorage_tpu.fetch.hedge import HedgeBudget, Hedger
+from tieredstorage_tpu.fleet import (
+    FleetMetrics,
+    FleetRouter,
+    PeerChunkCache,
+    parse_instances,
+    register_fleet_metrics,
+)
 from tieredstorage_tpu.storage.replicated import ReplicatedStorageBackend
 from tieredstorage_tpu.storage.resilient import (
     CircuitBreaker,
@@ -138,6 +146,10 @@ class RemoteStorageManager:
         #: Entry-gate admission controller (`admission.enabled`); the sidecar
         #: boundaries (HTTP gateway + gRPC server) shed through this.
         self.admission: Optional[AdmissionController] = None
+        #: Fleet mode (`fleet.*`): consistent-hash router + peer cache tier.
+        self.fleet_router: Optional[FleetRouter] = None
+        self._peer_cache: Optional[PeerChunkCache] = None
+        self._fleet_metrics: Optional[FleetMetrics] = None
 
     # ------------------------------------------------------------------ setup
     def configure(self, configs: Mapping[str, object]) -> None:
@@ -176,6 +188,7 @@ class RemoteStorageManager:
         if config.upload_rate_limit is not None:
             self._rate_bucket = TokenBucket(config.upload_rate_limit)
 
+        self._wire_fleet_router(config)
         self._chunk_manager = self._build_chunk_manager(backend)
         self._wire_fetch_observability()
         self._wire_tail_tolerance(config)
@@ -258,6 +271,83 @@ class RemoteStorageManager:
     def antientropy_scheduler(self):
         return self._antientropy_scheduler
 
+    def _wire_fleet_router(self, config: RemoteStorageManagerConfig) -> None:
+        """Fleet mode (`fleet.*`, ISSUE 6): build the consistent-hash router
+        BEFORE the chunk manager — `_build_chunk_manager` inserts the
+        PeerChunkCache tier (route → forward-to-owner → local single-flight
+        backend fetch) between the local chunk cache and the default
+        manager. Static membership comes from `fleet.instances`; dynamic
+        deployments call `set_fleet_peers` once gateway ports are known."""
+        if not config.fleet_enabled:
+            return
+        self.fleet_router = FleetRouter(
+            config.fleet_instance_id,
+            vnodes=config.fleet_vnodes,
+            tracer=self.tracer,
+        )
+        static = parse_instances(config.fleet_instances)
+        if static:
+            self.fleet_router.set_membership(static)
+        self._fleet_metrics = FleetMetrics(self._metrics.registry)
+        log.info(
+            "Fleet mode enabled: instance=%s vnodes=%d members=%s",
+            config.fleet_instance_id, config.fleet_vnodes,
+            sorted(self.fleet_router.peers) or [config.fleet_instance_id],
+        )
+
+    @property
+    def peer_chunk_cache(self) -> Optional[PeerChunkCache]:
+        return self._peer_cache
+
+    def set_fleet_peers(self, peers: Mapping[str, Optional[str]]) -> None:
+        """Replace fleet membership with {name: base_url|None} — the
+        bootstrap hook for deployments whose gateway ports are only known
+        after bind (tools/fleet_demo.py), and the demotion hook when a
+        member is declared dead (bounded key movement: only the arcs of the
+        changed instances move)."""
+        if self.fleet_router is None:
+            raise RemoteStorageException("fleet mode is not enabled")
+        self.fleet_router.set_membership(peers)
+
+    def fleet_fetch_chunks(
+        self, object_key_value: str, first: int, last: int
+    ) -> list[bytes]:
+        """Serve a window of plaintext chunks of a locally-owned segment to
+        a fleet sibling (the gateway's GET /chunk route). Runs through this
+        instance's FULL chunk path — local cache hit, else single-flight
+        backend fetch — with the key pinned local so a forwarded request is
+        never re-forwarded, even under transient ring disagreement."""
+        if self.fleet_router is None:
+            raise RemoteStorageException("fleet mode is not enabled")
+        base, _, suffix = object_key_value.rpartition(".")
+        if not base or suffix != Suffix.LOG.value:
+            raise ValueError(
+                f"peer chunk reads serve .log objects only, got {object_key_value!r}"
+            )
+        if first < 0 or last < first:
+            raise ValueError(f"invalid chunk window {first}-{last}")
+        manifest_key = ObjectKey(f"{base}.{Suffix.MANIFEST.value}")
+        with ensure_deadline(self.default_deadline_s):
+            check_deadline("fleet chunk serve")
+            manifest = self._manifest_cache.get(
+                manifest_key, lambda: self._fetch_manifest_by_key(manifest_key)
+            )
+            if last >= manifest.chunk_index.chunk_count:
+                raise ValueError(
+                    f"chunk window {first}-{last} beyond "
+                    f"{manifest.chunk_index.chunk_count} chunks"
+                )
+            pin = (
+                self._peer_cache.serving_locally(object_key_value)
+                if self._peer_cache is not None
+                else contextlib.nullcontext()
+            )
+            with pin:
+                return self._chunk_manager.get_chunks(
+                    ObjectKey(object_key_value), manifest,
+                    list(range(first, last + 1)),
+                )
+
     def _wire_scrubber(self, config: RemoteStorageManagerConfig) -> None:
         """Background integrity scrubbing (scrub/): enumerate + verify +
         quarantine/repair on a jittered period, throttled so it never
@@ -272,12 +362,8 @@ class RemoteStorageManager:
             if config.scrub_rate_bytes is not None
             else None
         )
-        inner = (
-            self._chunk_manager._delegate
-            if isinstance(self._chunk_manager, ChunkCache)
-            else self._chunk_manager
-        )
-        quarantine = inner.quarantine if isinstance(inner, DefaultChunkManager) else None
+        inner = self._innermost_chunk_manager(self._chunk_manager)
+        quarantine = inner.quarantine if inner is not None else None
         self._scrubber = Scrubber(
             self._storage,
             prefix=config.key_prefix,
@@ -340,9 +426,8 @@ class RemoteStorageManager:
                 tracer=self.tracer,
                 on_win=self._metrics.record_hedge_win,
             )
-            cm = self._chunk_manager
-            inner = cm._delegate if isinstance(cm, ChunkCache) else cm
-            if isinstance(inner, DefaultChunkManager):
+            inner = self._innermost_chunk_manager(self._chunk_manager)
+            if inner is not None:
                 inner.hedger = self._hedger
         if config.admission_enabled:
             self.admission = AdmissionController(
@@ -370,6 +455,14 @@ class RemoteStorageManager:
         )
 
     @property
+    def sidecar_http_max_workers(self) -> int:
+        """`sidecar.http.max.workers` (SidecarHttpGateway reads this when no
+        explicit max_workers is passed)."""
+        return (
+            self._config.sidecar_http_max_workers if self._config is not None else 32
+        )
+
+    @property
     def hedger(self) -> Optional[Hedger]:
         return self._hedger
 
@@ -381,8 +474,8 @@ class RemoteStorageManager:
         """Hand the configured tracer + latency hooks to the fetch tier so
         chunk-fetch/detransform/cache-get land in traces and histograms."""
         cm = self._chunk_manager
-        inner = cm._delegate if isinstance(cm, ChunkCache) else cm
-        if isinstance(inner, DefaultChunkManager):
+        inner = self._innermost_chunk_manager(cm)
+        if inner is not None:
             inner.tracer = self.tracer
             inner.on_fetch = self._metrics.record_chunk_fetch
         if isinstance(cm, ChunkCache):
@@ -434,18 +527,23 @@ class RemoteStorageManager:
         chunk_cache = (
             self._chunk_manager if isinstance(self._chunk_manager, ChunkCache) else None
         )
-        inner = chunk_cache._delegate if chunk_cache is not None else self._chunk_manager
         register_resilience_metrics(
             self._metrics.registry,
             breaker=self._breaker,
             fault_schedule=self._fault_schedule,
             chunk_cache=chunk_cache,
-            chunk_manager=inner if isinstance(inner, DefaultChunkManager) else None,
+            chunk_manager=self._innermost_chunk_manager(self._chunk_manager),
             hedger=self._hedger,
             retry_budget=self._retry_budget,
             admission=self.admission,
             deadline_exceeded_supplier=deadline_util.exceeded_total,
         )
+        if self.fleet_router is not None:
+            register_fleet_metrics(
+                self._metrics.registry,
+                router=self.fleet_router,
+                peer_cache=self._peer_cache,
+            )
 
     def _register_cache_metrics(self) -> None:
         registry = self._metrics.registry
@@ -476,7 +574,33 @@ class RemoteStorageManager:
     def _build_chunk_manager(self, backend) -> ChunkManager:
         factory = ChunkManagerFactory()
         factory.configure(self._config.raw_props())
-        return factory.init_chunk_manager(self._storage, backend)
+        wrapper = None
+        if self.fleet_router is not None:
+            config = self._config
+
+            def wrapper(default):
+                self._peer_cache = PeerChunkCache(
+                    default,
+                    self.fleet_router,
+                    forward_timeout_s=config.fleet_forward_timeout_ms / 1000.0,
+                    down_cooldown_s=config.fleet_peer_down_cooldown_ms / 1000.0,
+                    tracer=self.tracer,
+                    on_forward=self._fleet_metrics.record_forward,
+                )
+                return self._peer_cache
+
+        return factory.init_chunk_manager(self._storage, backend, wrapper)
+
+    @staticmethod
+    def _innermost_chunk_manager(cm) -> Optional[DefaultChunkManager]:
+        """Unwrap the chunk-manager decorators (ChunkCache → PeerChunkCache
+        → DefaultChunkManager; each exposes `_delegate`) down to the
+        backend-fetching manager the hedger/tracer/quarantine hooks live on."""
+        seen = 0
+        while cm is not None and not isinstance(cm, DefaultChunkManager) and seen < 8:
+            cm = getattr(cm, "_delegate", None)
+            seen += 1
+        return cm if isinstance(cm, DefaultChunkManager) else None
 
     @property
     def metrics(self) -> Metrics:
@@ -911,6 +1035,8 @@ class RemoteStorageManager:
                 )
         if self._chunk_manager is not None and hasattr(self._chunk_manager, "close"):
             self._chunk_manager.close()
+        if self._peer_cache is not None:
+            self._peer_cache.close()
         if self._manifest_cache is not None:
             self._manifest_cache.close()
         if self._indexes_cache is not None:

@@ -15,7 +15,24 @@ adopted as the request's end-to-end Deadline (falling back to the RSM's
 ``deadline.default.ms``), and every POST passes the RSM's
 AdmissionController — shedding happens BEFORE the request body is read, so
 an overloaded sidecar refuses cheaply instead of buffering segment uploads
-it will never serve.
+it will never serve. Requests carrying an ``x-tenant`` header are
+additionally subject to the controller's per-tenant fair share at
+saturation (429 when a greedy tenant exceeds its split).
+
+Fleet mode (ISSUE 6) adds two things at this boundary:
+
+- ``GET /chunk?key=<object key>&chunks=<lo>-<hi>`` — the peer-cache route:
+  a sibling instance asks the OWNER of a segment for a window of plaintext
+  chunks (framed u32 count + per-chunk u32 len|bytes). Served through the
+  owner's full chunk path (cache, then single-flight backend fetch), with
+  the caller's ``x-deadline-ms`` and ``traceparent`` honored; deliberately
+  NOT admission-gated — a client request already holds a slot while it
+  forwards, so gating the peer hop could deadlock the fleet at saturation
+  (the bounded worker pool is the backstop).
+- a bounded worker pool (``sidecar.http.max.workers``): connections are
+  handled by a fixed executor instead of one unbounded thread each, so a
+  fleet instance under fan-in keeps a bounded thread count and excess
+  connections queue instead of multiplying stacks.
 """
 
 from __future__ import annotations
@@ -25,8 +42,10 @@ import math
 import pathlib
 import tempfile
 import threading
+from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs, unquote, urlsplit
 
 from tieredstorage_tpu.errors import RemoteResourceNotFoundException
 from tieredstorage_tpu.manifest.segment_indexes import IndexType
@@ -196,8 +215,11 @@ class _Handler(BaseHTTPRequestHandler):
 
     # ------------------------------------------------------------- handlers
     def do_GET(self) -> None:
-        if self.path == "/v1/health":
+        parts = urlsplit(self.path)
+        if parts.path == "/v1/health":
             self._reply(200)
+        elif parts.path in ("/chunk", "/v1/chunk"):
+            self._peer_chunk(parts.query)
         elif self.path in ("/scrub", "/v1/scrub"):
             # Integrity-scrubber status: scheduler state, cumulative
             # counters, and the last pass summary ({"enabled": false} when
@@ -212,6 +234,40 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(200, json.dumps(status, indent=1).encode("utf-8"))
         else:
             self._reply(404, b"no such endpoint")
+
+    def _peer_chunk(self, query: str) -> None:
+        """Fleet peer-cache route: serve a window of plaintext chunks of a
+        locally-owned segment to a sibling instance (fleet/peer_cache.py).
+        The serving path pins the key local, so a forwarded request can
+        never be re-forwarded even under transient ring disagreement."""
+        serve = getattr(self.rsm, "fleet_fetch_chunks", None)
+        if serve is None or getattr(self.rsm, "fleet_router", None) is None:
+            self._reply(404, b"fleet mode disabled")
+            return
+        tracer = getattr(self.rsm, "tracer", NOOP_TRACER)
+        try:
+            params = parse_qs(query, keep_blank_values=False, strict_parsing=False)
+            key = unquote(params["key"][0])
+            window = params["chunks"][0]
+            first_s, _, last_s = window.partition("-")
+            first, last = int(first_s), int(last_s or first_s)
+        except (KeyError, IndexError, ValueError):
+            self._reply(400, b"expected ?key=<object key>&chunks=<lo>-<hi>")
+            return
+        wire_deadline = parse_deadline_ms(self.headers.get(shimwire.DEADLINE_HEADER))
+        try:
+            with deadline_scope(wire_deadline), \
+                    ensure_deadline(getattr(self.rsm, "default_deadline_s", None)), \
+                    tracer.continue_trace(
+                        self.headers.get(shimwire.TRACEPARENT_HEADER)), \
+                    tracer.span("gateway.chunk", key=key, chunks=last - first + 1):
+                chunks = serve(key, first, last)
+        except Exception as exc:  # noqa: BLE001 — boundary translation
+            self._fail(exc)
+            return
+        from tieredstorage_tpu.fleet.peer_cache import encode_chunk_frames
+
+        self._reply(200, encode_chunk_frames(chunks))
 
     def do_POST(self) -> None:
         routes = {
@@ -229,11 +285,14 @@ class _Handler(BaseHTTPRequestHandler):
         # keep-alive framing, so a shed reply also drops the connection.
         admission = getattr(self.rsm, "admission", None)
         tracer = getattr(self.rsm, "tracer", NOOP_TRACER)
+        # Optional tenant identity: engages the controller's per-tenant
+        # fair share at saturation (absent header = legacy behavior).
+        tenant = self.headers.get("x-tenant") or None
         if admission is not None:
             try:
-                admission.acquire(self.path)
+                admission.acquire(self.path, tenant=tenant)
             except AdmissionRejectedException as exc:
-                tracer.event("admission.shed", path=self.path)
+                tracer.event("admission.shed", path=self.path, tenant=tenant or "")
                 self._fail(exc)
                 self.close_connection = True
                 return
@@ -241,7 +300,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._handle_admitted(handler, tracer)
         finally:
             if admission is not None:
-                admission.release()
+                admission.release(tenant=tenant)
 
     def _handle_admitted(self, handler, tracer) -> None:
         try:
@@ -339,12 +398,57 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply(204)
 
 
+class _BoundedThreadingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer handling connections on a BOUNDED worker pool.
+
+    The stock server spawns one unbounded thread per connection, so a fleet
+    instance under fan-in (brokers + peer forwards) multiplies stacks
+    without limit. Here connections are accepted eagerly (cheap) and handed
+    to a fixed executor (`sidecar.http.max.workers`); excess connections
+    queue in the executor until a worker frees up — bounded memory, and the
+    admission controller still sheds the work itself."""
+
+    def __init__(self, server_address, handler_class, max_workers: int):
+        super().__init__(server_address, handler_class)
+        self.max_workers = max_workers
+        self._executor = ThreadPoolExecutor(
+            max_workers=max_workers, thread_name_prefix="sidecar-http"
+        )
+
+    def process_request(self, request, client_address):
+        try:
+            self._executor.submit(
+                self.process_request_thread, request, client_address
+            )
+        except RuntimeError:  # executor shut down mid-accept
+            self.shutdown_request(request)
+
+    def server_close(self):
+        try:
+            super().server_close()
+        finally:
+            self._executor.shutdown(wait=False, cancel_futures=True)
+
+
 class SidecarHttpGateway:
-    def __init__(self, rsm, *, port: int = 0, host: str = "127.0.0.1"):
+    def __init__(
+        self,
+        rsm,
+        *,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        max_workers: Optional[int] = None,
+    ):
         handler = type("BoundHandler", (_Handler,), {"rsm": rsm})
-        self._server = ThreadingHTTPServer((host, port), handler)
+        if max_workers is None:
+            max_workers = getattr(rsm, "sidecar_http_max_workers", 32)
+        self._server = _BoundedThreadingHTTPServer((host, port), handler, max_workers)
         self.port = self._server.server_address[1]
         self._thread: Optional[threading.Thread] = None
+
+    @property
+    def max_workers(self) -> int:
+        return self._server.max_workers
 
     def start(self) -> "SidecarHttpGateway":
         self._thread = threading.Thread(

@@ -255,6 +255,14 @@ def main(argv: Optional[list[str]] = None) -> None:
              "this port; 0 picks a free port.",
     )
     parser.add_argument(
+        "--fleet-peers", default=None, metavar="NAME=URL,...",
+        help="Fleet membership override applied after configure(): "
+             "comma-separated 'name=http://host:port' entries (bare 'name' "
+             "for address-less members). Replaces fleet.instances — for "
+             "deployments whose gateway ports are only known at launch. "
+             "Requires fleet.enabled in the config.",
+    )
+    parser.add_argument(
         "--virtual-cpu-devices", type=int, default=None, metavar="N",
         help="Pin JAX to the host platform with N virtual CPU devices before "
              "serving (host-only deployments / environments where the "
@@ -271,6 +279,10 @@ def main(argv: Optional[list[str]] = None) -> None:
 
     rsm = RemoteStorageManager()
     rsm.configure(json.loads(pathlib.Path(args.config).read_text()))
+    if args.fleet_peers:
+        from tieredstorage_tpu.fleet import parse_instances
+
+        rsm.set_fleet_peers(parse_instances(args.fleet_peers.split(",")))
     exporter = None
     if args.metrics_port is not None:
         from tieredstorage_tpu.metrics.prometheus import PrometheusExporter

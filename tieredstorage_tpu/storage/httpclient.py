@@ -5,10 +5,20 @@ google-cloud-storage's HttpTransport, azure-core's HttpPipeline — see
 storage/s3/.../S3ClientBuilder.java, storage/gcs/.../GcsStorage.java:41-88,
 storage/azure/.../AzureBlobStorage.java:48-99). This build speaks the three
 REST protocols directly over the standard library so the backends carry zero
-SDK dependencies; this module is the shared transport: per-thread connection
-reuse, timeouts, an observer hook (the analogue of the reference's
+SDK dependencies; this module is the shared transport: a bounded keep-alive
+connection pool, timeouts, an observer hook (the analogue of the reference's
 MetricCollector pipeline taps), and a socket factory hook used for SOCKS5
 proxying (storage/core/.../proxy/).
+
+Connection management (the fleet-mode enabling refactor, ISSUE 6): each
+client holds ONE bounded pool of keep-alive connections to its host —
+``max_connections`` in-flight requests at most, idle connections reused by
+whichever thread asks next, callers past the bound waiting (deadline-clamped)
+for a slot instead of minting sockets. The previous design pinned one
+connection per THREAD, so concurrency was only reachable by thread count and
+every new worker paid a TCP/TLS handshake; with the pool, a process holds
+thousands of logical in-flight fetches over a fixed socket budget, and
+streamed bodies return their connection for reuse once fully drained.
 
 Retry ownership is split the same way the reference splits it: the
 transport retries only replay-safe requests (ranged GETs, HEAD, deletes,
@@ -128,11 +138,23 @@ class HttpResponse:
 
 
 class _StreamedBody(io.RawIOBase):
-    """Wraps an http.client response; closing closes the dedicated connection."""
+    """Wraps an http.client response; the stream owns a pooled connection.
 
-    def __init__(self, resp: http.client.HTTPResponse, conn: http.client.HTTPConnection):
+    Closing returns the connection to the pool — for keep-alive REUSE when
+    the body was fully drained (the overwhelmingly common case: ranged chunk
+    GETs are read to completion), or closed and its slot freed when the
+    caller abandoned the body mid-stream (the framing is desynced, the
+    socket is useless)."""
+
+    def __init__(
+        self,
+        resp: http.client.HTTPResponse,
+        conn: http.client.HTTPConnection,
+        pool: Optional["_ConnectionPool"] = None,
+    ):
         self._resp = resp
         self._conn = conn
+        self._pool = pool
 
     def readable(self) -> bool:
         return True
@@ -147,14 +169,25 @@ class _StreamedBody(io.RawIOBase):
         return self._resp.read(None if size is None or size < 0 else size)
 
     def close(self) -> None:
-        if not self.closed:
+        if self.closed:
+            return
+        try:
+            try:
+                drained = bool(self._resp.isclosed())
+            except Exception:  # fakes/tests without isclosed
+                drained = False
             try:
                 self._resp.close()
             finally:
-                try:
+                if self._pool is None:
                     self._conn.close()
-                finally:
-                    super().close()
+                elif drained:
+                    self._conn._tstpu_used = True
+                    self._pool.release(self._conn)
+                else:
+                    self._pool.discard(self._conn)
+        finally:
+            super().close()
 
 
 # Observer signature: (method, url_path, status, elapsed_seconds, error) -> None
@@ -191,8 +224,110 @@ class _SecureConnection(http.client.HTTPSConnection):
             self.sock = self._context.wrap_socket(raw, server_hostname=self.host)
 
 
+class _ConnectionPool:
+    """Bounded pool of keep-alive connections to one host.
+
+    Invariant: in-flight + idle connections never exceed `max_connections`.
+    acquire() prefers an idle keep-alive connection, creates a new one while
+    under the bound, and otherwise blocks (bounded by the caller's timeout)
+    until release()/discard() frees a slot — so concurrency is a fixed
+    socket budget, not a per-thread property."""
+
+    def __init__(self, factory: Callable[[], http.client.HTTPConnection],
+                 max_connections: int) -> None:
+        if max_connections < 1:
+            raise ValueError(f"max_connections must be >= 1, got {max_connections}")
+        self._factory = factory
+        self.max_connections = max_connections
+        self._cond = threading.Condition()
+        self._idle: list[http.client.HTTPConnection] = []
+        self._in_use = 0
+        #: Lifetime counters (pool health introspection).
+        self.created_total = 0
+        self.waited_total = 0
+        self.exhausted_total = 0
+
+    @property
+    def in_use(self) -> int:
+        with self._cond:
+            return self._in_use
+
+    @property
+    def idle(self) -> int:
+        with self._cond:
+            return len(self._idle)
+
+    def acquire(self, timeout_s: Optional[float] = None, *, fresh: bool = False):
+        """An idle connection, a new one (under the bound), or a bounded
+        wait. `fresh=True` skips idle reuse where possible — the
+        stale-keepalive replay path must not retry onto another possibly
+        stale idle socket (an idle one is closed to keep the bound)."""
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        create = False
+        with self._cond:
+            while True:
+                if self._idle and not fresh:
+                    conn = self._idle.pop()
+                    self._in_use += 1
+                    return conn
+                if self._in_use + len(self._idle) < self.max_connections:
+                    self._in_use += 1
+                    create = True
+                    break
+                if fresh and self._idle:
+                    # Under the fresh policy, trade an idle (possibly stale)
+                    # socket for a new one rather than waiting.
+                    self._idle.pop().close()
+                    continue
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    self.exhausted_total += 1
+                    raise HttpError(
+                        f"connection pool exhausted ({self.max_connections} "
+                        f"in flight); no slot within {timeout_s:.1f}s"
+                    )
+                self.waited_total += 1
+                self._cond.wait(remaining)
+        if create:
+            try:
+                conn = self._factory()
+            except BaseException:
+                with self._cond:
+                    self._in_use -= 1
+                    self._cond.notify()
+                raise
+            with self._cond:
+                self.created_total += 1
+            return conn
+
+    def release(self, conn) -> None:
+        """Return a healthy connection for keep-alive reuse."""
+        with self._cond:
+            self._in_use -= 1
+            self._idle.append(conn)
+            self._cond.notify()
+
+    def discard(self, conn) -> None:
+        """Close a broken/desynced connection and free its slot."""
+        try:
+            conn.close()
+        finally:
+            with self._cond:
+                self._in_use -= 1
+                self._cond.notify()
+
+    def close(self) -> None:
+        with self._cond:
+            idle, self._idle = self._idle, []
+        for conn in idle:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
 class HttpClient:
-    """Per-thread keep-alive connections to a single base URL."""
+    """Bounded pooled keep-alive connections to a single base URL."""
 
     def __init__(
         self,
@@ -203,10 +338,13 @@ class HttpClient:
         socket_factory: Optional[SocketFactory] = None,
         observer: Optional[Observer] = None,
         retry: Optional[RetryPolicy] = None,
+        max_connections: int = 32,
+        pool_wait_timeout_s: float = 30.0,
     ) -> None:
         parts = urlsplit(base_url)
         if parts.scheme not in ("http", "https"):
             raise ValueError(f"Unsupported scheme in {base_url!r}")
+        self.base_url = base_url
         self.scheme = parts.scheme
         self.host = parts.hostname or ""
         self.port = parts.port or (443 if self.scheme == "https" else 80)
@@ -218,7 +356,12 @@ class HttpClient:
         self.socket_factory = socket_factory
         self.observer = observer
         self.retry = retry if retry is not None else RetryPolicy()
-        self._local = threading.local()
+        self.pool_wait_timeout_s = pool_wait_timeout_s
+        # Late-bound factory: tests monkeypatch `_new_connection` per
+        # instance after construction, and the pool must see the override.
+        self._pool = _ConnectionPool(
+            lambda: self._new_connection(), max_connections
+        )
         if self.scheme == "https":
             self._ssl_context = ssl.create_default_context()
             if not verify_tls:
@@ -235,21 +378,17 @@ class HttpClient:
             )
         return _Connection(self.host, self.port, self.timeout, self.socket_factory)
 
-    def _pooled(self) -> http.client.HTTPConnection:
-        conn = getattr(self._local, "conn", None)
-        if conn is None:
-            conn = self._new_connection()
-            self._local.conn = conn
-            self._local.conn_used = False
-        return conn
+    @property
+    def pool(self) -> _ConnectionPool:
+        return self._pool
 
-    def _drop_pooled(self) -> None:
-        conn = getattr(self._local, "conn", None)
-        if conn is not None:
-            try:
-                conn.close()
-            finally:
-                self._local.conn = None
+    def _acquire_timeout(self, budget: Optional[float]) -> Optional[float]:
+        """Longest a request may wait for a pool slot: the configured pool
+        wait, clamped to the remaining call budget."""
+        candidates = [self.pool_wait_timeout_s]
+        if budget is not None:
+            candidates.append(max(0.001, budget))
+        return min(candidates)
 
     # -------------------------------------------------------------- requests
     def request(
@@ -325,15 +464,21 @@ class HttpClient:
         try:
             if budget is not None and budget <= 0:
                 raise TimeoutError("api call deadline exceeded before attempt")
-            resp = self._roundtrip(
+            resp, conn = self._roundtrip(
                 method, path_and_query, headers, body, idempotent, budget=budget
             )
             status = resp.status
-            data = resp.read()
+            try:
+                data = resp.read()
+            except (OSError, http.client.HTTPException):
+                self._pool.discard(conn)
+                raise
+            # Body fully drained: the keep-alive connection goes back to the
+            # pool for the next request on any thread.
+            self._pool.release(conn)
             return HttpResponse(status, dict(resp.getheaders()), data)
         except (OSError, http.client.HTTPException) as e:
             err = e
-            self._drop_pooled()
             raise HttpError(f"{method} {path_and_query} failed: {e}") from e
         finally:
             if self.observer is not None:
@@ -386,20 +531,20 @@ class HttpClient:
         self, method, path_and_query, headers, budget=None
     ) -> tuple[int, Mapping[str, str], BinaryIO]:
         t0 = time.perf_counter()
-        conn = self._new_connection()
+        conn = self._pool.acquire(self._acquire_timeout(budget))
         self._apply_timeout(conn, budget)
         try:
             conn.request(method, path_and_query, body=None, headers=dict(headers or {}))
             resp = conn.getresponse()
         except (OSError, http.client.HTTPException) as e:
-            conn.close()
+            self._pool.discard(conn)
             if self.observer is not None:
                 self.observer(method, path_and_query, 0, time.perf_counter() - t0, e)
             raise HttpError(f"{method} {path_and_query} failed: {e}") from e
         if self.observer is not None:
             self.observer(method, path_and_query, resp.status, time.perf_counter() - t0, None)
         hdrs = {k.lower(): v for k, v in resp.getheaders()}
-        return resp.status, hdrs, _StreamedBody(resp, conn)
+        return resp.status, hdrs, _StreamedBody(resp, conn, self._pool)
 
     _IDEMPOTENT = frozenset({"GET", "HEAD", "PUT", "DELETE"})
 
@@ -442,17 +587,19 @@ class HttpClient:
 
     def _roundtrip(
         self, method, path_and_query, headers, body, idempotent=None, budget=None
-    ) -> http.client.HTTPResponse:
-        conn = self._pooled()
-        self._apply_timeout(conn, budget)
-        reused = getattr(self._local, "conn_used", False)
+    ) -> tuple[http.client.HTTPResponse, http.client.HTTPConnection]:
+        """One exchange on a pooled connection; returns (response, conn) —
+        the caller reads the body and releases/discards the connection."""
+        conn = self._pool.acquire(self._acquire_timeout(budget))
+        reused = getattr(conn, "_tstpu_used", False)
         sent = False
         try:
+            self._apply_timeout(conn, budget)
             conn.request(method, path_and_query, body=body, headers=dict(headers or {}))
             sent = True
             resp = conn.getresponse()
         except (OSError, http.client.HTTPException):
-            self._drop_pooled()
+            self._pool.discard(conn)
             # Retry once ONLY when replay is safe: the first attempt must
             # have been on a reused keep-alive connection (a fresh-connection
             # failure isn't a stale-socket artifact), and for non-idempotent
@@ -465,12 +612,18 @@ class HttpClient:
             )
             if not reused or (sent and not replay_safe):
                 raise
-            conn = self._pooled()
-            self._apply_timeout(conn, budget)
-            conn.request(method, path_and_query, body=body, headers=dict(headers or {}))
-            resp = conn.getresponse()
-        self._local.conn_used = True
-        return resp
+            # The replay must not land on ANOTHER possibly-stale idle
+            # socket: acquire fresh.
+            conn = self._pool.acquire(self._acquire_timeout(budget), fresh=True)
+            try:
+                self._apply_timeout(conn, budget)
+                conn.request(method, path_and_query, body=body, headers=dict(headers or {}))
+                resp = conn.getresponse()
+            except (OSError, http.client.HTTPException):
+                self._pool.discard(conn)
+                raise
+        conn._tstpu_used = True
+        return resp, conn
 
     def close(self) -> None:
-        self._drop_pooled()
+        self._pool.close()

@@ -1,29 +1,54 @@
-"""Characterize the axon tunnel: dispatch overhead, h2d/d2h bandwidth vs size."""
+"""Relay-transfer characterization: one parameterized probe, three stages.
+
+Consolidates the former profile_transfer.py / profile_transfer2.py /
+profile_transfer3.py measurement series behind PROFILE.md's host↔device
+table (each stage corresponds to the rows of evidence cited there):
+
+- ``basic``    (was profile_transfer.py)  — dispatch overhead, h2d/d2h
+  bandwidth vs size, overlapped/2-D puts;
+- ``cliff``    (was profile_transfer2.py, the r2 variant) — the h2d size
+  cliff, chunked-put reassembly, real d2h cost, the per-launch floor, and
+  back-to-back async launches;
+- ``parallel`` (was profile_transfer3.py, the r3 variant) — d2h
+  parallel-stream scaling, upload-only (compute-consumed) cost, small-size
+  d2h, and copy_to_host_async.
+
+Run ``python tools/profile_transfer.py --stage all`` on a live relay; each
+stage prints to stderr as it measures, so a relay drop mid-run keeps the
+numbers already taken.
+"""
 
 from __future__ import annotations
 
+import argparse
 import sys
 import time
+from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
-err = lambda *a: print(*a, file=sys.stderr, flush=True)
+err = lambda *a: print(*a, file=sys.stderr, flush=True)  # noqa: E731
 
 
-def t(fn, iters=3, warmup=1):
+def t(fn, iters=3, warmup=1, block=True):
     for _ in range(warmup):
-        jax.block_until_ready(fn())
+        out = fn()
+        if block:
+            jax.block_until_ready(out)
     best = float("inf")
     for _ in range(iters):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn())
+        out = fn()
+        if block:
+            jax.block_until_ready(out)
         best = min(best, time.perf_counter() - t0)
     return best
 
 
-def main():
+def stage_basic() -> None:
+    """Dispatch overhead + h2d/d2h bandwidth vs size (PROFILE.md row 1)."""
     err(f"devices={jax.devices()}")
     tiny = jnp.zeros((8, 128), jnp.uint8)
     inc = jax.jit(lambda x: x ^ 1)
@@ -35,24 +60,170 @@ def main():
         dt = t(lambda: jax.device_put(a))
         err(f"h2d {mib:3d} MiB: {dt*1e3:8.1f} ms  {mib/1024/dt:7.3f} GiB/s")
         d = jax.device_put(a)
-        dt = t(lambda: np.asarray(d))
+        dt = t(lambda: np.asarray(d), block=False)
         err(f"d2h {mib:3d} MiB: {dt*1e3:8.1f} ms  {mib/1024/dt:7.3f} GiB/s")
         big_xor = jax.jit(lambda x: x ^ np.uint8(255))
         dt = t(lambda: big_xor(d))
         err(f"dev xor {mib:3d} MiB (no transfer): {dt*1e3:8.1f} ms  {mib/1024/dt:7.3f} GiB/s")
 
-    # parallel h2d: do 8 x 8MiB puts at once, then block
+    # parallel h2d: 8 x 8MiB puts at once, then block
     a = [rng.integers(0, 256, 8 << 20, dtype=np.uint8) for _ in range(8)]
-    def par_put():
-        ds = [jax.device_put(x) for x in a]
-        return ds
-    dt = t(par_put)
+    dt = t(lambda: [jax.device_put(x) for x in a])
     err(f"h2d 8x8 MiB overlapped: {dt*1e3:8.1f} ms  {64/1024/dt:7.3f} GiB/s")
 
-    # pinned layout? try jnp.asarray on 2D
     b = rng.integers(0, 256, (16, 4 << 20), dtype=np.uint8)
     dt = t(lambda: jax.device_put(b))
     err(f"h2d 64 MiB 2D: {dt*1e3:8.1f} ms  {64/1024/dt:7.3f} GiB/s")
+
+
+def stage_cliff() -> None:
+    """h2d size cliff, real d2h cost, per-launch floor (the r2 variant)."""
+    rng = np.random.default_rng(0)
+    err("--- h2d size sweep ---")
+    for kib in (256, 512, 1024, 1536, 2048, 2560, 3072, 4096, 8192):
+        a = rng.integers(0, 256, kib << 10, dtype=np.uint8)
+        dt = t(lambda: jax.device_put(a))
+        err(f"h2d {kib:6d} KiB: {dt*1e3:9.2f} ms  {kib/1024/1024/dt:8.3f} GiB/s")
+
+    err("--- h2d chunked: 64 MiB as N puts of S, then concat on device ---")
+    total = 64 << 20
+    for s_kib in (1024, 2048):
+        s = s_kib << 10
+        n = total // s
+        parts = [rng.integers(0, 256, s, dtype=np.uint8) for _ in range(n)]
+        cat = jax.jit(lambda *xs: jnp.concatenate(xs))
+
+        def chunked():
+            return cat(*[jax.device_put(p) for p in parts])
+
+        dt = t(chunked, iters=2, warmup=1)
+        err(f"chunked {s_kib} KiB x{n}: {dt*1e3:9.1f} ms  {total/(1<<30)/dt:8.3f} GiB/s")
+
+        def chunked_nocat():
+            ds = [jax.device_put(p) for p in parts]
+            for d in ds:
+                d.block_until_ready()
+            return ds[0]
+
+        dt = t(chunked_nocat, iters=2, warmup=1)
+        err(f"chunked {s_kib} KiB x{n} (no concat): {dt*1e3:9.1f} ms  {total/(1<<30)/dt:8.3f} GiB/s")
+
+    err("--- real d2h: fresh output each call ---")
+    f = jax.jit(lambda x, s: x ^ s)
+    for mib in (1, 16, 64):
+        a = jax.device_put(rng.integers(0, 256, mib << 20, dtype=np.uint8))
+        seed = jax.device_put(np.uint8(7))
+
+        def fresh_fetch():
+            return np.asarray(f(a, seed))  # fresh array, never fetched
+
+        dt = t(fresh_fetch, iters=3, warmup=1, block=False)
+        dt_nofetch = t(lambda: f(a, seed), iters=3, warmup=1)
+        err(
+            f"d2h {mib:3d} MiB: total {dt*1e3:8.1f} ms, launch-only "
+            f"{dt_nofetch*1e3:8.1f} ms, fetch {max(dt-dt_nofetch,1e-9)*1e3:8.1f} ms  "
+            f"{mib/1024/max(dt-dt_nofetch,1e-9):8.3f} GiB/s"
+        )
+
+    err("--- launch floor vs output size (input 64 MiB resident) ---")
+    a = jax.device_put(rng.integers(0, 256, 64 << 20, dtype=np.uint8))
+    for out_mib, slc in ((64, 64 << 20), (16, 16 << 20), (1, 1 << 20)):
+        g = jax.jit(lambda x, s=slc: x[:s] ^ np.uint8(3))
+        dt = t(lambda: g(a), iters=5, warmup=2)
+        err(f"xor out={out_mib:3d} MiB: {dt*1e3:8.2f} ms")
+    h = jax.jit(lambda x: jnp.sum(x, dtype=jnp.int32))
+    dt = t(lambda: h(a), iters=5, warmup=2)
+    err(f"sum out=4B: {dt*1e3:8.2f} ms")
+
+    err("--- back-to-back async launches (8 xors then block) ---")
+    g = jax.jit(lambda x: x ^ np.uint8(3))
+
+    def burst():
+        outs = [g(a) for _ in range(8)]
+        for o in outs:
+            o.block_until_ready()
+
+    dt = t(burst, iters=3, warmup=1, block=False)
+    err(f"8 async xors (64 MiB): {dt*1e3:8.2f} ms total, {dt/8*1e3:8.2f} ms/launch")
+
+
+def stage_parallel() -> None:
+    """d2h parallel-stream scaling + upload-only cost (the r3 variant)."""
+    rng = np.random.default_rng(0)
+    f = jax.jit(lambda x, s: x ^ s)
+
+    err("--- upload-only: device_put 64MiB + xor + fetch 4-byte sum ---")
+    a_host = rng.integers(0, 256, 64 << 20, dtype=np.uint8)
+    g = jax.jit(lambda x, s: jnp.sum(x ^ s, dtype=jnp.uint32))
+    seed = np.uint8(7)
+
+    def up_only():
+        return int(g(jax.device_put(a_host), seed))
+
+    dt = t(up_only, iters=3, warmup=1, block=False)
+    err(f"upload+compute+tiny-fetch 64 MiB: {dt*1e3:8.1f} ms  {64/1024/dt:7.3f} GiB/s")
+
+    err("--- d2h parallel: 8 disjoint 8MiB outputs, N threads ---")
+    parts = [jax.device_put(rng.integers(0, 256, 8 << 20, dtype=np.uint8)) for _ in range(8)]
+    for p in parts:
+        p.block_until_ready()
+    counter = [0]
+
+    def fetch_all(nthreads):
+        counter[0] += 1
+        s = np.uint8(counter[0] & 0xFF)  # fresh outputs each call (defeat _value cache)
+        outs = [f(p, s) for p in parts]
+        if nthreads == 1:
+            for o in outs:
+                np.asarray(o)
+        else:
+            with ThreadPoolExecutor(nthreads) as ex:
+                list(ex.map(np.asarray, outs))
+
+    for n in (1, 2, 4, 8):
+        dt = t(lambda: fetch_all(n), iters=2, warmup=1, block=False)
+        err(f"fetch 64 MiB via 8x8MiB, {n} threads: {dt*1e3:8.1f} ms  {64/1024/dt:7.3f} GiB/s")
+
+    err("--- d2h small sizes (fresh each) ---")
+    base = jax.device_put(rng.integers(0, 256, 4 << 20, dtype=np.uint8))
+    for kib in (64, 256, 1024, 4096):
+        sl = jax.jit(lambda x, s, k=kib: (x[: k << 10] ^ s))
+
+        def fetch_one():
+            counter[0] += 1
+            return np.asarray(sl(base, np.uint8(counter[0] & 0xFF)))
+
+        dt = t(fetch_one, iters=3, warmup=1, block=False)
+        err(f"d2h {kib:5d} KiB: {dt*1e3:8.2f} ms  {kib/1024/1024/dt:7.3f} GiB/s")
+
+    err("--- jax.copy_to_host_async then asarray ---")
+
+    def fetch_async():
+        counter[0] += 1
+        s = np.uint8(counter[0] & 0xFF)
+        outs = [f(p, s) for p in parts]
+        for o in outs:
+            o.copy_to_host_async()
+        return [np.asarray(o) for o in outs]
+
+    dt = t(fetch_async, iters=2, warmup=1, block=False)
+    err(f"fetch 64 MiB copy_to_host_async: {dt*1e3:8.1f} ms  {64/1024/dt:7.3f} GiB/s")
+
+
+STAGES = {"basic": stage_basic, "cliff": stage_cliff, "parallel": stage_parallel}
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--stage", choices=[*STAGES, "all"], default="all",
+        help="basic = original sweep; cliff = the r2 variant (size cliff / "
+             "launch floor); parallel = the r3 variant (d2h stream scaling).",
+    )
+    args = parser.parse_args()
+    for name in (STAGES if args.stage == "all" else [args.stage]):
+        err(f"=== stage {name} ===")
+        STAGES[name]()
 
 
 if __name__ == "__main__":
