@@ -1,7 +1,7 @@
 # Developer entry points (counterpart of /root/reference/Makefile).
 PYTHON ?= python
 
-.PHONY: test test-e2e chaos bench demo trace-demo scrub-demo tail-demo failover-demo fleet-demo docs docker lint mutation clean
+.PHONY: test test-e2e chaos bench demo trace-demo scrub-demo tail-demo failover-demo fleet-demo docs docker lint analyze mutation clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q --ignore=tests/e2e
@@ -11,9 +11,12 @@ test-e2e:
 
 # Fault-injection / resilience suite, including the slow soak variants.
 # Schedules are seeded (fault.seed / FaultSchedule(seed=...)), so runs are
-# deterministic and reproducible.
+# deterministic and reproducible. TSTPU_LOCK_WITNESS=1 arms the runtime
+# LockWitness (utils/locks.py): every lock acquisition order observed under
+# chaos must stay a DAG, validating the static lock-order proof against real
+# executions (conftest fails the session on any recorded violation).
 chaos:
-	$(PYTHON) -m pytest tests/ -q -m chaos
+	TSTPU_LOCK_WITNESS=1 $(PYTHON) -m pytest tests/ -q -m chaos
 
 bench:
 	$(PYTHON) bench.py
@@ -65,8 +68,11 @@ failover-demo:
 # diffs across all responses; and a greedy tenant saturating the admission
 # gate is shed 429 while a polite tenant is served. Writes and re-validates
 # artifacts/fleet_report.json.
+# LockWitness armed: 3 instances' worth of gateways, caches, pools, and
+# single-flight slots hammering each other is the richest lock interleaving
+# any suite produces; the demo asserts zero order violations at the end.
 fleet-demo:
-	$(PYTHON) tools/fleet_demo.py --out artifacts/fleet_report.json
+	TSTPU_LOCK_WITNESS=1 $(PYTHON) tools/fleet_demo.py --out artifacts/fleet_report.json
 
 docs:
 	$(PYTHON) -m tieredstorage_tpu.docs.configs_docs > docs/configs.rst
@@ -75,14 +81,23 @@ docs:
 docker:
 	docker build -t tieredstorage-tpu -f docker/Dockerfile .
 
-lint:
+# Project-invariant static analysis (tieredstorage_tpu/analysis/): lock-order
+# DAG + blocking-under-lock, Deadline discipline, bounded concurrency,
+# monotonic clock, swallowed exceptions, config/metrics doc drift. Exits
+# non-zero on any unsuppressed finding or stale suppression
+# (tools/analysis_suppressions.txt is a burn-down list, not a grandfather
+# clause). The JSON artifact is uploaded by CI next to the demo reports.
+analyze:
+	$(PYTHON) -m tieredstorage_tpu.analysis --json artifacts/analysis_report.json
+
+lint: analyze
 	$(PYTHON) -m compileall -q tieredstorage_tpu tests tools bench.py
 
 # Mutation testing (counterpart of the reference's pitest gate,
 # /root/reference/build.gradle:24): flips operators in core pure-logic
 # modules and requires the owning suites to notice.
 mutation:
-	$(PYTHON) tools/mutation_test.py --budget 40
+	$(PYTHON) tools/mutation_test.py --budget 48
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} +

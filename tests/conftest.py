@@ -50,6 +50,30 @@ def pytest_runtest_makereport(item, call):
             )
 
 
+def pytest_sessionfinish(session, exitstatus):
+    """LockWitness gate (`make chaos` runs with TSTPU_LOCK_WITNESS=1): any
+    lock-acquisition-order violation observed during the whole session —
+    including inside daemons and pool threads no single test asserts on —
+    fails the run, validating the static lock-order checker's DAG against
+    real executions."""
+    from tieredstorage_tpu.utils.locks import witness, witness_enabled
+
+    if not witness_enabled():
+        return
+    violations = witness().violations
+    if violations:
+        print("\nLockWitness: lock-order violations observed:", flush=True)
+        for v in violations:
+            print(f"  {v}", flush=True)
+        session.exitstatus = 1
+    else:
+        print(
+            f"\nLockWitness: DAG held ({len(witness().edges())} distinct "
+            "acquisition-order edges observed, 0 violations)",
+            flush=True,
+        )
+
+
 @pytest.fixture
 def tmp_storage_root(tmp_path):
     root = tmp_path / "storage-root"

@@ -1,0 +1,647 @@
+"""Static-analysis framework tests (ISSUE 7): positive/negative fixtures per
+checker, suppression round-trip, JSON report schema, and the run-on-repo
+smoke gate (the tree must analyze clean against its own suppression file).
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import textwrap
+
+import pytest
+
+from tieredstorage_tpu.analysis import lockorder
+from tieredstorage_tpu.analysis.core import (
+    Suppressions,
+    SuppressionError,
+    load_project,
+    run_analysis,
+)
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+def make_project(tmp_path, files: dict[str, str]):
+    """Write fixture sources under a fake tieredstorage_tpu/ tree."""
+    for rel, src in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+    return load_project(tmp_path, sorted(files))
+
+
+def analyze(tmp_path, files, *, only):
+    return run_analysis(make_project(tmp_path, files), only=only)
+
+
+def fingerprints(report):
+    return [f.fingerprint for f in report.findings]
+
+
+# ---------------------------------------------------------- monotonic-clock
+class TestMonotonicClock:
+    def test_time_time_flagged(self, tmp_path):
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/mod.py": """
+                import time
+
+                def elapsed(start):
+                    return time.time() - start
+            """,
+        }, only=["monotonic-clock"])
+        assert len(report.findings) == 1
+        f = report.findings[0]
+        assert f.detail == "time.time"
+        assert f.qualname == "elapsed"
+        assert f.line == 5  # fixtures keep their leading blank line
+
+    def test_monotonic_not_flagged(self, tmp_path):
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/mod.py": """
+                import time
+
+                def elapsed(start):
+                    return time.monotonic() - start
+            """,
+        }, only=["monotonic-clock"])
+        assert report.findings == []
+
+    def test_fingerprint_is_line_independent(self, tmp_path):
+        src = """
+            import time
+
+            def f():
+                return time.time()
+        """
+        a = analyze(tmp_path / "a", {"tieredstorage_tpu/mod.py": src},
+                    only=["monotonic-clock"])
+        b = analyze(tmp_path / "b", {"tieredstorage_tpu/mod.py": "\n\n\n" + textwrap.dedent(src)},
+                    only=["monotonic-clock"])
+        assert fingerprints(a) == fingerprints(b)
+        assert a.findings[0].line != b.findings[0].line
+
+
+# ------------------------------------------------------- swallowed-exception
+class TestSwallowedException:
+    def test_broad_pass_flagged(self, tmp_path):
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/mod.py": """
+                def f():
+                    try:
+                        risky()
+                    except Exception:
+                        pass
+            """,
+        }, only=["swallowed-exception"])
+        assert [f.detail for f in report.findings] == ["swallow:Exception"]
+
+    def test_bare_except_and_continue_flagged(self, tmp_path):
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/mod.py": """
+                def f(items):
+                    for item in items:
+                        try:
+                            risky(item)
+                        except:
+                            continue
+            """,
+        }, only=["swallowed-exception"])
+        assert [f.detail for f in report.findings] == ["swallow:<bare>"]
+
+    def test_narrow_catch_not_flagged(self, tmp_path):
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/mod.py": """
+                def f():
+                    try:
+                        risky()
+                    except (KeyError, OSError):
+                        pass
+            """,
+        }, only=["swallowed-exception"])
+        assert report.findings == []
+
+    def test_counter_bump_not_flagged(self, tmp_path):
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/mod.py": """
+                class C:
+                    failures = 0
+
+                    def f(self):
+                        try:
+                            risky()
+                        except Exception:
+                            self.failures += 1
+            """,
+        }, only=["swallowed-exception"])
+        assert report.findings == []
+
+
+# ------------------------------------------------------ bounded-concurrency
+class TestBoundedConcurrency:
+    def test_unsanctioned_thread_flagged(self, tmp_path):
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/mod.py": """
+                import threading
+
+                def spawn(fn):
+                    threading.Thread(target=fn, daemon=True).start()
+            """,
+        }, only=["bounded-concurrency"])
+        assert [f.detail for f in report.findings] == ["unsanctioned-thread"]
+
+    def test_sanctioned_daemon_allowed(self, tmp_path):
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/metrics/prometheus.py": """
+                import threading
+
+                class PrometheusExporter:
+                    def __init__(self):
+                        self._thread = threading.Thread(
+                            target=self._run, daemon=True
+                        )
+            """,
+        }, only=["bounded-concurrency"])
+        assert report.findings == []
+
+    def test_sanctioned_daemon_without_daemon_flag_flagged(self, tmp_path):
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/metrics/prometheus.py": """
+                import threading
+
+                class PrometheusExporter:
+                    def __init__(self):
+                        self._thread = threading.Thread(target=self._run)
+            """,
+        }, only=["bounded-concurrency"])
+        assert [f.detail for f in report.findings] == ["thread-not-daemon"]
+
+    def test_unbounded_executor_flagged(self, tmp_path):
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/mod.py": """
+                from concurrent.futures import ThreadPoolExecutor
+
+                def make_pool():
+                    return ThreadPoolExecutor()
+            """,
+        }, only=["bounded-concurrency"])
+        assert [f.detail for f in report.findings] == ["unbounded-executor"]
+
+    def test_bounded_executor_allowed(self, tmp_path):
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/mod.py": """
+                from concurrent.futures import ThreadPoolExecutor
+
+                def make_pool():
+                    return ThreadPoolExecutor(max_workers=4)
+            """,
+        }, only=["bounded-concurrency"])
+        assert report.findings == []
+
+
+# ------------------------------------------------------------------ deadline
+class TestDeadlineDiscipline:
+    def test_unbounded_wait_in_request_path_flagged(self, tmp_path):
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/storage/mod.py": """
+                def fetch(future):
+                    return future.result()
+            """,
+        }, only=["deadline"])
+        assert [f.detail for f in report.findings] == ["unbounded:result@future"]
+
+    def test_constant_timeout_flagged_as_unclamped(self, tmp_path):
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/fleet/mod.py": """
+                def fetch(event):
+                    return event.wait(5.0)
+            """,
+        }, only=["deadline"])
+        assert [f.detail for f in report.findings] == ["unclamped:wait@event"]
+
+    def test_deadline_clamped_wait_allowed(self, tmp_path):
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/fetch/mod.py": """
+                def fetch(future, deadline):
+                    return future.result(max(0.0, deadline.remaining_s()))
+
+                def wait_for(cond, budget):
+                    cond.wait(timeout=budget)
+            """,
+        }, only=["deadline"])
+        assert report.findings == []
+
+    def test_outside_request_path_not_flagged(self, tmp_path):
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/scrub/mod.py": """
+                def fetch(future):
+                    return future.result()
+            """,
+        }, only=["deadline"])
+        assert report.findings == []
+
+    def test_daemon_loop_exempt(self, tmp_path):
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/storage/replicated.py": """
+                class HealthProber:
+                    def _run(self):
+                        while not self._stop.wait(self.interval_s):
+                            self.probe_once()
+            """,
+        }, only=["deadline"])
+        assert report.findings == []
+
+
+# ---------------------------------------------------------------- lock-order
+LOCK_CYCLE_FIXTURE = {
+    "tieredstorage_tpu/mod_a.py": """
+        import threading
+
+        from tieredstorage_tpu.mod_b import B
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._b = B()
+
+            def outer(self):
+                with self._lock:
+                    self._b.locked_op()
+
+            def leaf(self):
+                with self._lock:
+                    pass
+    """,
+    "tieredstorage_tpu/mod_b.py": """
+        import threading
+
+        from tieredstorage_tpu import mod_a
+
+        class B:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._a = mod_a.A()
+
+            def locked_op(self):
+                with self._lock:
+                    pass
+
+            def reverse(self):
+                with self._lock:
+                    self._a.leaf()
+    """,
+}
+
+
+class TestLockOrder:
+    def test_cycle_detected_across_modules(self, tmp_path):
+        report = analyze(tmp_path, LOCK_CYCLE_FIXTURE, only=["lock-order"])
+        cycles = [f for f in report.findings if f.detail.startswith("cycle:")]
+        assert len(cycles) == 1
+        assert "mod_a.py:A._lock" in cycles[0].detail
+        assert "mod_b.py:B._lock" in cycles[0].detail
+
+    def test_one_direction_is_no_cycle(self, tmp_path):
+        files = dict(LOCK_CYCLE_FIXTURE)
+        files["tieredstorage_tpu/mod_b.py"] = files[
+            "tieredstorage_tpu/mod_b.py"
+        ].replace("self._a.leaf()", "pass")
+        report = analyze(tmp_path, files, only=["lock-order"])
+        assert [f for f in report.findings if f.detail.startswith("cycle:")] == []
+
+    def test_blocking_call_under_lock_flagged(self, tmp_path):
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/mod.py": """
+                import threading
+                import time
+
+                class C:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def slow(self):
+                        with self._lock:
+                            time.sleep(1.0)
+            """,
+        }, only=["lock-order"])
+        assert [f.detail for f in report.findings] == [
+            "blocking:time.sleep@C._lock"
+        ]
+
+    def test_blocking_outside_lock_not_flagged(self, tmp_path):
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/mod.py": """
+                import threading
+                import time
+
+                class C:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def slow(self):
+                        with self._lock:
+                            x = 1
+                        time.sleep(1.0)
+                        return x
+            """,
+        }, only=["lock-order"])
+        assert report.findings == []
+
+    def test_blocking_via_helper_call_flagged(self, tmp_path):
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/mod.py": """
+                import threading
+                import time
+
+                class C:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def _helper(self):
+                        time.sleep(1.0)
+
+                    def slow(self):
+                        with self._lock:
+                            self._helper()
+            """,
+        }, only=["lock-order"])
+        assert any("self._helper" in f.detail for f in report.findings)
+
+    def test_condition_wait_on_held_lock_not_flagged(self, tmp_path):
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/mod.py": """
+                import threading
+
+                class C:
+                    def __init__(self):
+                        self._cond = threading.Condition()
+
+                    def take(self, remaining):
+                        with self._cond:
+                            self._cond.wait(remaining)
+            """,
+        }, only=["lock-order"])
+        assert report.findings == []
+
+    def test_lambda_body_not_under_lock(self, tmp_path):
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/mod.py": """
+                import threading
+                import time
+
+                class C:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def defer(self, pool):
+                        with self._lock:
+                            fn = lambda: time.sleep(1.0)
+                        return fn
+            """,
+        }, only=["lock-order"])
+        assert report.findings == []
+
+    def test_witnessed_factories_count_as_locks(self, tmp_path):
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/mod.py": """
+                import time
+
+                from tieredstorage_tpu.utils.locks import new_lock
+
+                class C:
+                    def __init__(self):
+                        self._lock = new_lock("mod.C._lock")
+
+                    def slow(self):
+                        with self._lock:
+                            time.sleep(1.0)
+            """,
+        }, only=["lock-order"])
+        assert [f.detail for f in report.findings] == [
+            "blocking:time.sleep@C._lock"
+        ]
+
+    def test_witness_names_match_static_lock_model(self):
+        """Static<->runtime cross-validation: every literal name handed to
+        new_lock/new_rlock/new_condition in the tree must correspond to a
+        lock node the static model derives, so LockWitness edges and the
+        lock-order graph talk about the same objects."""
+        import re
+
+        project = load_project(REPO_ROOT)
+        summaries, _, _ = lockorder.build_lock_model(project)
+        static_ids = set().union(*(s.acquires for s in summaries.values()))
+        name_re = re.compile(r"new_(?:lock|rlock|condition)\(\"([^\"]+)\"\)")
+        runtime_names = {
+            m
+            for pf in project.files
+            for m in name_re.findall(pf.source)
+        }
+        assert runtime_names, "no witnessed locks found in the tree"
+        for name in sorted(runtime_names):
+            stem, _, suffix = name.partition(".")
+            matches = [
+                lock_id for lock_id in static_ids
+                if lock_id.split(":", 1)[1] == suffix
+                and lock_id.split(":", 1)[0].endswith(f"{stem}.py")
+                or (stem == "native" and lock_id.startswith("tieredstorage_tpu/native/"))
+                and lock_id.endswith(f":{suffix}")
+            ]
+            assert matches, f"witness name {name!r} has no static lock node"
+
+    def test_model_sees_repo_lock_inventory(self):
+        project = load_project(REPO_ROOT)
+        summaries, edges, _ = lockorder.build_lock_model(project)
+        lock_nodes = {n for e in edges for n in e}
+        acquired = set().union(*(s.acquires for s in summaries.values()))
+        # The converted modules must all be visible to the static model.
+        for expected in (
+            "tieredstorage_tpu/utils/caching.py:LoadingCache._lock",
+            "tieredstorage_tpu/storage/httpclient.py:_ConnectionPool._cond",
+            "tieredstorage_tpu/fleet/peer_cache.py:PeerChunkCache._lock",
+            "tieredstorage_tpu/fleet/singleflight.py:SingleFlight._lock",
+            "tieredstorage_tpu/utils/admission.py:AdmissionController._cond",
+        ):
+            assert expected in acquired, expected
+        assert lock_nodes <= acquired
+
+
+# ------------------------------------------------------------- config drift
+class TestConfigDrift:
+    def test_undeclared_read_flagged(self, tmp_path):
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/config/mod.py": """
+                from tieredstorage_tpu.config.configdef import ConfigKey
+
+                KEY = ConfigKey("declared.key", "int", default=1)
+
+                class Cfg:
+                    def read(self):
+                        return (
+                            self._values["declared.key"],
+                            self._values["undeclared.key"],
+                        )
+            """,
+        }, only=["config-drift"])
+        assert [f.detail for f in report.findings] == [
+            "undeclared-key:undeclared.key"
+        ]
+
+    def test_dynamic_families_allowed(self, tmp_path):
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/config/mod.py": """
+                class Cfg:
+                    def read(self):
+                        return self._props.get(
+                            "replication.replica.a.backend.class"
+                        )
+            """,
+        }, only=["config-drift"])
+        assert report.findings == []
+
+
+# ------------------------------------------------- suppressions / reporting
+class TestSuppressions:
+    def test_round_trip(self, tmp_path):
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/mod.py": """
+                import time
+
+                def f():
+                    return time.time()
+            """,
+        }, only=["monotonic-clock"])
+        assert not report.ok
+        fp = report.findings[0].fingerprint
+        sup = Suppressions({fp: "fixture: wall clock is intended here"})
+        text = sup.serialize()
+        reparsed = Suppressions.parse(text)
+        assert reparsed.entries == sup.entries
+
+        clean = run_analysis(
+            make_project(tmp_path / "again", {
+                "tieredstorage_tpu/mod.py": """
+                    import time
+
+                    def f():
+                        return time.time()
+                """,
+            }),
+            suppressions=reparsed,
+            only=["monotonic-clock"],
+        )
+        assert clean.ok
+        assert len(clean.suppressed) == 1
+        assert clean.unsuppressed == []
+
+    def test_stale_suppression_fails(self, tmp_path):
+        sup = Suppressions({"monotonic-clock:gone.py:f:time.time": "obsolete"})
+        report = run_analysis(
+            make_project(tmp_path, {"tieredstorage_tpu/mod.py": "x = 1\n"}),
+            suppressions=sup,
+            only=["monotonic-clock"],
+        )
+        assert not report.ok
+        assert report.stale_suppressions == ["monotonic-clock:gone.py:f:time.time"]
+
+    def test_missing_justification_rejected(self):
+        with pytest.raises(SuppressionError):
+            Suppressions.parse("checker:file.py:f:detail\n")
+        with pytest.raises(SuppressionError):
+            Suppressions.parse("checker:file.py:f:detail  #   \n")
+
+    def test_duplicate_rejected(self):
+        with pytest.raises(SuppressionError):
+            Suppressions.parse(
+                "a:b:c:d  # one\na:b:c:d  # two\n"
+            )
+
+    def test_comments_and_blanks_ignored(self):
+        sup = Suppressions.parse("# header\n\na:b:c:d  # why\n")
+        assert sup.entries == {"a:b:c:d": "why"}
+
+
+class TestJsonReport:
+    def test_schema(self, tmp_path):
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/mod.py": """
+                import time
+
+                def f():
+                    return time.time()
+            """,
+        }, only=["monotonic-clock"])
+        out = tmp_path / "report.json"
+        report.write_json(out)
+        data = json.loads(out.read_text())
+        assert data["version"] == 1
+        assert data["generated_by"] == "tieredstorage_tpu.analysis"
+        assert data["files_scanned"] == 1
+        assert data["checkers"] == ["monotonic-clock"]
+        assert data["summary"]["total"] == 1
+        assert data["summary"]["unsuppressed"] == 1
+        assert data["summary"]["ok"] is False
+        (finding,) = data["findings"]
+        for field in ("checker", "path", "line", "qualname", "detail",
+                      "message", "fingerprint", "suppressed", "justification"):
+            assert field in finding
+        assert finding["suppressed"] is False
+
+    def test_cli_exit_codes(self, tmp_path):
+        from tieredstorage_tpu.analysis.__main__ import main
+
+        (tmp_path / "tieredstorage_tpu").mkdir()
+        (tmp_path / "tieredstorage_tpu" / "mod.py").write_text(
+            "import time\n\ndef f():\n    return time.time()\n"
+        )
+        rc = main([
+            "--root", str(tmp_path), "--checker", "monotonic-clock",
+            "--json", str(tmp_path / "r.json"),
+        ])
+        assert rc == 1
+        data = json.loads((tmp_path / "r.json").read_text())
+        fp = data["findings"][0]["fingerprint"]
+        (tmp_path / "sup.txt").write_text(f"{fp}  # fixture waiver\n")
+        rc = main([
+            "--root", str(tmp_path), "--checker", "monotonic-clock",
+            "--suppressions", str(tmp_path / "sup.txt"),
+        ])
+        assert rc == 0
+
+    def test_cli_rejects_unjustified_suppressions(self, tmp_path):
+        from tieredstorage_tpu.analysis.__main__ import main
+
+        (tmp_path / "tieredstorage_tpu").mkdir()
+        (tmp_path / "tieredstorage_tpu" / "mod.py").write_text("x = 1\n")
+        (tmp_path / "sup.txt").write_text("some:finger:print:here\n")
+        rc = main([
+            "--root", str(tmp_path), "--checker", "monotonic-clock",
+            "--suppressions", str(tmp_path / "sup.txt"),
+        ])
+        assert rc == 2
+
+
+# ------------------------------------------------------- run-on-repo smoke
+class TestRunOnRepo:
+    def test_repo_is_clean_under_suppression_file(self):
+        """THE gate: the tree itself must produce zero unsuppressed findings
+        and zero stale suppressions (mirrors `make analyze` / CI)."""
+        suppressions = Suppressions.load(
+            REPO_ROOT / "tools" / "analysis_suppressions.txt"
+        )
+        report = run_analysis(
+            load_project(REPO_ROOT), suppressions=suppressions
+        )
+        assert report.unsuppressed == [], "\n".join(
+            f.render() for f in report.unsuppressed
+        )
+        assert report.stale_suppressions == []
+        assert report.ok
+
+    def test_every_suppression_is_justified(self):
+        suppressions = Suppressions.load(
+            REPO_ROOT / "tools" / "analysis_suppressions.txt"
+        )
+        assert suppressions.entries, "suppression file should not be empty"
+        for fp, why in suppressions.entries.items():
+            assert len(why) >= 20, f"{fp}: justification too thin: {why!r}"

@@ -1,0 +1,46 @@
+"""Project-invariant static analysis (ISSUE 7).
+
+An AST-based checker framework (stdlib ``ast``, zero dependencies) that
+mechanically enforces the concurrency and observability discipline the
+serving tier relies on — the counterpart of the reference repo's pitest
+merge gate, but aimed at *project invariants* instead of test strength:
+
+- ``lock-order``        cross-module lock-acquisition graph stays a DAG; no
+                        blocking calls (socket/HTTP/waits) under a held lock
+- ``deadline``          blocking waits in request-path modules clamp to the
+                        end-to-end ``Deadline`` budget
+- ``bounded-concurrency``  no unsanctioned ``threading.Thread`` and no
+                        unbounded executors
+- ``monotonic-clock``   no ``time.time()`` (durations/timeouts must ride the
+                        monotonic clock)
+- ``swallowed-exception``  no broad ``except: pass`` without a trace event,
+                        metric, or log
+- ``config-drift``      every config key read is declared; generated docs
+                        (configs.rst / metrics.rst) match the live code
+
+Entry points: ``python -m tieredstorage_tpu.analysis`` / ``make analyze``
+(CI-gated). Findings carry stable line-independent fingerprints; legacy
+violations live in ``tools/analysis_suppressions.txt`` with one-line
+justifications and are burned down, never silently grandfathered. The
+static lock-order proof is cross-validated at runtime by
+``tieredstorage_tpu.utils.locks.LockWitness`` (``TSTPU_LOCK_WITNESS=1``
+under ``make chaos`` / ``make fleet-demo``).
+"""
+
+from tieredstorage_tpu.analysis.core import (
+    AnalysisReport,
+    Finding,
+    Project,
+    Suppressions,
+    load_project,
+    run_analysis,
+)
+
+__all__ = [
+    "AnalysisReport",
+    "Finding",
+    "Project",
+    "Suppressions",
+    "load_project",
+    "run_analysis",
+]

@@ -1,0 +1,90 @@
+"""CLI: ``python -m tieredstorage_tpu.analysis`` (a.k.a. ``make analyze``).
+
+Exit status: 0 when every finding is suppressed-with-justification and no
+suppression is stale; 1 otherwise. ``--json`` writes the machine-readable
+report (uploaded as a CI artifact next to the demo reports).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from tieredstorage_tpu.analysis.core import (
+    Suppressions,
+    SuppressionError,
+    checker_registry,
+    load_project,
+    run_analysis,
+)
+
+DEFAULT_SUPPRESSIONS = "tools/analysis_suppressions.txt"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tieredstorage_tpu.analysis", description=__doc__
+    )
+    ap.add_argument(
+        "--root", default=None,
+        help="repo root (default: autodetected from the package location)",
+    )
+    ap.add_argument(
+        "--json", default=None, metavar="PATH",
+        help="write the JSON findings artifact here",
+    )
+    ap.add_argument(
+        "--suppressions", default=None, metavar="PATH",
+        help=f"suppression file (default: <root>/{DEFAULT_SUPPRESSIONS})",
+    )
+    ap.add_argument(
+        "--checker", action="append", default=None, metavar="NAME",
+        help="run only this checker (repeatable); default: all",
+    )
+    ap.add_argument(
+        "--scan", action="append", default=None, metavar="DIR",
+        help="directory/file under root to scan (default: tieredstorage_tpu)",
+    )
+    ap.add_argument(
+        "--list-checkers", action="store_true", help="list checkers and exit"
+    )
+    ap.add_argument(
+        "-q", "--quiet", action="store_true",
+        help="suppress the per-finding text output (summary only)",
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_checkers:
+        for name in checker_registry():
+            print(name)
+        return 0
+
+    root = (
+        Path(args.root).resolve()
+        if args.root
+        else Path(__file__).resolve().parents[2]
+    )
+    suppressions_path = (
+        Path(args.suppressions) if args.suppressions else root / DEFAULT_SUPPRESSIONS
+    )
+    try:
+        suppressions = Suppressions.load(suppressions_path)
+    except SuppressionError as e:
+        print(f"analysis: bad suppression file: {e}", file=sys.stderr)
+        return 2
+
+    project = load_project(root, args.scan)
+    report = run_analysis(project, suppressions=suppressions, only=args.checker)
+
+    if args.json:
+        report.write_json(Path(args.json))
+    text = report.render_text()
+    if args.quiet:
+        text = "\n".join(text.splitlines()[-2:])
+    print(text)
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,11 +41,11 @@ from __future__ import annotations
 import dataclasses
 import random
 import re
-import threading
 from collections import Counter
 from typing import Iterable, Optional, Sequence, Union
 
 from tieredstorage_tpu.storage.core import StorageBackendException
+from tieredstorage_tpu.utils.locks import new_lock
 
 OPS = ("upload", "fetch", "delete", "list")
 ACTIONS = ("raise", "key-not-found", "delay", "truncate", "corrupt")
@@ -158,7 +158,7 @@ class FaultSchedule:
         self._rules = list(rules)
         self._rng = random.Random(seed)
         self._calls: Counter[str] = Counter()
-        self._lock = threading.Lock()
+        self._lock = new_lock("schedule.FaultSchedule._lock")
         #: Every fired rule as (op, action, key string), in order.
         self.injections: list[tuple[str, str, str]] = []
 

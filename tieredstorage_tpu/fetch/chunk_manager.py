@@ -13,7 +13,6 @@ from __future__ import annotations
 import abc
 import io
 import logging
-import threading
 import time
 from typing import BinaryIO, Callable, Optional, Sequence
 
@@ -24,6 +23,7 @@ from tieredstorage_tpu.storage.core import (
     ObjectKey,
     StorageBackendException,
 )
+from tieredstorage_tpu.utils.locks import new_lock
 from tieredstorage_tpu.transform.api import DetransformOptions, TransformBackend
 from tieredstorage_tpu.utils.deadline import check_deadline
 from tieredstorage_tpu.utils.streams import read_exactly
@@ -84,7 +84,7 @@ class DefaultChunkManager(ChunkManager):
         )
         self._now = time_source
         self._quarantine: dict[str, tuple[float, str]] = {}
-        self._quarantine_lock = threading.Lock()
+        self._quarantine_lock = new_lock("chunk_manager.DefaultChunkManager._quarantine_lock")
         #: Total detransform corruption detections (exported as a gauge).
         self.corruptions = 0
 

@@ -29,13 +29,13 @@ fallback until enough samples exist.
 from __future__ import annotations
 
 import concurrent.futures
-import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Optional, TypeVar
 
 from tieredstorage_tpu.utils.deadline import current_deadline, deadline_scope
 from tieredstorage_tpu.utils.tracing import NOOP_TRACER
+from tieredstorage_tpu.utils.locks import new_lock
 
 T = TypeVar("T")
 
@@ -53,7 +53,7 @@ class HedgeBudget:
         self._earn = percent / 100.0
         self._capacity = max(1.0, capacity)
         self._balance = 1.0
-        self._lock = threading.Lock()
+        self._lock = new_lock("hedge.HedgeBudget._lock")
 
     @property
     def balance(self) -> float:
@@ -104,7 +104,7 @@ class Hedger:
         self.wins = 0
         #: Hedges suppressed because the budget was exhausted.
         self.suppressed = 0
-        self._lock = threading.Lock()
+        self._lock = new_lock("hedge.Hedger._lock")
 
     @property
     def budget(self) -> HedgeBudget:

@@ -30,7 +30,6 @@ from __future__ import annotations
 import contextlib
 import io
 import struct
-import threading
 import time
 from typing import BinaryIO, Optional, Sequence
 from urllib.parse import quote
@@ -43,6 +42,7 @@ from tieredstorage_tpu.storage.core import ObjectKey
 from tieredstorage_tpu.storage.httpclient import HttpClient, HttpError, NO_RETRY
 from tieredstorage_tpu.utils.deadline import DEADLINE_HEADER, current_deadline
 from tieredstorage_tpu.utils.tracing import TRACEPARENT_HEADER, NOOP_TRACER
+from tieredstorage_tpu.utils.locks import new_lock
 
 
 def encode_chunk_frames(chunks: Sequence[bytes]) -> bytes:
@@ -106,7 +106,7 @@ class PeerChunkCache(ChunkManager):
         self.forward_timeout_s = forward_timeout_s
         self.down_cooldown_s = down_cooldown_s
         self._now = time_source
-        self._lock = threading.Lock()
+        self._lock = new_lock("peer_cache.PeerChunkCache._lock")
         self._clients: dict[str, HttpClient] = {}
         self._down_until: dict[str, float] = {}
         #: Keys this instance is currently serving AS the owner (forwarded
@@ -179,18 +179,23 @@ class PeerChunkCache(ChunkManager):
         self.tracer.event("fleet.peer_down", peer=peer, reason=reason)
 
     def _client(self, peer: str, url: str) -> HttpClient:
+        stale: Optional[HttpClient] = None
         with self._lock:
             client = self._clients.get(peer)
             if client is None or client.base_url != url:
-                if client is not None:
-                    client.close()
                 # Single attempt: the local backend path IS the retry, and a
-                # struggling peer must not absorb backoff sleeps.
+                # struggling peer must not absorb backoff sleeps. The stale
+                # client (peer re-ringed to a new URL) is closed OUTSIDE the
+                # lock - socket teardown must not stall every other forward
+                # (lock-order checker: no blocking calls under _lock).
+                stale = client
                 client = HttpClient(
                     url, timeout=self.forward_timeout_s, retry=NO_RETRY
                 )
                 self._clients[peer] = client
-            return client
+        if stale is not None:
+            stale.close()
+        return client
 
     # ----------------------------------------------------------------- reads
     def get_chunk(

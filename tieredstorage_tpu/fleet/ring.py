@@ -23,10 +23,10 @@ from __future__ import annotations
 
 import bisect
 import hashlib
-import threading
 from typing import Mapping, Optional
 
 from tieredstorage_tpu.utils.tracing import NOOP_TRACER
+from tieredstorage_tpu.utils.locks import new_lock
 
 #: Full circle size: MD5-derived points are taken mod 2^64.
 _RING_BITS = 64
@@ -136,7 +136,7 @@ class FleetRouter:
         self.instance_id = instance_id
         self.vnodes = vnodes
         self.tracer = tracer
-        self._lock = threading.Lock()
+        self._lock = new_lock("ring.FleetRouter._lock")
         self._peers: dict[str, Optional[str]] = {instance_id: None}
         self._ring = HashRing([instance_id], vnodes)
         #: Membership generations applied (starts at 1 for the solo ring).

@@ -27,6 +27,7 @@ from tieredstorage_tpu.utils.deadline import (
     DeadlineExceededException,
     remaining_s,
 )
+from tieredstorage_tpu.utils.locks import new_lock
 from tieredstorage_tpu.utils.tracing import NOOP_TRACER
 
 T = TypeVar("T")
@@ -46,7 +47,7 @@ class SingleFlight:
 
     def __init__(self, tracer=NOOP_TRACER) -> None:
         self.tracer = tracer
-        self._lock = threading.Lock()
+        self._lock = new_lock("singleflight.SingleFlight._lock")
         self._flights: dict[str, _Flight] = {}
         #: Calls that executed the work (one per flight).
         self.leaders = 0

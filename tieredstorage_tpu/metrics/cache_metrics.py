@@ -40,6 +40,7 @@ def register_cache_metrics(
     gauge("cache-load-failures-total", lambda: stats.load_failures)
     gauge("cache-load-time-total-ns", lambda: stats.total_load_time_ns)
     gauge("cache-eviction-weight-total", lambda: stats.eviction_weight)
+    gauge("cache-listener-failures-total", lambda: stats.listener_failures)
     gauge(
         "cache-evictions-total",
         lambda: sum(stats.evictions.values()),

@@ -14,10 +14,10 @@ point-in-time snapshot in place of JMX.
 from __future__ import annotations
 
 import bisect
-import threading
 import time
 from dataclasses import dataclass
 from typing import Callable, Mapping, Optional, Sequence
+from tieredstorage_tpu.utils.locks import new_lock
 
 
 @dataclass(frozen=True)
@@ -100,7 +100,7 @@ class Histogram(Stat):
         self._counts = [0] * (len(self._bounds) + 1)
         self._sum = 0.0
         self._count = 0
-        self._lock = threading.Lock()
+        self._lock = new_lock("core.Histogram._lock")
 
     def record(self, value: float, now: float) -> None:
         idx = bisect.bisect_left(self._bounds, value)
@@ -177,7 +177,7 @@ class SampledStat(Stat):
         # record() runs under the owning sensor's lock, but measure() is
         # driven by snapshot readers on other threads; both mutate the sample
         # ring (window advance / purge), so the stat needs its own lock.
-        self._stat_lock = threading.Lock()
+        self._stat_lock = new_lock("core.SampledStat._stat_lock")
 
     def record(self, value: float, now: float) -> None:
         with self._stat_lock:
@@ -287,7 +287,7 @@ class Sensor:
         self.recording_level = recording_level
         self._registry = registry
         self._stats: list[tuple[MetricName, Stat]] = []
-        self._lock = threading.Lock()
+        self._lock = new_lock("core.Sensor._lock")
 
     def _bind(self, metric_name: MetricName, stat: Stat) -> None:
         if isinstance(stat, SampledStat):
@@ -331,7 +331,7 @@ class MetricsRegistry:
         self.time = time_source
         self._sensors: dict[str, Sensor] = {}
         self._metrics: dict[MetricName, Stat | Callable[[], float]] = {}
-        self._lock = threading.Lock()
+        self._lock = new_lock("core.MetricsRegistry._lock")
 
     def sensor(self, name: str, recording_level: str = "INFO") -> Sensor:
         """Create-or-get, idempotent (commons SensorProvider semantics)."""

@@ -13,17 +13,17 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import threading
 from pathlib import Path
 from typing import Optional
 
 import numpy as np
+from tieredstorage_tpu.utils.locks import new_lock
 
 _REPO_ROOT = Path(__file__).resolve().parent.parent.parent
 _NATIVE_DIR = _REPO_ROOT / "native"
 _SO_PATH = _NATIVE_DIR / "libtransform_host.so"
 
-_lock = threading.Lock()
+_lock = new_lock("native._lock")
 _lib: Optional[ctypes.CDLL] = None
 _load_error: Optional[str] = None
 

@@ -100,7 +100,9 @@ class AntiEntropyRepairer:
 
     # ------------------------------------------------------------------ pass
     def run_once(self) -> AntiEntropyReport:
-        report = AntiEntropyReport(started_at=time.time())
+        # Monotonic, like ScrubReport.started_at: an ordering instant on the
+        # process clock, not a calendar timestamp.
+        report = AntiEntropyReport(started_at=time.monotonic())
         start = time.monotonic()
         with self.tracer.span("replication.antientropy", prefix=self.prefix):
             replicas = self._replicated.replica_states

@@ -163,7 +163,9 @@ class Scrubber:
 
     # ------------------------------------------------------------------ pass
     def scrub_once(self) -> ScrubReport:
-        report = ScrubReport(started_at=time.time())
+        # Monotonic: started_at orders passes and feeds duration math; it is
+        # an instant on the process clock, not a calendar timestamp.
+        report = ScrubReport(started_at=time.monotonic())
         start = time.monotonic()
         with self.tracer.span("scrub.pass", prefix=self.prefix):
             inventory = [k.value for k in self._storage.list_objects(self.prefix)]

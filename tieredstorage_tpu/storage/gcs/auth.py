@@ -49,13 +49,17 @@ class ServiceAccountTokenProvider:
         self._expires_at = 0.0
 
     def token(self) -> str:
-        now = time.time()
-        if self._token is None or now >= self._expires_at - self.REFRESH_MARGIN_S:
-            self._token = self._mint(now)
-            self._expires_at = now + self.LIFETIME_S
+        # Expiry bookkeeping rides the monotonic clock (an NTP step must not
+        # refresh early or, worse, serve a token past its real lifetime).
+        if self._token is None or time.monotonic() >= self._expires_at - self.REFRESH_MARGIN_S:
+            self._token = self._mint(time.time())
+            self._expires_at = time.monotonic() + self.LIFETIME_S
         return self._token
 
     def _mint(self, now: float) -> str:
+        # `now` is wall-clock epoch seconds by protocol: JWT iat/exp are
+        # absolute times the server compares against ITS clock (the one
+        # suppressed monotonic-clock finding, tools/analysis_suppressions.txt).
         header = _b64url(json.dumps({"alg": "RS256", "typ": "JWT"}).encode())
         claims = _b64url(
             json.dumps(

@@ -39,7 +39,6 @@ import io
 import random
 import socket
 import ssl
-import threading
 import time
 from datetime import datetime, timezone
 from email.utils import parsedate_to_datetime
@@ -51,6 +50,7 @@ from tieredstorage_tpu.utils.deadline import (
     check_deadline,
     current_deadline,
 )
+from tieredstorage_tpu.utils.locks import new_condition
 
 
 class HttpError(Exception):
@@ -239,7 +239,7 @@ class _ConnectionPool:
             raise ValueError(f"max_connections must be >= 1, got {max_connections}")
         self._factory = factory
         self.max_connections = max_connections
-        self._cond = threading.Condition()
+        self._cond = new_condition("httpclient._ConnectionPool._cond")
         self._idle: list[http.client.HTTPConnection] = []
         self._in_use = 0
         #: Lifetime counters (pool health introspection).
@@ -264,41 +264,54 @@ class _ConnectionPool:
         stale idle socket (an idle one is closed to keep the bound)."""
         deadline = None if timeout_s is None else time.monotonic() + timeout_s
         create = False
-        with self._cond:
-            while True:
-                if self._idle and not fresh:
-                    conn = self._idle.pop()
-                    self._in_use += 1
-                    return conn
-                if self._in_use + len(self._idle) < self.max_connections:
-                    self._in_use += 1
-                    create = True
-                    break
-                if fresh and self._idle:
-                    # Under the fresh policy, trade an idle (possibly stale)
-                    # socket for a new one rather than waiting.
-                    self._idle.pop().close()
-                    continue
-                remaining = None if deadline is None else deadline - time.monotonic()
-                if remaining is not None and remaining <= 0:
-                    self.exhausted_total += 1
-                    raise HttpError(
-                        f"connection pool exhausted ({self.max_connections} "
-                        f"in flight); no slot within {timeout_s:.1f}s"
-                    )
-                self.waited_total += 1
-                self._cond.wait(remaining)
-        if create:
-            try:
-                conn = self._factory()
-            except BaseException:
-                with self._cond:
-                    self._in_use -= 1
-                    self._cond.notify()
-                raise
+        conn = None
+        stale: list[http.client.HTTPConnection] = []
+        try:
             with self._cond:
-                self.created_total += 1
+                while True:
+                    if self._idle and not fresh:
+                        conn = self._idle.pop()
+                        self._in_use += 1
+                        break
+                    if self._in_use + len(self._idle) < self.max_connections:
+                        self._in_use += 1
+                        create = True
+                        break
+                    if fresh and self._idle:
+                        # Under the fresh policy, trade an idle (possibly
+                        # stale) socket for a new one rather than waiting.
+                        # Popping it frees the slot immediately; the socket
+                        # teardown itself happens outside the lock (lock-order
+                        # checker: no blocking calls under _cond).
+                        stale.append(self._idle.pop())
+                        continue
+                    remaining = None if deadline is None else deadline - time.monotonic()
+                    if remaining is not None and remaining <= 0:
+                        self.exhausted_total += 1
+                        raise HttpError(
+                            f"connection pool exhausted ({self.max_connections} "
+                            f"in flight); no slot within {timeout_s:.1f}s"
+                        )
+                    self.waited_total += 1
+                    self._cond.wait(remaining)
+        finally:
+            for old in stale:
+                try:
+                    old.close()
+                except OSError:
+                    pass
+        if not create:
             return conn
+        try:
+            conn = self._factory()
+        except BaseException:
+            with self._cond:
+                self._in_use -= 1
+                self._cond.notify()
+            raise
+        with self._cond:
+            self.created_total += 1
+        return conn
 
     def release(self, conn) -> None:
         """Return a healthy connection for keep-alive reuse."""

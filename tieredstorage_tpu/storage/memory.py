@@ -9,7 +9,6 @@ contract suite and the RSM lifecycle tests can run in-process).
 from __future__ import annotations
 
 import io
-import threading
 from typing import BinaryIO, Dict, Mapping, Optional
 
 from tieredstorage_tpu.storage.core import (
@@ -19,12 +18,13 @@ from tieredstorage_tpu.storage.core import (
     ObjectKey,
     StorageBackend,
 )
+from tieredstorage_tpu.utils.locks import new_lock
 
 
 class InMemoryStorage(StorageBackend):
     def __init__(self) -> None:
         self._objects: Dict[str, bytes] = {}
-        self._lock = threading.Lock()
+        self._lock = new_lock("memory.InMemoryStorage._lock")
 
     def configure(self, configs: Mapping[str, object]) -> None:
         pass

@@ -61,6 +61,7 @@ from tieredstorage_tpu.config.configdef import (
     null_or,
     subset_with_prefix,
 )
+from tieredstorage_tpu.utils.locks import new_lock
 from tieredstorage_tpu.storage.core import (
     BytesRange,
     InvalidRangeException,
@@ -140,7 +141,7 @@ class ReplicaState:
     def __init__(self, name: str, backend: StorageBackend) -> None:
         self.name = name
         self.backend = backend
-        self._lock = threading.Lock()
+        self._lock = new_lock("replicated.ReplicaState._lock")
         self._latency_ms: Optional[float] = None
         self._error_rate = 0.0
         #: Cumulative counters, exported as replication-metrics gauges.
@@ -270,7 +271,7 @@ class ReplicatedStorageBackend(StorageBackend):
         self._probe_prefix = probe_prefix
         self.tracer = tracer
         self._pool: Optional[ThreadPoolExecutor] = None
-        self._pool_lock = threading.Lock()
+        self._pool_lock = new_lock("replicated.ReplicatedStorageBackend._pool_lock")
         self._prober: Optional[HealthProber] = None
         #: Optional `(elapsed_ms)` hook; the RSM wires it to the
         #: replica-failover-time histogram.
@@ -278,7 +279,7 @@ class ReplicatedStorageBackend(StorageBackend):
         #: Cumulative counters, exported as replication-metrics gauges.
         self.failovers = 0
         self.quorum_failures = 0
-        self._counter_lock = threading.Lock()
+        self._counter_lock = new_lock("replicated.ReplicatedStorageBackend._counter_lock")
         self._validate_quorum()
         if self._replicas and self._probe_interval_s:
             self.start_prober()

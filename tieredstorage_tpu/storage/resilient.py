@@ -32,7 +32,6 @@ from __future__ import annotations
 
 import enum
 import random
-import threading
 import time
 from typing import BinaryIO, Callable, Mapping, Optional
 
@@ -44,6 +43,7 @@ from tieredstorage_tpu.storage.core import (
     StorageBackend,
     StorageBackendException,
 )
+from tieredstorage_tpu.utils.locks import new_lock
 from tieredstorage_tpu.utils.deadline import DeadlineExceededException, remaining_s
 
 
@@ -72,7 +72,7 @@ class CircuitBreaker:
         self._cooldown_s = cooldown_s
         self._now = time_source
         self._on_transition = on_transition
-        self._lock = threading.Lock()
+        self._lock = new_lock("resilient.CircuitBreaker._lock")
         self._state = BreakerState.CLOSED
         self._consecutive_failures = 0
         self._opened_at = 0.0
@@ -80,6 +80,10 @@ class CircuitBreaker:
         #: Cumulative counters, exported as gauges.
         self.opens = 0
         self.fast_fails = 0
+        #: Transition-observer callbacks that raised (swallowed-exception
+        #: checker: a failing observer must not break the breaker, but the
+        #: failure must still be countable).
+        self.observer_failures = 0
 
     @property
     def state(self) -> BreakerState:
@@ -96,7 +100,7 @@ class CircuitBreaker:
             try:
                 self._on_transition(old, new)
             except Exception:  # noqa: BLE001 — observers must not break the breaker
-                pass
+                self.observer_failures += 1
 
     def acquire(self) -> None:
         """Gate a call; raises CircuitOpenException while open."""
@@ -159,7 +163,7 @@ class RetryBudget:
         self._earn = percent / 100.0
         self._capacity = max(1.0, capacity)
         self._balance = self._capacity
-        self._lock = threading.Lock()
+        self._lock = new_lock("resilient.RetryBudget._lock")
         #: Retries granted / denied (exported as resilience gauges).
         self.spent = 0
         self.denied = 0

@@ -10,8 +10,11 @@ ObjectUploader.upload.
 from __future__ import annotations
 
 import io
+import logging
 
 from tieredstorage_tpu.storage.s3.client import S3Client
+
+log = logging.getLogger(__name__)
 
 
 class S3MultiPartOutputStream(io.RawIOBase):
@@ -65,8 +68,14 @@ class S3MultiPartOutputStream(io.RawIOBase):
         if self._upload_id is not None:
             try:
                 self.client.abort_multipart_upload(self.key, self._upload_id)
-            except Exception:
-                pass
+            except Exception:  # noqa: BLE001 — abort is best-effort by contract
+                # Logged, not raised: the caller is already unwinding an
+                # upload failure, but a leaked multipart upload accrues
+                # storage until lifecycle cleanup, so leave a trace.
+                log.warning(
+                    "Failed to abort multipart upload %s for %s",
+                    self._upload_id, self.key, exc_info=True,
+                )
         self._buffer.clear()
 
     def close(self) -> None:

@@ -31,10 +31,10 @@ as before.
 from __future__ import annotations
 
 import math
-import threading
 import time
 from collections import Counter
 from typing import Callable, Optional
+from tieredstorage_tpu.utils.locks import new_condition
 
 
 class AdmissionRejectedException(Exception):
@@ -64,7 +64,7 @@ class AdmissionController:
         self._queue_timeout_s = queue_timeout_s
         self.retry_after_s = retry_after_s
         self.on_wait = on_wait
-        self._cond = threading.Condition()
+        self._cond = new_condition("admission.AdmissionController._cond")
         #: Requests currently executing / currently queued (gauges).
         self.active = 0
         self.queued = 0

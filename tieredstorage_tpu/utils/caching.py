@@ -23,12 +23,12 @@ Loads run on a caller-supplied executor; `get` blocks up to `timeout`
 from __future__ import annotations
 
 import enum
-import threading
 import time
 from collections import OrderedDict
 from concurrent.futures import Executor, Future
 from dataclasses import dataclass, field
 from typing import Any, Callable, Generic, Hashable, Optional, TypeVar
+from tieredstorage_tpu.utils.locks import new_lock
 
 K = TypeVar("K", bound=Hashable)
 V = TypeVar("V")
@@ -54,6 +54,9 @@ class CacheStats:
         default_factory=lambda: {c: 0 for c in RemovalCause}
     )
     eviction_weight: int = 0
+    #: Removal-listener callbacks that raised (must not poison the cache,
+    #: but must not vanish either — swallowed-exception checker).
+    listener_failures: int = 0
 
 
 class _Entry(Generic[V]):
@@ -84,7 +87,7 @@ class LoadingCache(Generic[K, V]):
         self._expire = expire_after_access_s
         self._listener = removal_listener
         self._now = time_source
-        self._lock = threading.Lock()
+        self._lock = new_lock("caching.LoadingCache._lock")
         # Ordered oldest-access-first for LRU eviction.
         self._entries: "OrderedDict[K, _Entry[V]]" = OrderedDict()
         self._total_weight = 0
@@ -238,7 +241,7 @@ class LoadingCache(Generic[K, V]):
             try:
                 self._listener(key, value, cause)
             except Exception:  # noqa: BLE001 — listener failures must not poison the cache
-                pass
+                self.stats.listener_failures += 1
 
     # ------------------------------------------------------------- inspection
     @property

@@ -36,13 +36,14 @@ import time
 from typing import Iterator, Optional
 
 from tieredstorage_tpu.storage.core import StorageBackendException
+from tieredstorage_tpu.utils.locks import new_lock
 
 #: Header / gRPC-metadata key carrying the remaining budget in integer
 #: milliseconds (the deadline twin of the ``traceparent`` key).
 DEADLINE_HEADER = "x-deadline-ms"
 
 _local = threading.local()
-_exceeded_lock = threading.Lock()
+_exceeded_lock = new_lock("deadline._exceeded_lock")
 _exceeded_total = 0
 
 

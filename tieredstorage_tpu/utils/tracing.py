@@ -35,6 +35,7 @@ import pathlib
 import threading
 import time
 from typing import Iterator, Optional
+from tieredstorage_tpu.utils.locks import new_lock
 
 #: Header/metadata key carrying W3C trace context across process boundaries.
 TRACEPARENT_HEADER = "traceparent"
@@ -121,12 +122,15 @@ class Tracer:
         self._spans: collections.deque[Span] = collections.deque(maxlen=max_spans)
         #: Spans evicted from the ring buffer (exported as a counter metric).
         self.dropped_spans = 0
-        self._lock = threading.Lock()
+        self._lock = new_lock("tracing.Tracer._lock")
         self._local = threading.local()
         # Pinned once so Chrome-trace timestamps from several tracers in one
-        # process (client + sidecar in tests/demos) land on one wall clock.
+        # process (client + sidecar in tests/demos) land on one shared
+        # timeline. Monotonic, not wall clock: Perfetto only needs a
+        # consistent epoch, and an NTP step mid-run would skew span starts
+        # against their perf_counter-measured durations.
         self._epoch_perf = time.perf_counter()
-        self._epoch_wall = time.time()
+        self._epoch_mono = time.monotonic()
 
     # ---------------------------------------------------------------- context
     def _stack(self) -> list[Span]:
@@ -278,7 +282,7 @@ class Tracer:
 
     # ---------------------------------------------------------------- export
     def _ts_us(self, perf_s: float) -> float:
-        return (self._epoch_wall + (perf_s - self._epoch_perf)) * 1e6
+        return (self._epoch_mono + (perf_s - self._epoch_perf)) * 1e6
 
     def chrome_trace_events(self) -> list[dict]:
         """Spans as Chrome trace-event dicts: complete events (``ph: "X"``)

@@ -398,6 +398,23 @@ def run(out_path: pathlib.Path) -> int:
 
         report["byte_diffs"] = byte_diffs
         assert byte_diffs == 0, f"{byte_diffs} responses diverged from source bytes"
+
+        # ------------------------------------------ lock-order witness gate
+        # Under TSTPU_LOCK_WITNESS=1 (make fleet-demo) every lock in the
+        # three instances' gateways/caches/pools/single-flight is wrapped;
+        # the acquisition orders observed across this drill must form a DAG,
+        # validating the static lock-order checker against real executions.
+        from tieredstorage_tpu.utils.locks import witness, witness_enabled
+
+        report["lock_witness"] = {
+            "enabled": witness_enabled(),
+            "edges": len(witness().edges()),
+            "violations": list(witness().violations),
+        }
+        assert not witness().violations, (
+            "lock-order violations observed at runtime:\n  "
+            + "\n  ".join(witness().violations)
+        )
     finally:
         for g in gateways.values():
             try:
@@ -420,6 +437,8 @@ def run(out_path: pathlib.Path) -> int:
     assert parsed["fair_share"]["greedy_status"] == 429
     assert parsed["fair_share"]["polite_status"] == 200
     assert parsed["kill"]["victim"] in parsed["instances"]
+    assert parsed["lock_witness"]["violations"] == []
+    assert not parsed["lock_witness"]["enabled"] or parsed["lock_witness"]["edges"] > 0
     print(
         f"FLEET_DEMO_OK hot_backend_fetches={parsed['burst']['hot_chunk_backend_fetches']} "
         f"coalesced={parsed['burst']['coalesced_fetches']} "

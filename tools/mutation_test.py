@@ -59,6 +59,10 @@ DEFAULT_TARGETS = [
     ("tieredstorage_tpu/security/rsa.py", ["tests/test_security.py"]),
     ("tieredstorage_tpu/security/keys.py", ["tests/test_security.py"]),
     ("tieredstorage_tpu/metadata.py", ["tests/test_object_key_and_metadata.py"]),
+    # ISSUE 7: the analyzer's own pure logic must be mutation-hard too — a
+    # checker that silently stops finding violations is worse than none.
+    ("tieredstorage_tpu/analysis/core.py", ["tests/test_static_analysis.py"]),
+    ("tieredstorage_tpu/utils/locks.py", ["tests/test_lock_witness.py"]),
 ]
 
 _CMP_SWAP = {
