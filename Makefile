@@ -1,7 +1,7 @@
 # Developer entry points (counterpart of /root/reference/Makefile).
 PYTHON ?= python
 
-.PHONY: test test-e2e chaos bench demo trace-demo scrub-demo tail-demo failover-demo fleet-demo docs docker lint analyze mutation clean
+.PHONY: test test-e2e chaos bench demo trace-demo scrub-demo tail-demo failover-demo fleet-demo transform-demo docs docker lint analyze mutation clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q --ignore=tests/e2e
@@ -73,6 +73,17 @@ failover-demo:
 # any suite produces; the demo asserts zero order violations at the end.
 fleet-demo:
 	TSTPU_LOCK_WITNESS=1 $(PYTHON) tools/fleet_demo.py --out artifacts/fleet_report.json
+
+# Fused-window gate: one pipelined multi-window transform through the
+# production TpuTransformBackend path on the host platform must cost exactly
+# ONE fused GCM device dispatch (plus one h2d staging transfer and one d2h
+# fetch) per window — cross-checked against the ops-level launch counter —
+# with wire bytes identical to the multi-dispatch reference ops, a byte-clean
+# round trip, tamper rejection, and the default bench window shapes eligible
+# for the Pallas kernels by pure host logic. Writes and re-validates
+# artifacts/transform_report.json.
+transform-demo:
+	$(PYTHON) tools/transform_demo.py --out artifacts/transform_report.json
 
 docs:
 	$(PYTHON) -m tieredstorage_tpu.docs.configs_docs > docs/configs.rst

@@ -352,13 +352,22 @@ def run_bench() -> dict:
     window = max(1, int(os.environ.get("BENCH_WINDOW_CHUNKS", 16)))
     extras: dict = {}
 
-    # Record whether the Pallas kernels engage on this platform (preflight
-    # verdicts) — BENCH artifacts must show which program was measured.
-    # Probe with the SAME shapes the measured windows produce, or a shrunken
-    # workload could record a kernel the run never used.
+    # Record whether the Pallas kernels engage at the measured shapes.
+    # `pallas_aes`/`pallas_ghash` are the SHAPE eligibility verdicts (pure
+    # host logic — the production windows tile onto the kernels), probed
+    # with the SAME shapes the measured windows produce so a shrunken
+    # workload can't record a kernel the run never used;
+    # `pallas_*_platform` records the platform/preflight half that the
+    # dispatch gate additionally requires, so a CPU-fallback artifact still
+    # shows which program a TPU run WOULD have measured — and a TPU
+    # artifact shows which program it DID measure.
     try:
-        from tieredstorage_tpu.ops.aes_bitsliced import _use_pallas_circuit
-        from tieredstorage_tpu.ops.ghash_pallas import use_pallas_ghash
+        from tieredstorage_tpu.ops.aes_bitsliced import pallas_aes_available
+        from tieredstorage_tpu.ops.aes_pallas import use_pallas_aes
+        from tieredstorage_tpu.ops.ghash_pallas import (
+            pallas_ghash_available,
+            use_pallas_ghash,
+        )
 
         from tieredstorage_tpu.ops.gcm import make_context
 
@@ -372,11 +381,15 @@ def run_bench() -> dict:
         aes_words = window * (-(-(m_blocks + 1) // 32))
         k1 = ctx.agg_mats[0].shape[1] // 16
         ghash_rows = window * (-(-m_blocks // k1))
-        extras["pallas_aes"] = bool(_use_pallas_circuit(aes_words))
+        extras["pallas_aes"] = bool(use_pallas_aes(aes_words))
         extras["pallas_ghash"] = bool(use_pallas_ghash(ghash_rows, k1 * 16))
+        extras["pallas_aes_platform"] = bool(pallas_aes_available())
+        extras["pallas_ghash_platform"] = bool(pallas_ghash_available())
         _err(
             f"[bench] pallas kernels at the measured shapes: "
-            f"aes={extras['pallas_aes']} ghash={extras['pallas_ghash']}"
+            f"aes={extras['pallas_aes']} ghash={extras['pallas_ghash']} "
+            f"(platform: aes={extras['pallas_aes_platform']} "
+            f"ghash={extras['pallas_ghash_platform']})"
         )
     except Exception as exc:  # never cost the artifact
         extras["pallas_gate_error"] = f"{type(exc).__name__}: {exc}"
@@ -418,6 +431,7 @@ def run_bench() -> dict:
 
         return run
 
+    tpu.reset_dispatch_stats()
     e2e_enc_s = time_best(windowed(opts_enc_only), iters=2, warmup=1)
     extras["end_to_end_encrypt_gibs"] = round(gib / e2e_enc_s, 3)
     _err(f"[bench] end-to-end encrypt-only (incl tunnel): {gib / e2e_enc_s:.3f} GiB/s")
@@ -426,6 +440,20 @@ def run_bench() -> dict:
     _err(
         f"[bench] end-to-end zstd+encrypt pipelined x{window}-chunk windows "
         f"(incl tunnel): {gib / e2e_s:.3f} GiB/s"
+    )
+    # Launch-count regressions must show up in the BENCH trajectory the
+    # same way GiB/s does: the steady-state window path is ONE fused GCM
+    # dispatch (and one h2d staging transfer + one d2h fetch) per window
+    # (transform/tpu.py DispatchStats over both windowed runs above).
+    wstats = tpu.reset_dispatch_stats()
+    extras["dispatches_per_window"] = wstats.dispatches_per_window
+    extras["bytes_per_dispatch"] = wstats.bytes_per_dispatch
+    _err(
+        f"[bench] window dispatch accounting: windows={wstats.windows} "
+        f"dispatches={wstats.dispatches} h2d={wstats.h2d_transfers} "
+        f"d2h={wstats.d2h_fetches} -> dispatches_per_window="
+        f"{wstats.dispatches_per_window} bytes_per_dispatch="
+        f"{wstats.bytes_per_dispatch}"
     )
 
     t0 = time.perf_counter()

@@ -276,6 +276,93 @@ class TestMemoryChunkCache:
         assert cache.get_chunk(KEY, manifest, 2).read() == bytes([2]) * CHUNK
 
 
+class TestInflightSingleFlight:
+    """Per-chunk single-flight across readers and the async prefetch: a
+    foreground read of a chunk whose fetch+detransform is already in
+    flight must JOIN that load (one delegate call total), not duplicate
+    the decode — the fix for slow-codec ranged-fetch p99 (BENCH_r05's
+    tpu-lzhuff-v1 435 ms)."""
+
+    def test_concurrent_reader_joins_inflight_load(self):
+        release = threading.Event()
+        entered = threading.Event()
+
+        class BlockingChunkManager(CountingChunkManager):
+            def get_chunks(self, objects_key, manifest, chunk_ids):
+                out = super().get_chunks(objects_key, manifest, chunk_ids)
+                entered.set()
+                release.wait(5)
+                return out
+
+        delegate = BlockingChunkManager()
+        cache = MemoryChunkCache(delegate)
+        cache.configure({"size": -1, "get.timeout.ms": 5_000})
+        manifest = make_manifest()
+        pool = ThreadPoolExecutor(2)
+        first = pool.submit(lambda: cache.get_chunk(KEY, manifest, 0).read())
+        assert entered.wait(5)
+        second = pool.submit(lambda: cache.get_chunk(KEY, manifest, 0).read())
+        # Let the joiner reach the flight before releasing the owner.
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and cache.inflight_joins == 0:
+            time.sleep(0.01)
+        release.set()
+        assert first.result(5) == bytes([0]) * CHUNK
+        assert second.result(5) == bytes([0]) * CHUNK
+        assert delegate.calls == [[0]]  # ONE fetch+detransform total
+        assert cache.inflight_joins == 1
+
+    def test_prefetch_decodes_in_subwindows(self):
+        delegate = CountingChunkManager()
+        cache = MemoryChunkCache(delegate)
+        cache.configure({
+            "size": -1,
+            "prefetch.max.size": CHUNK * 3,
+            "prefetch.window.chunks": 1,
+        })
+        manifest = make_manifest(n_chunks=4)
+        cache.get_chunk(KEY, manifest, 0).read()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and len(delegate.calls) < 4:
+            time.sleep(0.01)
+        # The 3-chunk prefetch range decoded as three 1-chunk sub-windows,
+        # so each chunk became servable as soon as its own decode finished.
+        assert sorted(delegate.calls) == [[0], [1], [2], [3]]
+
+    def test_joined_flight_error_falls_back_to_direct_fetch(self):
+        entered = threading.Event()
+        release = threading.Event()
+
+        class FailingOwnerChunkManager(CountingChunkManager):
+            def get_chunks(self, objects_key, manifest, chunk_ids):
+                first = not self.calls
+                out = super().get_chunks(objects_key, manifest, chunk_ids)
+                if first:
+                    entered.set()
+                    release.wait(5)
+                    raise RuntimeError("owner load failed")
+                return out
+
+        delegate = FailingOwnerChunkManager()
+        cache = MemoryChunkCache(delegate)
+        cache.configure({"size": -1, "get.timeout.ms": 5_000})
+        manifest = make_manifest()
+        pool = ThreadPoolExecutor(2)
+        first = pool.submit(lambda: cache.get_chunk(KEY, manifest, 0).read())
+        assert entered.wait(5)
+        second = pool.submit(lambda: cache.get_chunk(KEY, manifest, 0).read())
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and cache.inflight_joins == 0:
+            time.sleep(0.01)
+        release.set()
+        # The owner's read surfaces the authoritative error; the joiner
+        # falls back to its own direct fetch and succeeds.
+        with pytest.raises(RuntimeError, match="owner load failed"):
+            first.result(5)
+        assert second.result(5) == bytes([0]) * CHUNK
+        assert delegate.calls == [[0], [0]]  # owner + joiner fallback only
+
+
 class TestDiskChunkCache:
     def test_cache_files_lifecycle(self, tmp_path):
         delegate = CountingChunkManager()

@@ -111,6 +111,17 @@ def _chunk_cache_extra() -> ConfigDef:
         doc="The amount of data that should be eagerly prefetched and cached, "
             "in bytes. Defaults to 0 (no prefetching).",
     ))
+    d.define(ConfigKey(
+        "prefetch.window.chunks", "int", default=2,
+        validator=in_range(0, None), importance="low",
+        doc="Chunks per batched fetch+detransform sub-window of the prefetch "
+            "range. Smaller windows surface prefetched chunks sooner and "
+            "bound how long a foreground read that joins an in-flight "
+            "prefetch decode waits (important for slow decodes, e.g. "
+            "tpu-lzhuff-v1 frames); larger windows amortize storage round "
+            "trips and device dispatches. 0 decodes the whole prefetch "
+            "range in one batch. Defaults to 2.",
+    ))
     return d
 
 
@@ -125,6 +136,11 @@ class ChunkCacheConfig(CacheConfig):
     @property
     def prefetch_max_size(self) -> int:
         return self._values["prefetch.max.size"]
+
+    @property
+    def prefetch_window_chunks(self) -> int:
+        """0 ⇒ one batch over the whole prefetch range."""
+        return self._values["prefetch.window.chunks"]
 
 
 def _disk_cache_extra() -> ConfigDef:

@@ -30,6 +30,7 @@ from tieredstorage_tpu.manifest.segment_manifest import SegmentManifestV1
 from tieredstorage_tpu.storage.core import ObjectKey
 from tieredstorage_tpu.utils.caching import LoadingCache, RemovalCause
 from tieredstorage_tpu.utils.deadline import check_deadline, remaining_s
+from tieredstorage_tpu.utils.locks import new_lock
 from tieredstorage_tpu.utils.tracing import NOOP_TRACER
 
 log = logging.getLogger(__name__)
@@ -78,6 +79,19 @@ class ChunkCache(ChunkManager, Generic[T], abc.ABC):
         self.degradations = 0
         #: Background prefetch loads that failed; never propagated.
         self.prefetch_failures = 0
+        #: Per-chunk single-flight across readers AND the async prefetch:
+        #: a chunk whose fetch+detransform is in flight (delegate call
+        #: issued, cache entry not yet registered) has a Future[bytes]
+        #: here, so a concurrent reader JOINS the in-flight decode instead
+        #: of duplicating it. Critical for slow detransforms (tpu-lzhuff-v1
+        #: frames cost ~0.4 s/chunk on the host fallback, BENCH_r05's
+        #: 435 ms ranged-fetch p99): without the join, a foreground read
+        #: of a chunk the prefetch was already decoding re-decoded it from
+        #: scratch while contending for the same cores.
+        self._inflight: dict[ChunkKey, "concurrent.futures.Future[bytes]"] = {}
+        self._inflight_lock = new_lock("chunk_cache.ChunkCache._inflight_lock")
+        #: Readers that joined another reader's in-flight chunk load.
+        self.inflight_joins = 0
 
     # ------------------------------------------------------------------ setup
     def configure(self, configs: Mapping[str, Any]) -> None:
@@ -160,8 +174,23 @@ class ChunkCache(ChunkManager, Generic[T], abc.ABC):
         fallback: list[int] = []
         for cid in chunk_ids:
             chunk_key = ChunkKey.of(objects_key, cid)
+            kind, future = futures[cid]
+            if kind == "bytes":
+                # Joined another reader's in-flight fetch+detransform (most
+                # often the async prefetch): the future resolves straight to
+                # plaintext bytes. A wedged or failed owner must not fail
+                # THIS read — degrade to a direct fetch, where the
+                # authoritative error (if any) surfaces on our own call.
+                try:
+                    out[cid] = self._await(future, deadline, cid, objects_key)
+                except ChunkCacheTimeoutException:
+                    self.degradations += 1
+                    fallback.append(cid)
+                except Exception:
+                    fallback.append(cid)
+                continue
             try:
-                value = self._await(futures[cid], deadline, cid, objects_key)
+                value = self._await(future, deadline, cid, objects_key)
             except ChunkCacheTimeoutException:
                 # Another reader's wedged population (the delegate fetch of
                 # THIS window is bounded separately in _populate_window) must
@@ -221,46 +250,105 @@ class ChunkCache(ChunkManager, Generic[T], abc.ABC):
         manifest: SegmentManifestV1,
         chunk_ids: Sequence[int],
         deadline: Optional[float],
-    ) -> dict[int, "concurrent.futures.Future[T]"]:
-        """Batch-fetch every not-yet-cached chunk of the window with ONE
-        delegate call, then register per-chunk cache loaders that only persist
-        the already-fetched bytes (no network under an executor lock).
-        Single-flight per chunk is preserved: if another thread registered a
-        key first, get_future returns that load and our bytes go unused.
+    ) -> dict[int, tuple[str, "concurrent.futures.Future"]]:
+        """Batch-fetch every not-yet-cached, not-yet-in-flight chunk of the
+        window with ONE delegate call, then register per-chunk cache loaders
+        that only persist the already-fetched bytes (no network under an
+        executor lock). Returns cid -> ("cache", Future[T]) for cached/owned
+        chunks and cid -> ("bytes", Future[bytes]) for chunks joined from
+        another reader's in-flight load (single-flight: the prefetch and
+        concurrent readers share one fetch+detransform per chunk; joiners
+        never wait on more than the owner's sub-window).
 
         With a deadline (synchronous reads) the delegate fetch runs on the
         pool and is awaited with the remaining budget, so `get.timeout.ms`
-        bounds a hung storage backend; without one (prefetch — already on a
-        pool worker) it runs inline."""
+        bounds a hung storage backend — on timeout the flight stays
+        registered and resolves when the delegate returns, so later readers
+        still join it instead of piling on. Without a deadline (prefetch —
+        already on a pool worker) the fetch runs inline."""
+        futures: dict[int, tuple[str, "concurrent.futures.Future"]] = {}
         missing: list[int] = []
-        futures: dict[int, "concurrent.futures.Future[T]"] = {}
         for cid in chunk_ids:
-            present = self._cache.peek(ChunkKey.of(objects_key, cid))
+            key = ChunkKey.of(objects_key, cid)
+            present = self._cache.peek(key)
             if present is not None:
-                futures[cid] = present
-                self._cache.get_if_present(ChunkKey.of(objects_key, cid))  # hit + recency
+                futures[cid] = ("cache", present)
+                self._cache.get_if_present(key)  # hit + recency
             else:
                 missing.append(cid)
+        own: list[int] = []
         if missing:
+            with self._inflight_lock:
+                for cid in missing:
+                    key = ChunkKey.of(objects_key, cid)
+                    flight = self._inflight.get(key)
+                    if flight is not None:
+                        futures[cid] = ("bytes", flight)
+                        self.inflight_joins += 1
+                    else:
+                        self._inflight[key] = concurrent.futures.Future()
+                        own.append(cid)
+        if own:
             if deadline is None:
-                fetched_list = self._delegate.get_chunks(objects_key, manifest, missing)
+                futures.update(
+                    self._load_owned(objects_key, manifest, own)
+                )
             else:
                 task = self._executor.submit(
-                    self._delegate.get_chunks, objects_key, manifest, missing
+                    self._load_owned, objects_key, manifest, own
                 )
                 try:
-                    fetched_list = task.result(max(0.0, deadline - time.monotonic()))
+                    futures.update(
+                        task.result(max(0.0, deadline - time.monotonic()))
+                    )
                 except concurrent.futures.TimeoutError:
-                    task.cancel()
                     raise ChunkCacheTimeoutException(
-                        f"Fetching chunks {missing} of {objects_key} timed out"
+                        f"Fetching chunks {own} of {objects_key} timed out"
                     ) from None
-            for cid, data in zip(missing, fetched_list):
-                key = ChunkKey.of(objects_key, cid)
-                futures[cid] = self._cache.get_future(
-                    key, lambda k=key, d=data: self.cache_chunk(k, d)
-                )
         return futures
+
+    def _load_owned(
+        self, objects_key: ObjectKey, manifest: SegmentManifestV1, own: list[int]
+    ) -> dict[int, tuple[str, "concurrent.futures.Future"]]:
+        """Fetch+detransform the owned chunks with one delegate call, then
+        register cache loaders and resolve the in-flight futures (success or
+        error) so joiners wake — runs to completion even when the submitting
+        reader's window deadline has already expired."""
+        try:
+            fetched = self._delegate.get_chunks(objects_key, manifest, own)
+        except BaseException as e:
+            self._finish_flights(objects_key, own, None, e)
+            raise
+        futures: dict[int, tuple[str, "concurrent.futures.Future"]] = {}
+        for cid, data in zip(own, fetched):
+            key = ChunkKey.of(objects_key, cid)
+            futures[cid] = ("cache", self._cache.get_future(
+                key, lambda k=key, d=data: self.cache_chunk(k, d)
+            ))
+        # Resolve flights AFTER the cache entries exist, so a reader that
+        # misses the flight window finds the chunk in the cache.
+        self._finish_flights(objects_key, own, dict(zip(own, fetched)), None)
+        return futures
+
+    def _finish_flights(
+        self,
+        objects_key: ObjectKey,
+        own: list[int],
+        results: Optional[dict[int, bytes]],
+        error: Optional[BaseException],
+    ) -> None:
+        popped: list[tuple[int, "concurrent.futures.Future"]] = []
+        with self._inflight_lock:
+            for cid in own:
+                flight = self._inflight.pop(ChunkKey.of(objects_key, cid), None)
+                if flight is not None:
+                    popped.append((cid, flight))
+        # Wake joiners outside the lock.
+        for cid, flight in popped:
+            if error is not None:
+                flight.set_exception(error)
+            else:
+                flight.set_result(results[cid])
 
     # --------------------------------------------------------------- prefetch
     def _start_prefetching(
@@ -293,13 +381,25 @@ class ChunkCache(ChunkManager, Generic[T], abc.ABC):
     ) -> None:
         """Isolation boundary: a failed prefetch is counted, never raised —
         and the LoadingCache drops failed loads, so the entries stay clean
-        for the next foreground get."""
+        for the next foreground get.
+
+        The range is decoded in `prefetch.window.chunks`-sized sub-windows
+        rather than one monolithic batch: each sub-window's chunks become
+        servable (cache entries + resolved flights) as soon as IT finishes,
+        and a foreground read that joins an in-flight prefetch chunk waits
+        for one sub-window's fetch+detransform, not the whole prefetch
+        range — which is what keeps slow decodes (tpu-lzhuff-v1) from
+        poisoning ranged-fetch p99."""
         try:
             # Prefetch runs on a pool worker: its spans are roots of their own
             # trace (the requesting thread's context is deliberately not
             # captured — the prefetch outlives the request).
+            window = self._config.prefetch_window_chunks or len(ids)
             with self.tracer.span("cache.prefetch", chunks=len(ids)):
-                self._populate_window(objects_key, manifest, ids, None)
+                for i in range(0, len(ids), max(1, window)):
+                    self._populate_window(
+                        objects_key, manifest, ids[i : i + max(1, window)], None
+                    )
         except Exception:
             self.prefetch_failures += 1
             self.tracer.event("cache.prefetch_failure", chunks=len(ids))

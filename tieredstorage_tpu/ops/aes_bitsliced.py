@@ -463,17 +463,15 @@ def _forced_crosscheck_ok() -> bool:
     return _forced_crosscheck_ok()
 
 
-def _use_pallas_circuit(n_words: int) -> bool:
-    """Route the cipher through the fused Pallas kernel on real TPUs.
+def pallas_aes_available() -> bool:
+    """Platform half of the kernel gate: can (or must) the kernel run here?
 
-    The XLA lowering of the circuit round-trips every gate through HBM
-    (0.66 GiB/s measured, PROFILE.md); the Pallas kernel keeps the planes in
-    VMEM. CPU (tests, virtual meshes) keeps the XLA path — Mosaic interpret
-    mode is orders slower to compile there. TIEREDSTORAGE_TPU_PALLAS=0/1
-    overrides the gate, but is read at trace time: set it before the first
-    call for a given (batch, chunk) shape, or the cached executable wins.
-    First TPU use preflights the kernel on a minimal tile and falls back to
-    the XLA circuit if Mosaic can't lower or run it on this platform."""
+    CPU (tests, virtual meshes) keeps the XLA path — Mosaic interpret mode
+    is orders slower to compile there. TIEREDSTORAGE_TPU_PALLAS=0/1
+    overrides, but is read at trace time: set it before the first call for
+    a given (batch, chunk) shape, or the cached executable wins. First TPU
+    use preflights the kernel on a minimal tile and falls back to the XLA
+    circuit if Mosaic can't lower or run it on this platform."""
     import os
 
     forced = os.environ.get("TIEREDSTORAGE_TPU_PALLAS")
@@ -484,14 +482,30 @@ def _use_pallas_circuit(n_words: int) -> bool:
         # cross-check itself — a mistiled TSTPU_AES_R fails loud here
         # instead of corrupting keystream silently.
         return _forced_crosscheck_ok()
-    if n_words < 1024:  # one kernel step; smaller batches aren't worth a pad
-        return False
     try:
         if jax.default_backend() not in ("tpu", "axon"):
             return False
     except Exception:
         return False
     return _pallas_preflight_ok()
+
+
+def _use_pallas_circuit(n_words: int) -> bool:
+    """Route the cipher through the fused Pallas kernel on real TPUs.
+
+    The XLA lowering of the circuit round-trips every gate through HBM
+    (0.66 GiB/s measured, PROFILE.md); the Pallas kernel keeps the planes
+    in VMEM. Split gate: `aes_pallas.use_pallas_aes` is the pure-host shape
+    eligibility (asserted on CPU by bench/CI), `pallas_aes_available` the
+    platform/preflight half. A forced TIEREDSTORAGE_TPU_PALLAS=1 overrides
+    the shape floor too — probes dispatch tiny tiles on purpose."""
+    import os
+
+    from tieredstorage_tpu.ops.aes_pallas import use_pallas_aes
+
+    if os.environ.get("TIEREDSTORAGE_TPU_PALLAS") is not None:
+        return pallas_aes_available()
+    return use_pallas_aes(n_words) and pallas_aes_available()
 
 
 def ctr_keystream_batch(
@@ -535,16 +549,11 @@ def ctr_keystream_batch(
     state = state.transpose(1, 2, 0, 3).reshape(16, 8, batch * w)
     n_words = batch * w
     if _use_pallas_circuit(n_words):
-        from tieredstorage_tpu.ops.aes_pallas import (
-            WORDS_PER_STEP,
-            aes_encrypt_planes_pallas,
-        )
+        from tieredstorage_tpu.ops.aes_pallas import aes_encrypt_planes_pallas
 
-        padded = -(-n_words // WORDS_PER_STEP) * WORDS_PER_STEP
-        if padded != n_words:
-            state = jnp.pad(state, ((0, 0), (0, 0), (0, padded - n_words)))
         # interpret off-TPU lets the forced path run (slowly) anywhere;
         # the probe degrades to interpret instead of aborting the trace.
+        # The op pads W to its own grid internally.
         import logging
 
         from tieredstorage_tpu.ops._preflight import interpret_off_device
@@ -555,7 +564,7 @@ def ctr_keystream_batch(
             interpret=interpret_off_device(
                 logging.getLogger(__name__), "Pallas AES circuit"
             ),
-        )[:, :, :n_words]
+        )
     else:
         out = aes_encrypt_planes(rk_planes, state)
     # Unpack to bytes: [16, 8, B, w] → [B, w*32, 16].

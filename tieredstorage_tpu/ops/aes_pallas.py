@@ -63,6 +63,20 @@ R = _validated_r(os.environ.get("TSTPU_AES_R", "8"))
 WORDS_PER_STEP = R * 128
 
 
+def use_pallas_aes(n_words: int) -> bool:
+    """Shape eligibility for the fused circuit kernel — pure host logic, no
+    platform probe, so benchmarks and CPU-only CI can assert that the
+    production window shapes tile onto the kernel (the platform half lives
+    in `aes_bitsliced.pallas_aes_available`).
+
+    `aes_encrypt_planes_pallas` zero-pads W to the WORDS_PER_STEP grid
+    internally, so eligibility is only a worth-it floor: at least 1024
+    words (512 KiB of keystream — below that the XLA circuit wins on
+    launch overhead) and at least half a grid step (so padding never more
+    than doubles the dispatched compute under a TSTPU_AES_R override)."""
+    return n_words >= 1024 and 2 * n_words >= WORDS_PER_STEP
+
+
 def _xtime_planes(x: list) -> list:
     """GF(2^8) multiply-by-x on 8 bit-planes (LSB-first bit index)."""
     return [
@@ -176,13 +190,17 @@ def aes_encrypt_planes_pallas(
 ) -> jnp.ndarray:
     """Encrypt a bitsliced state uint32[16, 8, W] with AES-256 in one kernel.
 
-    Drop-in for `aes_bitsliced.aes_encrypt_planes`; W must be a multiple of
-    WORDS_PER_STEP (callers zero-pad and slice). `interpret=True` runs the
+    Drop-in for `aes_bitsliced.aes_encrypt_planes`; W is zero-padded to the
+    WORDS_PER_STEP grid INSIDE the op and the result sliced back, so callers
+    dispatch production window shapes as-is. `interpret=True` runs the
     kernel op-by-op on CPU for tests."""
     w = state.shape[2]
-    if w % WORDS_PER_STEP:
-        raise ValueError(f"W={w} not a multiple of {WORDS_PER_STEP}")
-    steps = w // WORDS_PER_STEP
+    if w <= 0:
+        raise ValueError("W must be positive")
+    padded = -(-w // WORDS_PER_STEP) * WORDS_PER_STEP
+    if padded != w:
+        state = jnp.pad(state, ((0, 0), (0, 0), (0, padded - w)))
+    steps = padded // WORDS_PER_STEP
     st4 = state.reshape(16, 8, steps * R, 128)
     rk = rk_planes.reshape(_NR + 1, 128)
     out = pl.pallas_call(
@@ -196,4 +214,4 @@ def aes_encrypt_planes_pallas(
         out_shape=jax.ShapeDtypeStruct((16, 8, steps * R, 128), jnp.uint32),
         interpret=interpret,
     )(rk, st4)
-    return out.reshape(16, 8, w)
+    return out.reshape(16, 8, padded)[:, :, :w]

@@ -139,14 +139,14 @@ def _ghash_grouped(data_flat: jnp.ndarray, agg_mats: tuple) -> jnp.ndarray:
         )
     from tieredstorage_tpu.ops import ghash_pallas
 
-    if ghash_pallas.use_pallas_ghash(batch * g, k1 * 16):
+    if ghash_pallas.use_pallas_ghash(
+        batch * g, k1 * 16
+    ) and ghash_pallas.pallas_ghash_available():
         # In-kernel plane extraction: bytes cross HBM once instead of as
-        # 8 materialized int8 planes (ghash_pallas.py).
+        # 8 materialized int8 planes (ghash_pallas.py, which pads the row
+        # count to its own grid internally).
         rows = batch * g
         mat = data_flat.reshape(rows, k1 * 16)
-        padded = _ceil_div(rows, ghash_pallas.ROWS_PER_STEP) * ghash_pallas.ROWS_PER_STEP
-        if padded != rows:
-            mat = jnp.pad(mat, ((0, padded - rows), (0, 0)))
         # interpret off-TPU lets the forced path run (slowly) anywhere; the
         # backend probe can raise (like in the gates) and degrades to
         # interpret rather than aborting the trace (ops/_preflight.py).
@@ -160,7 +160,7 @@ def _ghash_grouped(data_flat: jnp.ndarray, agg_mats: tuple) -> jnp.ndarray:
             interpret=interpret_off_device(
                 logging.getLogger(__name__), "Pallas GHASH level 1"
             ),
-        )[:rows].reshape(batch, g, 128)
+        ).reshape(batch, g, 128)
     else:
         planes = jnp.stack(
             [(data_flat >> np.uint8(kbit)) & np.uint8(1) for kbit in range(8)]
@@ -247,6 +247,25 @@ def _gcm_process_batch(
     return output, tags
 
 
+# --- dispatch accounting ---
+
+#: Device-program launches issued by this module's public entry points.
+#: The transform backend reads deltas around each window, which makes the
+#: "one fused dispatch per window" invariant testable without a TPU (the
+#: counter is a single int mutated under the GIL by the one dispatching
+#: thread; readers only ever need a snapshot).
+_DISPATCHES = [0]
+
+
+def device_dispatches() -> int:
+    """Total GCM device-program launches issued so far in this process."""
+    return _DISPATCHES[0]
+
+
+def _count_dispatch() -> None:
+    _DISPATCHES[0] += 1
+
+
 # Device-resident copies of each context's constant arrays, uploaded once
 # per context instead of once per window call (the round keys, GHASH level
 # matrices, and folded constants are identical for every window of a
@@ -281,6 +300,7 @@ def gcm_encrypt_chunks(ctx: GcmContext, ivs: np.ndarray, plaintext: np.ndarray):
     """plaintext uint8[B, ctx.chunk_bytes], ivs uint8[B,12] ->
     (ciphertext uint8[B, chunk_bytes], tags uint8[B,16])."""
     round_keys, agg_mats, final_mat, const_bits = _device_consts(ctx)
+    _count_dispatch()
     ct, tags = _gcm_process_batch(
         round_keys,
         jnp.asarray(ivs, dtype=jnp.uint8),
@@ -433,6 +453,7 @@ def _host_len_blocks(ctx: GcmVarlenContext, lengths: np.ndarray) -> np.ndarray:
 def _run_varlen(ctx: GcmVarlenContext, ivs, data, lengths, decrypt: bool):
     lengths = np.asarray(lengths, dtype=np.int32)
     round_keys, aad_blocks, agg_mats, h_mat = _device_consts(ctx)
+    _count_dispatch()
     return _gcm_varlen_batch(
         round_keys,
         jnp.asarray(ivs, dtype=jnp.uint8),
@@ -467,6 +488,7 @@ def gcm_decrypt_chunks(ctx: GcmContext, ivs: np.ndarray, ciphertext: np.ndarray)
     comparison is not required server-side here, but verification is
     mandatory — the TPU transform backend raises on mismatch)."""
     round_keys, agg_mats, final_mat, const_bits = _device_consts(ctx)
+    _count_dispatch()
     return _gcm_process_batch(
         round_keys,
         jnp.asarray(ivs, dtype=jnp.uint8),
@@ -477,6 +499,169 @@ def gcm_decrypt_chunks(ctx: GcmContext, ivs: np.ndarray, ciphertext: np.ndarray)
         chunk_bytes=ctx.chunk_bytes,
         n_blocks=ctx.n_blocks,
         decrypt=True,
+    )
+
+
+# --- fused single-dispatch windows (the production transform path) ---
+#
+# One jit executable per window: CTR keystream -> XOR -> GHASH -> tag fold
+# in a single device program whose ONE output buffer packs `output || tag`
+# per row. On the measured harness every extra launch or fetch pays a
+# ~62 ms size-independent floor (PROFILE.md), so the window path dispatches
+# once and fetches once per window. The input is staged in the same packed
+# shape uint8[B, n_bytes + TAG_SIZE] (tail bytes ignored — on decrypt they
+# can simply carry the received tag), which makes the output shape
+# identical to the input's so XLA can DONATE the staged buffer into the
+# result: steady-state windows reuse one HBM allocation instead of
+# allocating input + output per window.
+#
+# Passing ivs=None (and for varlen lengths=None) switches the per-row
+# metadata to ride IN the packed tail — [iv 12 B][length u32 LE 4 B] after
+# the payload columns — so a window crosses the host→device link as ONE
+# buffer: no side transfers for IVs, lengths, or length blocks (the GCM
+# length block is then rebuilt in-graph, bit-identical to
+# `_host_len_blocks`).
+
+
+def _packed_fixed_impl(
+    round_keys, ivs, data_packed, agg_mats, final_mat, const_bits,
+    *, chunk_bytes: int, n_blocks: int, decrypt: bool,
+):
+    if ivs is None:  # trace-time branch: IVs ride the packed tail
+        ivs = data_packed[:, chunk_bytes : chunk_bytes + 12]
+    out, tags = _gcm_process_batch(
+        round_keys, ivs, data_packed[:, :chunk_bytes], agg_mats, final_mat,
+        const_bits, chunk_bytes=chunk_bytes, n_blocks=n_blocks, decrypt=decrypt,
+    )
+    return jnp.concatenate([out, tags], axis=1)
+
+
+def _device_len_blocks(lengths: jnp.ndarray, aad_bit_len: int) -> jnp.ndarray:
+    """uint8[B, 16] GCM length blocks built in-graph — bit-identical to
+    `_host_len_blocks` (64-bit big-endian AAD and ciphertext bit lengths)
+    without needing x64: big-endian byte j of (lengths * 8) is
+    lengths >> (8*(7-j) - 3), and the bytes whose shift would overflow
+    int32 are zero for any length below 2^37 bytes (chunks are capped two
+    orders below that)."""
+    batch = lengths.shape[0]
+    aad_half = jnp.broadcast_to(
+        jnp.asarray(
+            np.frombuffer(int(aad_bit_len).to_bytes(8, "big"), dtype=np.uint8)
+        ),
+        (batch, 8),
+    )
+    cols = []
+    for j in range(8):
+        shift = 8 * (7 - j) - 3
+        if shift >= 31:
+            cols.append(jnp.zeros((batch,), jnp.uint8))
+        elif shift >= 0:
+            cols.append(((lengths >> shift) & 0xFF).astype(jnp.uint8))
+        else:
+            cols.append(((lengths & 0x1F) << 3).astype(jnp.uint8))
+    return jnp.concatenate([aad_half, jnp.stack(cols, axis=1)], axis=1)
+
+
+def _packed_varlen_impl(
+    round_keys, ivs, data_packed, lengths, len_blocks, aad_blocks, agg_mats,
+    h_mat, *, aad_bit_len: int, max_bytes: int, m_max: int, m_a: int,
+    m_cap: int, decrypt: bool,
+):
+    if ivs is None:
+        ivs = data_packed[:, max_bytes : max_bytes + 12]
+    if lengths is None:
+        lb = data_packed[:, max_bytes + 12 : max_bytes + 16].astype(jnp.int32)
+        lengths = lb[:, 0] | (lb[:, 1] << 8) | (lb[:, 2] << 16) | (lb[:, 3] << 24)
+    if len_blocks is None:
+        len_blocks = _device_len_blocks(lengths, aad_bit_len)
+    out, tags = _gcm_varlen_batch(
+        round_keys, ivs, data_packed[:, :max_bytes], lengths, len_blocks,
+        aad_blocks, agg_mats, h_mat, max_bytes=max_bytes, m_max=m_max,
+        m_a=m_a, m_cap=m_cap, decrypt=decrypt,
+    )
+    return jnp.concatenate([out, tags], axis=1)
+
+
+@functools.lru_cache(maxsize=4)
+def _packed_jit(varlen: bool, donate: bool):
+    fn = _packed_varlen_impl if varlen else _packed_fixed_impl
+    static = (
+        ("aad_bit_len", "max_bytes", "m_max", "m_a", "m_cap", "decrypt")
+        if varlen
+        else ("chunk_bytes", "n_blocks", "decrypt")
+    )
+    return jax.jit(
+        fn, static_argnames=static, donate_argnums=(2,) if donate else ()
+    )
+
+
+def gcm_window_packed(
+    ctx: GcmContext,
+    ivs,
+    data_packed,
+    *,
+    decrypt: bool,
+    donate: bool = False,
+):
+    """Fused fixed-size window: data_packed uint8[B, chunk_bytes + 16] ->
+    packed uint8[B, chunk_bytes + 16] where row i is `output_i || tag_i` —
+    one device dispatch, one output buffer. With ivs=None the per-row IV
+    is read from the packed tail (bytes [chunk_bytes, chunk_bytes+12));
+    otherwise the tail columns are ignored. The tag is over the ciphertext
+    in both directions (expected tag on decrypt; the caller verifies).
+    `donate=True` hands the staged input buffer to XLA for reuse as the
+    output — the caller must not touch data_packed afterwards."""
+    round_keys, agg_mats, final_mat, const_bits = _device_consts(ctx)
+    _count_dispatch()
+    return _packed_jit(False, donate)(
+        round_keys,
+        None if ivs is None else jnp.asarray(ivs, dtype=jnp.uint8),
+        jnp.asarray(data_packed, dtype=jnp.uint8),
+        agg_mats,
+        final_mat,
+        const_bits,
+        chunk_bytes=ctx.chunk_bytes,
+        n_blocks=ctx.n_blocks,
+        decrypt=decrypt,
+    )
+
+
+def gcm_varlen_window_packed(
+    ctx: GcmVarlenContext,
+    ivs,
+    data_packed,
+    lengths,
+    *,
+    decrypt: bool,
+    donate: bool = False,
+):
+    """Fused variable-length window: data_packed uint8[B, max_bytes + 16]
+    (rows left-aligned with a ZERO payload tail — GHASH requires it) ->
+    packed uint8[B, max_bytes + 16] = `masked output || tag` per row. With
+    ivs=None and lengths=None the per-row metadata rides the packed tail
+    ([iv 12 B][length u32 LE 4 B] at columns [max_bytes, max_bytes+16))
+    and the GCM length blocks are rebuilt in-graph, so the whole window is
+    ONE host→device buffer. Same single-dispatch/donation contract as
+    `gcm_window_packed`."""
+    if lengths is not None:
+        lengths = np.asarray(lengths, dtype=np.int32)
+    round_keys, aad_blocks, agg_mats, h_mat = _device_consts(ctx)
+    _count_dispatch()
+    return _packed_jit(True, donate)(
+        round_keys,
+        None if ivs is None else jnp.asarray(ivs, dtype=jnp.uint8),
+        jnp.asarray(data_packed, dtype=jnp.uint8),
+        None if lengths is None else jnp.asarray(lengths),
+        None if lengths is None else jnp.asarray(_host_len_blocks(ctx, lengths)),
+        aad_blocks,
+        agg_mats,
+        h_mat,
+        aad_bit_len=ctx.aad_bit_len,
+        max_bytes=ctx.max_bytes,
+        m_max=ctx.m_max,
+        m_a=ctx.aad_blocks.shape[0],
+        m_cap=ctx.m_cap,
+        decrypt=decrypt,
     )
 
 

@@ -80,18 +80,26 @@ def _preflight_ok() -> bool:
 
 
 def use_pallas_ghash(rows: int, k: int) -> bool:
-    """Route level 1 through the kernel on real TPUs for well-tiled shapes.
+    """Shape eligibility for the level-1 kernel — pure host logic, no
+    platform probe, so benchmarks and CPU-only CI can assert that the
+    production window shapes tile onto the kernel. K must tile the 128-lane
+    minor dimension and the row count must fill at least one grid step
+    (`ghash_level1_pallas` pads shorter remainders internally; a sub-step
+    batch would waste more than half the padded compute). The dispatch
+    decision is `use_pallas_ghash(...) and pallas_ghash_available()` —
+    shape preconditions hold regardless of forcing: an un-tiled K would
+    fail Mosaic lowering, so forcing only overrides the platform check and
+    the preflight, never validity."""
+    return k > 0 and k % 128 == 0 and rows >= ROWS_PER_STEP
+
+
+def pallas_ghash_available() -> bool:
+    """Platform half of the gate: can (or must) the kernel run here?
 
     TIEREDSTORAGE_TPU_PALLAS_GHASH=0/1 overrides (read at trace time, like
-    the AES gate); K must tile the 128-lane minor dimension and the row
-    count must fill at least one grid step."""
+    the AES gate); otherwise real TPUs only, preflight-verified."""
     import os
 
-    # Shape preconditions hold regardless of forcing: an un-tiled K would
-    # fail Mosaic lowering, so forcing only overrides the platform check
-    # and the preflight, never validity.
-    if k % 128 or rows < ROWS_PER_STEP:
-        return False
     forced = os.environ.get("TIEREDSTORAGE_TPU_PALLAS_GHASH")
     if forced is not None:
         return forced not in ("0", "false", "off")
@@ -123,18 +131,23 @@ def _ghash_l1_kernel(x_ref, w_ref, o_ref):
 def ghash_level1_pallas(
     data: jnp.ndarray, w1: jnp.ndarray, *, interpret: bool = False
 ) -> jnp.ndarray:
-    """data uint8[R, K] (R a multiple of ROWS_PER_STEP, K the level-1 group
-    byte width), w1 int8[8, K, 128] -> node bits int8[R, 128].
+    """data uint8[R, K] (K the level-1 group byte width),
+    w1 int8[8, K, 128] -> node bits int8[R, 128].
 
     Bit-exact drop-in for the XLA plane-stack + dot_general level 1 in
-    `gcm._ghash_grouped`; callers pad R and slice the result."""
+    `gcm._ghash_grouped`. R is padded to the ROWS_PER_STEP grid INSIDE the
+    op (zero rows contract to zero node bits) and the result sliced back,
+    so callers dispatch production window shapes as-is."""
     rows, k = data.shape
-    if rows % ROWS_PER_STEP:
-        raise ValueError(f"rows={rows} not a multiple of {ROWS_PER_STEP}")
+    if rows <= 0:
+        raise ValueError("rows must be positive")
     if w1.shape != (8, k, 128):
         raise ValueError(f"weights {w1.shape} do not match K={k}")
-    steps = rows // ROWS_PER_STEP
-    return pl.pallas_call(
+    padded = -(-rows // ROWS_PER_STEP) * ROWS_PER_STEP
+    if padded != rows:
+        data = jnp.pad(data, ((0, padded - rows), (0, 0)))
+    steps = padded // ROWS_PER_STEP
+    out = pl.pallas_call(
         _ghash_l1_kernel,
         grid=(steps,),
         in_specs=[
@@ -142,6 +155,7 @@ def ghash_level1_pallas(
             pl.BlockSpec((8, k, 128), lambda s: (0, 0, 0)),
         ],
         out_specs=pl.BlockSpec((ROWS_PER_STEP, 128), lambda s: (s, 0)),
-        out_shape=jax.ShapeDtypeStruct((rows, 128), jnp.int8),
+        out_shape=jax.ShapeDtypeStruct((padded, 128), jnp.int8),
         interpret=interpret,
     )(data, w1)
+    return out[:rows]

@@ -2,16 +2,23 @@
 TPU-native codec lands), pluggable at `transform.backend.class`.
 
 The point of the framework (BASELINE north star): whole windows of chunks are
-shipped to the device as uint8[batch, chunk_size] arrays and encrypted/
-decrypted by the vmapped AES-CTR + MXU-GHASH kernels (ops/gcm.py), with the
-per-chunk IV array generated host-side and the chunk batch optionally sharded
-across a device mesh (parallel/mesh.py). Wire format is identical to the CPU
-backend and the reference: per-chunk zstd frame (content size pledged), then
+shipped to the device as ONE packed uint8[batch, n_bytes + 16] buffer
+(per-row IV/length metadata riding the tail columns) and encrypted/decrypted
+by a SINGLE fused GCM dispatch per window — keystream, XOR, GHASH and tag
+fold in one device program whose one output buffer packs `output || tag`
+per row (ops/gcm.py packed window ops; the AES circuit and GHASH level 1
+run as Pallas kernels on real TPUs). One window therefore costs one
+host→device transfer, one launch, one device→host fetch — the ~62 ms
+per-launch floor of the measured harness is paid once per 64 MiB window
+(PROFILE.md), with the chunk batch optionally sharded across a device mesh
+(parallel/mesh.py). Wire format is identical to the CPU backend and the
+reference: per-chunk zstd frame (content size pledged), then
 IV || ciphertext || tag.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import hmac
 import os
@@ -27,11 +34,10 @@ except ImportError:  # pragma: no cover - exercised only without zstandard
     zstandard = None
 
 from tieredstorage_tpu import native
+from tieredstorage_tpu.ops import gcm as gcm_ops
 from tieredstorage_tpu.ops.gcm import (
-    gcm_decrypt_chunks,
-    gcm_decrypt_varlen,
-    gcm_encrypt_chunks,
-    gcm_encrypt_varlen,
+    gcm_varlen_window_packed,
+    gcm_window_packed,
     make_context,
     make_varlen_context,
 )
@@ -81,6 +87,41 @@ def _spanned(name: str, count=len, n_bytes=None):
     return deco
 
 
+@dataclasses.dataclass
+class DispatchStats:
+    """Per-backend device-interaction counters for the window path.
+
+    The steady-state invariant this makes testable WITHOUT a TPU: one
+    window costs exactly one host→device staging transfer, ONE fused
+    device dispatch (keystream → XOR → GHASH → tag in a single program —
+    `ops/gcm.py` packed window ops), and one device→host fetch. Every
+    extra launch or fetch pays a size-independent ~62 ms floor on the
+    measured harness (PROFILE.md), so launch-count regressions are
+    throughput regressions; bench.py reports `dispatches_per_window` and
+    `bytes_per_dispatch` from these counters next to the GiB/s numbers.
+    Mutated only from the dispatching thread (the transform generator)."""
+
+    windows: int = 0
+    dispatches: int = 0
+    h2d_transfers: int = 0
+    d2h_fetches: int = 0
+    bytes_in: int = 0
+
+    @property
+    def dispatches_per_window(self) -> float:
+        return round(self.dispatches / self.windows, 3) if self.windows else 0.0
+
+    @property
+    def bytes_per_dispatch(self) -> int:
+        return int(self.bytes_in / self.dispatches) if self.dispatches else 0
+
+    def as_dict(self) -> dict:
+        out = dataclasses.asdict(self)
+        out["dispatches_per_window"] = self.dispatches_per_window
+        out["bytes_per_dispatch"] = self.bytes_per_dispatch
+        return out
+
+
 class TpuTransformBackend(TransformBackend):
     preferred_batch_chunks = 256
     # Window byte cap: with pipeline_depth=3 up to 4 windows are in flight
@@ -92,6 +133,13 @@ class TpuTransformBackend(TransformBackend):
     def __init__(self, mesh=None):
         self._mesh = mesh
         self._pool: Optional[ThreadPoolExecutor] = None
+        self.dispatch_stats = DispatchStats()
+
+    def reset_dispatch_stats(self) -> DispatchStats:
+        """Swap in fresh counters; returns the retired snapshot."""
+        retired = self.dispatch_stats
+        self.dispatch_stats = DispatchStats()
+        return retired
 
     def configure(self, configs: dict) -> None:
         if "batch.chunks" in configs:
@@ -133,10 +181,19 @@ class TpuTransformBackend(TransformBackend):
     pipeline_depth = 3
 
     def transform_windows(self, windows, opts: TransformOptions):
-        """Pipelined staging (SURVEY §7 step 5): JAX dispatch is async —
-        `_encrypt_dispatch` returns un-materialized device arrays and starts
-        their device→host copies; `_encrypt_finish` (pipeline_depth windows
-        later) blocks on them."""
+        """Double-buffered pipelined staging (SURVEY §7 step 5): JAX
+        dispatch is async, so `_encrypt_dispatch` only ENQUEUES window k's
+        work — one `device_put` of the packed host buffer, one fused GCM
+        program (donating that buffer as its output allocation), and the
+        `copy_to_host_async` of the result — and returns un-materialized.
+        With `pipeline_depth` staged windows in flight, window k+1's
+        host→device transfer overlaps window k's compute and window
+        k−1's device→host materialization; only `_encrypt_finish`
+        (pipeline_depth windows later) blocks, on the oldest window's
+        single packed buffer. Steady-state cost is max(stage times), not
+        their sum, and each window pays the per-launch floor exactly once
+        (DispatchStats counts launches/transfers per window to keep the
+        invariant testable without a TPU)."""
         if opts.encryption is None:
             # Compression-only is host-bound: nothing to overlap against.
             for window in windows:
@@ -215,60 +272,109 @@ class TpuTransformBackend(TransformBackend):
             )
         return np.frombuffer(os.urandom(IV_SIZE * n), dtype=np.uint8).reshape(n, IV_SIZE)
 
+    def _build_packed(
+        self, payloads: list, sizes: list[int], ivs: np.ndarray, n_bytes: int,
+        varlen: bool,
+    ) -> np.ndarray:
+        """One packed host window uint8[B, n_bytes + 16]: left-aligned
+        payload rows (zero tail — varlen GHASH requires it) with the
+        per-row metadata the fused kernel reads from the tail columns
+        ([iv 12 B][length u32 LE 4 B]), so the whole window crosses the
+        host→device link as a single buffer."""
+        packed = np.zeros((len(payloads), n_bytes + TAG_SIZE), dtype=np.uint8)
+        for i, p in enumerate(payloads):
+            packed[i, : sizes[i]] = np.frombuffer(p, dtype=np.uint8)
+        packed[:, n_bytes : n_bytes + IV_SIZE] = ivs
+        if varlen:
+            packed[:, n_bytes + IV_SIZE :] = (
+                np.asarray(sizes, dtype="<u4").view(np.uint8).reshape(-1, 4)
+            )
+        return packed
+
+    def _stage_packed(self, packed: np.ndarray, varlen: bool):
+        """Mesh-pad and ship one packed window to the device — the single
+        host→device transfer of the window path (h2d counter)."""
+        import jax
+
+        n_bytes = packed.shape[1] - TAG_SIZE
+        pad = pad_batch(packed.shape[0], self._mesh)
+        if pad:
+            pad_rows = np.zeros((pad, packed.shape[1]), np.uint8)
+            if varlen:
+                # Degenerate zero-length rows are excluded by the varlen
+                # contract; padding rows carry one block like real callers.
+                pad_rows[:, n_bytes + IV_SIZE] = 16
+            packed = np.concatenate([packed, pad_rows])
+        staged = (
+            shard_rows(self._mesh, packed)
+            if self._mesh is not None
+            else jax.device_put(packed)
+        )
+        self.dispatch_stats.h2d_transfers += 1
+        return staged
+
+    def _launch_packed(self, ctx, staged, varlen: bool, *, decrypt: bool):
+        """ONE fused device dispatch for a staged window (keystream → XOR →
+        GHASH → tag in a single program, `output || tag` packed into a
+        single buffer), with the staged buffer donated back to XLA as the
+        output allocation on the unsharded steady-state path. Starts the
+        device→host copy immediately so the result streams back while
+        later windows compute."""
+        before = gcm_ops.device_dispatches()
+        if varlen:
+            out = gcm_varlen_window_packed(
+                ctx, None, staged, None, decrypt=decrypt,
+                donate=self._mesh is None,
+            )
+        else:
+            out = gcm_window_packed(
+                ctx, None, staged, decrypt=decrypt, donate=self._mesh is None,
+            )
+        self.dispatch_stats.dispatches += gcm_ops.device_dispatches() - before
+        try:
+            out.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass  # non-jax arrays (mocked backends) / platforms without it
+        return out
+
     @_spanned("transform.encrypt_dispatch")
     def _encrypt_dispatch(self, chunks: list[bytes], opts: TransformOptions):
-        """Stage a window: build host arrays, dispatch the GCM kernel
-        asynchronously, return (ivs, sizes, device ct, device tags)."""
+        """Stage and launch a window: build ONE packed host array, ship it
+        with one device_put, issue ONE fused GCM dispatch, start the
+        device→host copy; returns the un-materialized staged window."""
         enc = opts.encryption
         sizes = [len(c) for c in chunks]
         ivs = self._make_ivs(len(chunks), opts)
 
-        if len(set(sizes)) == 1:
-            ctx = make_context(enc.data_key, enc.aad, sizes[0])
-            data = np.stack([np.frombuffer(c, dtype=np.uint8) for c in chunks])
-            data, ivs_padded, pad = self._maybe_shard(data, ivs)
-            ct, tags = gcm_encrypt_chunks(ctx, ivs_padded, data)
+        varlen = len(set(sizes)) != 1
+        if varlen:
+            ctx = make_varlen_context(enc.data_key, enc.aad, max(sizes))
+            n_bytes = ctx.max_bytes
         else:
-            max_bytes = max(sizes)
-            ctx = make_varlen_context(enc.data_key, enc.aad, max_bytes)
-            data = np.zeros((len(chunks), ctx.max_bytes), dtype=np.uint8)
-            for i, c in enumerate(chunks):
-                data[i, : len(c)] = np.frombuffer(c, dtype=np.uint8)
-            lengths = np.asarray(sizes, dtype=np.int32)
-            data, ivs_padded, pad = self._maybe_shard(data, ivs)
-            if pad:
-                lengths = np.concatenate([lengths, np.full(pad, 16, np.int32)])
-            ct, tags = gcm_encrypt_varlen(ctx, ivs_padded, data, lengths)
-        # Start the device->host copies now so the relay streams this
-        # window's ciphertext back while later windows compute.
-        for arr in (ct, tags):
-            try:
-                arr.copy_to_host_async()
-            except (AttributeError, RuntimeError):
-                pass  # non-jax arrays (mocked backends) / platforms without it
-        return ivs, sizes, ct, tags
+            ctx = make_context(enc.data_key, enc.aad, sizes[0])
+            n_bytes = ctx.chunk_bytes
+        packed = self._build_packed(chunks, sizes, ivs, n_bytes, varlen)
+        staged = self._stage_packed(packed, varlen)
+        out = self._launch_packed(ctx, staged, varlen, decrypt=False)
+        self.dispatch_stats.windows += 1
+        self.dispatch_stats.bytes_in += sum(sizes)
+        return ivs, sizes, n_bytes, out
 
     @_spanned("transform.encrypt_finish", count=lambda staged: len(staged[1]),
               n_bytes=lambda staged: sum(staged[1]))
     def _encrypt_finish(self, staged) -> list[bytes]:
-        """Block on a staged window's device arrays and materialize the wire
-        format (IV || ct || tag per chunk)."""
-        ivs, sizes, ct, tags = staged
-        ct, tags = np.asarray(ct), np.asarray(tags)
+        """Block on a staged window's single packed device buffer (one
+        device→host fetch) and materialize the wire format
+        (IV || ct || tag per chunk)."""
+        ivs, sizes, n_bytes, out = staged
+        host = np.asarray(out)
+        self.dispatch_stats.d2h_fetches += 1
         return [
-            ivs[i].tobytes() + ct[i, : sizes[i]].tobytes() + tags[i].tobytes()
+            ivs[i].tobytes()
+            + host[i, : sizes[i]].tobytes()
+            + host[i, n_bytes:].tobytes()
             for i in range(len(sizes))
         ]
-
-    def _maybe_shard(self, data: np.ndarray, ivs: np.ndarray):
-        pad = pad_batch(data.shape[0], self._mesh)
-        if pad:
-            data = np.concatenate([data, np.zeros((pad,) + data.shape[1:], np.uint8)])
-            ivs = np.concatenate([ivs, np.zeros((pad, IV_SIZE), np.uint8)])
-        if self._mesh is not None:
-            data = shard_rows(self._mesh, data)
-            ivs = shard_rows(self._mesh, ivs)
-        return data, ivs, pad
 
     # ----------------------------------------------------------- detransform
     def detransform(self, chunks: Sequence[bytes], opts: DetransformOptions) -> list[bytes]:
@@ -310,6 +416,10 @@ class TpuTransformBackend(TransformBackend):
 
     @_spanned("transform.decrypt")
     def _decrypt_batch(self, chunks: list[bytes], opts: DetransformOptions) -> list[bytes]:
+        """Fetch-direction window through the same fused single-dispatch
+        path as encrypt: one packed staging transfer, one device program
+        computing plaintext + EXPECTED tags, one fetch; tags verified
+        host-side against the received ones."""
         enc = opts.encryption
         for i, c in enumerate(chunks):
             if len(c) < IV_SIZE + TAG_SIZE:
@@ -317,39 +427,32 @@ class TpuTransformBackend(TransformBackend):
         ivs = np.stack(
             [np.frombuffer(c[:IV_SIZE], dtype=np.uint8) for c in chunks]
         )
-        received_tags = np.stack(
-            [np.frombuffer(c[-TAG_SIZE:], dtype=np.uint8) for c in chunks]
-        )
+        received_tags = [c[-TAG_SIZE:] for c in chunks]
         sizes = [len(c) - IV_SIZE - TAG_SIZE for c in chunks]
+        payloads = [c[IV_SIZE:-TAG_SIZE] for c in chunks]
 
-        if len(set(sizes)) == 1:
-            ctx = make_context(enc.data_key, enc.aad, sizes[0])
-            data = np.stack(
-                [np.frombuffer(c[IV_SIZE:-TAG_SIZE], dtype=np.uint8) for c in chunks]
-            )
-            data, ivs_padded, pad = self._maybe_shard(data, ivs)
-            pt, expected_tags = gcm_decrypt_chunks(ctx, ivs_padded, data)
+        varlen = len(set(sizes)) != 1
+        if varlen:
+            ctx = make_varlen_context(enc.data_key, enc.aad, max(sizes))
+            n_bytes = ctx.max_bytes
         else:
-            max_bytes = max(sizes)
-            ctx = make_varlen_context(enc.data_key, enc.aad, max_bytes)
-            data = np.zeros((len(chunks), ctx.max_bytes), dtype=np.uint8)
-            for i, c in enumerate(chunks):
-                data[i, : sizes[i]] = np.frombuffer(c[IV_SIZE:-TAG_SIZE], dtype=np.uint8)
-            lengths = np.asarray(sizes, dtype=np.int32)
-            data, ivs_padded, pad = self._maybe_shard(data, ivs)
-            if pad:
-                lengths = np.concatenate([lengths, np.full(pad, 16, np.int32)])
-            pt, expected_tags = gcm_decrypt_varlen(ctx, ivs_padded, data, lengths)
+            ctx = make_context(enc.data_key, enc.aad, sizes[0])
+            n_bytes = ctx.chunk_bytes
+        packed = self._build_packed(payloads, sizes, ivs, n_bytes, varlen)
+        staged = self._stage_packed(packed, varlen)
+        out = self._launch_packed(ctx, staged, varlen, decrypt=True)
+        self.dispatch_stats.windows += 1
+        self.dispatch_stats.bytes_in += sum(sizes)
 
-        pt = np.asarray(pt)
-        expected_tags = np.asarray(expected_tags)[: len(chunks)]
+        host = np.asarray(out)
+        self.dispatch_stats.d2h_fetches += 1
         bad = [
             i
             for i in range(len(chunks))
             if not hmac.compare_digest(
-                expected_tags[i].tobytes(), received_tags[i].tobytes()
+                host[i, n_bytes:].tobytes(), received_tags[i]
             )
         ]
         if bad:
             raise AuthenticationError(f"GCM tag mismatch on chunks {bad}")
-        return [pt[i, : sizes[i]].tobytes() for i in range(len(chunks))]
+        return [host[i, : sizes[i]].tobytes() for i in range(len(chunks))]

@@ -1,0 +1,193 @@
+"""Transform demo: the fused single-dispatch window invariant as a CI gate.
+
+Runs one pipelined multi-window transform through the production
+`TpuTransformBackend` path on the host platform (no TPU needed — the same
+program shapes dispatch on-chip) and asserts the PR-8 tentpole contracts:
+
+- **One dispatch per window**: every window costs exactly ONE fused GCM
+  device dispatch, one host→device staging transfer, and one device→host
+  fetch (`DispatchStats` vs the ops-level launch counter in
+  `ops/gcm.py` — the ~62 ms per-launch floor of the measured harness is
+  paid once per window, PROFILE.md).
+- **Parity**: the fused path's wire bytes equal the multi-dispatch
+  reference ops' (`gcm_encrypt_chunks` / `gcm_encrypt_varlen`) byte for
+  byte, for fixed-size windows and a varlen tail window.
+- **Round trip**: the fused decrypt returns the original chunks, and
+  a tampered tag is rejected.
+- **Shape eligibility is host logic**: `use_pallas_aes`/`use_pallas_ghash`
+  are True at the default bench window shapes on this (CPU) platform.
+
+Writes and re-validates ``artifacts/transform_report.json`` — the
+``make transform-demo`` CI gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import random
+import sys
+import time
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO_ROOT))
+
+from tieredstorage_tpu.utils.platforms import pin_virtual_cpu  # noqa: E402
+
+pin_virtual_cpu(1)
+
+import numpy as np  # noqa: E402
+
+from tieredstorage_tpu.ops import gcm  # noqa: E402
+from tieredstorage_tpu.security.aes import IV_SIZE, AesEncryptionProvider  # noqa: E402
+from tieredstorage_tpu.transform.api import (  # noqa: E402
+    AuthenticationError,
+    DetransformOptions,
+    TransformOptions,
+)
+from tieredstorage_tpu.transform.tpu import TpuTransformBackend  # noqa: E402
+
+CHUNK = 32 << 10
+N_WINDOWS = 4
+WINDOW_CHUNKS = 4
+
+
+def _det_ivs(n: int) -> list[bytes]:
+    return [i.to_bytes(4, "big") * 3 for i in range(1, n + 1)]
+
+
+def _reference_wire(dk, ivs: list[bytes], chunks: list[bytes]) -> list[bytes]:
+    """IV || ct || tag via the MULTI-dispatch ops — the pre-PR-8 program."""
+    sizes = [len(c) for c in chunks]
+    np_ivs = np.stack([np.frombuffer(iv, np.uint8) for iv in ivs])
+    if len(set(sizes)) == 1:
+        ctx = gcm.make_context(dk.data_key, dk.aad, sizes[0])
+        data = np.stack([np.frombuffer(c, np.uint8) for c in chunks])
+        ct, tags = (np.asarray(a) for a in gcm.gcm_encrypt_chunks(ctx, np_ivs, data))
+    else:
+        ctx = gcm.make_varlen_context(dk.data_key, dk.aad, max(sizes))
+        data = np.zeros((len(chunks), ctx.max_bytes), np.uint8)
+        for i, c in enumerate(chunks):
+            data[i, : len(c)] = np.frombuffer(c, np.uint8)
+        ct, tags = (
+            np.asarray(a)
+            for a in gcm.gcm_encrypt_varlen(
+                ctx, np_ivs, data, np.asarray(sizes, np.int32)
+            )
+        )
+    return [
+        ivs[i] + ct[i, : sizes[i]].tobytes() + tags[i].tobytes()
+        for i in range(len(chunks))
+    ]
+
+
+def run(out_path: pathlib.Path) -> int:
+    report: dict = {"checks": {}}
+    checks = report["checks"]
+
+    rng = random.Random(42)
+    windows = []
+    for w in range(N_WINDOWS):
+        sizes = [CHUNK] * WINDOW_CHUNKS
+        if w == N_WINDOWS - 1:
+            sizes[-1] = CHUNK - 517  # varlen tail window
+        windows.append(
+            [bytes(rng.getrandbits(8) for _ in range(s)) for s in sizes]
+        )
+    n_chunks = sum(len(w) for w in windows)
+    ivs = _det_ivs(n_chunks)
+    dk = AesEncryptionProvider.create_data_key_and_aad()
+    opts = TransformOptions(encryption=dk, ivs=ivs)
+
+    # 1. The pipelined window path, with ops-level launch ground truth.
+    tpu = TpuTransformBackend()
+    ops_before = gcm.device_dispatches()
+    t0 = time.perf_counter()
+    out_windows = list(tpu.transform_windows(iter(list(windows)), opts))
+    elapsed_s = time.perf_counter() - t0
+    ops_dispatches = gcm.device_dispatches() - ops_before
+    stats = tpu.dispatch_stats
+    report["dispatch_stats"] = stats.as_dict()
+    report["ops_level_dispatches"] = ops_dispatches
+    report["elapsed_ms"] = round(elapsed_s * 1e3, 1)
+
+    assert stats.windows == N_WINDOWS, stats
+    checks["one_dispatch_per_window"] = (
+        stats.dispatches_per_window <= 1.0
+        and ops_dispatches == stats.dispatches == N_WINDOWS
+    )
+    checks["one_transfer_and_fetch_per_window"] = (
+        stats.h2d_transfers == N_WINDOWS and stats.d2h_fetches == N_WINDOWS
+    )
+
+    # 2. Byte parity against the multi-dispatch reference program.
+    flat = [c for w in out_windows for c in w]
+    ref: list[bytes] = []
+    iv_off = 0
+    for w in windows:
+        ref.extend(_reference_wire(dk, ivs[iv_off : iv_off + len(w)], w))
+        iv_off += len(w)
+    checks["parity_with_multi_dispatch_path"] = flat == ref
+
+    # 3. Round trip through the fused decrypt (+ tamper rejection).
+    d_opts = DetransformOptions(encryption=dk)
+    back = []
+    for w_out in out_windows:
+        back.extend(tpu.detransform(list(w_out), d_opts))
+    checks["roundtrip_byte_identical"] = back == [c for w in windows for c in w]
+    tampered = list(flat)
+    tampered[0] = (
+        tampered[0][: IV_SIZE + 7]
+        + bytes([tampered[0][IV_SIZE + 7] ^ 1])
+        + tampered[0][IV_SIZE + 8 :]
+    )
+    try:
+        tpu.detransform(tampered[:WINDOW_CHUNKS], d_opts)
+        checks["tamper_rejected"] = False
+    except AuthenticationError:
+        checks["tamper_rejected"] = True
+
+    # 4. Eligibility at the default bench shapes is pure host logic.
+    from tieredstorage_tpu.ops.aes_pallas import use_pallas_aes
+    from tieredstorage_tpu.ops.gf128 import ghash_agg_plan
+    from tieredstorage_tpu.ops.ghash_pallas import use_pallas_ghash
+
+    m_blocks = (4 << 20) // 16
+    aes_words = 16 * (-(-(m_blocks + 1) // 32))
+    k1 = ghash_agg_plan(m_blocks)[0][0]
+    checks["bench_shapes_pallas_eligible_on_host"] = bool(
+        use_pallas_aes(aes_words)
+        and use_pallas_ghash(16 * (-(-m_blocks // k1)), k1 * 16)
+    )
+
+    report["ok"] = all(checks.values())
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    out_path.write_text(json.dumps(report, indent=1, sort_keys=True) + "\n")
+
+    # Re-read and validate the artifact, like the other demo gates.
+    loaded = json.loads(out_path.read_text())
+    for name, ok in sorted(loaded["checks"].items()):
+        print(f"[transform-demo] {name}: {'PASS' if ok else 'FAIL'}")
+    print(
+        f"[transform-demo] {N_WINDOWS} windows x {WINDOW_CHUNKS} chunks: "
+        f"dispatches_per_window="
+        f"{loaded['dispatch_stats']['dispatches_per_window']} "
+        f"bytes_per_dispatch={loaded['dispatch_stats']['bytes_per_dispatch']} "
+        f"in {loaded['elapsed_ms']} ms -> {out_path}"
+    )
+    return 0 if loaded["ok"] else 1
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--out",
+        type=pathlib.Path,
+        default=REPO_ROOT / "artifacts" / "transform_report.json",
+    )
+    return run(parser.parse_args().out)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
