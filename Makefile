@@ -1,7 +1,7 @@
 # Developer entry points (counterpart of /root/reference/Makefile).
 PYTHON ?= python
 
-.PHONY: test test-e2e chaos bench demo trace-demo scrub-demo tail-demo failover-demo fleet-demo transform-demo docs docker lint analyze mutation clean
+.PHONY: test test-e2e chaos bench demo trace-demo scrub-demo tail-demo failover-demo fleet-demo transform-demo multichip-demo docs docker lint analyze mutation clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q --ignore=tests/e2e
@@ -84,6 +84,18 @@ fleet-demo:
 # artifacts/transform_report.json.
 transform-demo:
 	$(PYTHON) tools/transform_demo.py --out artifacts/transform_report.json
+
+# Multichip gate: the sharded transform path on 8 forced host devices — the
+# SAME production-path drill the driver's dryrun_multichip runs (shared via
+# tieredstorage_tpu/parallel/multichip.py). Sharded windows must be
+# byte-identical to unsharded for fixed AND varlen shapes in both
+# directions, cost ONE logical fused dispatch per window at mesh_size=8
+# with every staged buffer donated, pad non-divisible batches on the host
+# without the padding reaching the wire, and the chunk-index
+# all_gather/psum must agree with the host-side sizes. Writes and
+# re-validates artifacts/multichip_report.json.
+multichip-demo:
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" $(PYTHON) tools/multichip_demo.py --out artifacts/multichip_report.json
 
 docs:
 	$(PYTHON) -m tieredstorage_tpu.docs.configs_docs > docs/configs.rst

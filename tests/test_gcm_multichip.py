@@ -1,79 +1,191 @@
-"""Variable-length GCM under shard_map: the production upload path
-(compress → varlen encrypt) sharded over the data mesh, with the per-row
-transformed sizes all-gathered as the chunk-index build requires
-(SURVEY.md §7 step 5). The fixed-size mesh path is covered by the official
-`__graft_entry__.dryrun_multichip`; this pins the varlen core the transform
-backend actually uses when compression is on (`transform/tpu.py`)."""
+"""The PRODUCTION sharded transform path on the 8-device virtual CPU mesh.
+
+Pre-PR-9 this file drove `gcm._gcm_varlen_batch` under its own shard_map —
+a parallel implementation that could drift from the serving path. Everything
+now routes through the rebuilt oracle: the `TpuTransformBackend` window
+pipeline (`_build_packed` → row-sharded `_stage_packed` → ONE fused
+`_launch_packed` under shard_map → `_encrypt_finish`) and the shared
+multi-chip drill (`parallel/multichip.py`) that `dryrun_multichip` and
+`make multichip-demo` run, so the suite exercises exactly the bytes
+production serves."""
 
 from __future__ import annotations
 
-import secrets
+import random
 
 import numpy as np
 import pytest
 
 jax = pytest.importorskip("jax")
-import jax.numpy as jnp  # noqa: E402
-from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
 
 from tieredstorage_tpu.ops import gcm  # noqa: E402
-from tieredstorage_tpu.parallel.mesh import (  # noqa: E402
-    DATA_AXIS,
-    data_mesh,
-    shard_map_compat,
+from tieredstorage_tpu.parallel.mesh import MeshPlan  # noqa: E402
+from tieredstorage_tpu.security.aes import (  # noqa: E402
+    IV_SIZE,
+    AesEncryptionProvider,
 )
-from tieredstorage_tpu.security.aes import IV_SIZE, TAG_SIZE  # noqa: E402
+from tieredstorage_tpu.transform.api import (  # noqa: E402
+    DetransformOptions,
+    TransformOptions,
+)
+from tieredstorage_tpu.transform.tpu import TpuTransformBackend  # noqa: E402
+
+N_DEVICES = 8  # conftest pins the 8-device virtual CPU mesh
 
 
-def test_sharded_varlen_encrypt_matches_single_device():
-    mesh = data_mesh(8)
-    batch = 16  # 2 rows per device
-    key = secrets.token_bytes(32)
-    aad = secrets.token_bytes(32)
-    rng = np.random.default_rng(5)
-    lengths = rng.integers(1, 900, batch).astype(np.int32)
-    ctx = gcm.make_varlen_context(key, aad, int(lengths.max()))
-    data = np.zeros((batch, ctx.max_bytes), np.uint8)
-    for i, l in enumerate(lengths):
-        data[i, :l] = rng.integers(0, 256, l, dtype=np.uint8)
-    ivs = rng.integers(0, 256, (batch, 12), dtype=np.uint8)
-    len_blocks = gcm._host_len_blocks(ctx, lengths)
+@pytest.fixture(scope="module")
+def key_pair():
+    return AesEncryptionProvider.create_data_key_and_aad()
 
-    consts = gcm._device_consts(ctx)
-    round_keys, aad_blocks, agg_mats, h_mat = consts
 
-    def shard_step(iv, d, ln, lb):
-        ct, tags = gcm._gcm_varlen_batch(
-            round_keys, iv, d, ln, lb, aad_blocks, agg_mats, h_mat,
-            max_bytes=ctx.max_bytes, m_max=ctx.m_max,
-            m_a=ctx.aad_blocks.shape[0], m_cap=ctx.m_cap, decrypt=False,
+def det_ivs(n):
+    return [bytes([i + 1]) * IV_SIZE for i in range(n)]
+
+
+def sharded_backend(n=N_DEVICES):
+    backend = TpuTransformBackend()
+    backend.configure({"mesh.devices": n})
+    return backend
+
+
+class TestShardedProductionWindows:
+    def test_fixed_window_parity_and_accounting(self, key_pair):
+        rng = random.Random(1)
+        chunks = [bytes(rng.getrandbits(8) for _ in range(2048)) for _ in range(16)]
+        ivs = det_ivs(len(chunks))
+        opts = TransformOptions(encryption=key_pair, ivs=ivs)
+
+        plain = TpuTransformBackend().transform(chunks, opts)
+        tpu = sharded_backend()
+        before = gcm.device_dispatches()
+        sharded = tpu.transform(chunks, opts)
+        assert sharded == plain
+        stats = tpu.dispatch_stats
+        assert gcm.device_dispatches() - before == 1
+        assert (stats.windows, stats.dispatches) == (1, 1)
+        assert (stats.h2d_transfers, stats.d2h_fetches) == (1, 1)
+        assert stats.mesh_size == N_DEVICES
+        assert stats.rows_per_device == len(chunks) // N_DEVICES
+
+    def test_varlen_window_parity_with_non_divisible_batch(self, key_pair):
+        rng = random.Random(2)
+        sizes = [2048, 700, 2048, 51, 1999, 2048, 3, 1024, 2048, 512, 77]
+        assert len(sizes) % N_DEVICES != 0
+        chunks = [bytes(rng.getrandbits(8) for _ in range(s)) for s in sizes]
+        ivs = det_ivs(len(chunks))
+        opts = TransformOptions(encryption=key_pair, ivs=ivs)
+
+        plain = TpuTransformBackend().transform(chunks, opts)
+        tpu = sharded_backend()
+        sharded = tpu.transform(chunks, opts)
+        assert sharded == plain  # host padding rows never reach the wire
+        assert tpu.dispatch_stats.rows_per_device == 2  # 11 rows -> 16 padded
+
+    def test_sharded_decrypt_roundtrip_and_tamper(self, key_pair):
+        rng = random.Random(3)
+        sizes = [1024] * 5 + [333]
+        chunks = [bytes(rng.getrandbits(8) for _ in range(s)) for s in sizes]
+        tpu = sharded_backend()
+        wire = tpu.transform(chunks, TransformOptions(encryption=key_pair))
+        tpu.reset_dispatch_stats()
+        back = tpu.detransform(wire, DetransformOptions(encryption=key_pair))
+        assert back == chunks
+        stats = tpu.dispatch_stats
+        assert (stats.windows, stats.dispatches) == (1, 1)
+        assert stats.mesh_size == N_DEVICES
+
+        from tieredstorage_tpu.transform.api import AuthenticationError
+
+        bad = list(wire)
+        bad[2] = bad[2][:-1] + bytes([bad[2][-1] ^ 1])
+        with pytest.raises(AuthenticationError, match=r"\[2\]"):
+            tpu.detransform(bad, DetransformOptions(encryption=key_pair))
+
+    def test_steady_state_sharded_encrypt_donates_every_window(self, key_pair):
+        """The PR-8 donation skip under sharding is gone: input and output
+        carry the identical row sharding, so every staged window buffer is
+        consumed by XLA as the output allocation — steady state reuses one
+        HBM allocation per in-flight window."""
+        rng = random.Random(4)
+        windows = [
+            [bytes(rng.getrandbits(8) for _ in range(1024)) for _ in range(8)]
+            for _ in range(3)
+        ]
+        ivs = det_ivs(sum(len(w) for w in windows))
+        opts = TransformOptions(encryption=key_pair, ivs=ivs)
+        tpu = sharded_backend()
+        out = list(tpu.transform_windows(iter(windows), opts))
+        assert [len(o) for o in out] == [8, 8, 8]
+        stats = tpu.dispatch_stats
+        assert stats.windows == 3
+        assert stats.donated_buffers == stats.windows
+        assert stats.dispatches_per_window == 1.0
+
+    def test_windowed_sharded_equals_monolithic_unsharded(self, key_pair):
+        rng = random.Random(5)
+        all_chunks = [
+            bytes(rng.getrandbits(8) for _ in range(size))
+            for size in [1024] * 9 + [517]
+        ]
+        opts = TransformOptions(
+            encryption=key_pair, ivs=det_ivs(len(all_chunks))
         )
-        # Chunk-index collective: every chip needs every row's transformed
-        # size (IV || ct || tag) to place chunks in the segment object.
-        sizes = jnp.int32(IV_SIZE + TAG_SIZE) + ln
-        all_sizes = jax.lax.all_gather(sizes, DATA_AXIS, tiled=True)
-        total = jax.lax.psum(jnp.sum(sizes), DATA_AXIS)
-        return ct, tags, all_sizes, total
+        expected = TpuTransformBackend().transform(all_chunks, opts)
+        tpu = sharded_backend()
+        windows = [all_chunks[0:4], all_chunks[4:7], all_chunks[7:10]]
+        results = list(tpu.transform_windows(iter(windows), opts))
+        assert [c for r in results for c in r] == expected
 
-    row = P(DATA_AXIS)
-    row2 = P(DATA_AXIS, None)
-    step = jax.jit(
-        shard_map_compat(
-            shard_step,
-            mesh=mesh,
-            in_specs=(row2, row2, row, row2),
-            out_specs=(row2, row2, P(None), P()),
-            check_vma=False,
+
+class TestShardedPackedOps:
+    """The ops-level mesh contract `_launch_packed` relies on."""
+
+    def test_mesh_requires_tail_metadata(self, key_pair):
+        plan = MeshPlan.from_spec(N_DEVICES)
+        ctx = gcm.make_context(key_pair.data_key, key_pair.aad, 256)
+        data = np.zeros((8, 256 + 16), np.uint8)
+        ivs = np.zeros((8, 12), np.uint8)
+        with pytest.raises(ValueError, match="packed tail"):
+            gcm.gcm_window_packed(
+                ctx, ivs, data, decrypt=False, mesh=plan.mesh
+            )
+
+    def test_sharded_op_is_one_logical_dispatch(self, key_pair):
+        plan = MeshPlan.from_spec(N_DEVICES)
+        ctx = gcm.make_context(key_pair.data_key, key_pair.aad, 256)
+        rng = np.random.default_rng(6)
+        packed = rng.integers(0, 256, (16, 256 + 16), np.uint8)
+        before = gcm.device_dispatches()
+        sharded = np.asarray(
+            gcm.gcm_window_packed(
+                ctx, None, plan.shard(packed), decrypt=False, mesh=plan.mesh
+            )
         )
-    )
-    put = lambda a, s: jax.device_put(a, NamedSharding(mesh, s))
-    ct_s, tags_s, all_sizes, total = step(
-        put(ivs, row2), put(data, row2), put(lengths, row), put(len_blocks, row2)
-    )
+        assert gcm.device_dispatches() - before == 1
+        plain = np.asarray(
+            gcm.gcm_window_packed(ctx, None, packed, decrypt=False)
+        )
+        np.testing.assert_array_equal(sharded, plain)
 
-    ct_1, tags_1 = gcm.gcm_encrypt_varlen(ctx, ivs, data, lengths)
-    np.testing.assert_array_equal(np.asarray(ct_s), np.asarray(ct_1))
-    np.testing.assert_array_equal(np.asarray(tags_s), np.asarray(tags_1))
-    expected_sizes = IV_SIZE + TAG_SIZE + lengths
-    np.testing.assert_array_equal(np.asarray(all_sizes), expected_sizes)
-    assert int(total) == int(expected_sizes.sum())
+
+class TestSharedDrill:
+    """The rebuilt oracle itself — the same `run_drill` the driver's
+    `dryrun_multichip` and `make multichip-demo` execute."""
+
+    @pytest.mark.slow
+    def test_drill_passes_on_the_virtual_mesh(self):
+        from tieredstorage_tpu.parallel.multichip import run_drill, summary_line
+
+        report = run_drill(N_DEVICES, chunk_bytes=4096, window=16)
+        assert report["ok"], (report["failed_checks"], summary_line(report))
+        assert report["fixed"]["mesh_size"] == N_DEVICES
+        assert report["varlen"]["pad_rows"] > 0
+        assert report["fixed"]["dispatches_per_window"] == 1.0
+
+    def test_index_collective_matches_host_sizes(self):
+        from tieredstorage_tpu.parallel.multichip import _index_collective
+
+        plan = MeshPlan.from_spec(N_DEVICES)
+        sizes = [100 + i for i in range(11)]  # non-divisible row count
+        out = _index_collective(plan, sizes)
+        assert out["ok"] and out["total_bytes"] == sum(sizes)

@@ -542,6 +542,11 @@ class RemoteStorageManagerConfig:
         return self._values["transform.backend.class"]
 
     def transform_configs(self) -> dict[str, Any]:
+        """The `transform.`-prefixed subtree handed to the backend's
+        `configure()` (prefix stripped). The TPU backend's keys — incl.
+        `transform.mesh.devices` (default: shard windows over ALL local
+        chips) — are defined by `transform/tpu.py:_definition()` and
+        rendered into docs/configs.rst by the docs generator."""
         return subset_with_prefix(self._props, TRANSFORM_PREFIX)
 
     @property

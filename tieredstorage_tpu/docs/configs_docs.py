@@ -69,6 +69,18 @@ def generate() -> str:
     out += _section("Tiered Storage TPU configs", "=")
     out += _section("RemoteStorageManagerConfig")
     out.append(render_config_def(rsm_config._base_def()))
+    out += _section("TpuTransformBackendConfig (prefix: transform.)")
+    from tieredstorage_tpu.transform import tpu as transform_tpu
+
+    out.extend([
+        "Keys under the ``transform.`` prefix reach the configured transform",
+        "backend's ``configure()`` (``transform_configs()`` in",
+        "``config/rsm_config.py``); these are the keys the TPU backend reads.",
+        "",
+    ])
+    out.append(
+        render_config_def(transform_tpu._definition(), prefix="transform.")
+    )
     from tieredstorage_tpu.fetch.index_cache import MemorySegmentIndexesCache
     from tieredstorage_tpu.fetch.manifest_cache import MemorySegmentManifestCache
 

@@ -582,9 +582,92 @@ def _packed_varlen_impl(
     return jnp.concatenate([out, tags], axis=1)
 
 
-@functools.lru_cache(maxsize=4)
-def _packed_jit(varlen: bool, donate: bool):
-    fn = _packed_varlen_impl if varlen else _packed_fixed_impl
+def _require_tail_metadata(*side_args) -> None:
+    if any(a is not None for a in side_args):
+        raise ValueError(
+            "sharded packed windows read per-row metadata from the packed "
+            "tail columns: pass ivs=None (and lengths=None) so the window "
+            "crosses the host->device link as one row-sharded buffer"
+        )
+
+
+def _packed_fixed_sharded(mesh):
+    """`_packed_fixed_impl` fanned out over a 1-D device mesh: the packed
+    buffer's row axis is sharded (every row is independent — keystream,
+    XOR, GHASH and tag are all row-local, so no collectives), the GCM
+    constants are replicated, and in/out carry the SAME row sharding so
+    jit can still donate the staged input as the output allocation."""
+    from tieredstorage_tpu.parallel.mesh import DATA_AXIS, shard_map_compat
+    from jax.sharding import PartitionSpec as P
+
+    row, rep = P(DATA_AXIS, None), P()
+
+    def run(
+        round_keys, ivs, data_packed, agg_mats, final_mat, const_bits,
+        *, chunk_bytes: int, n_blocks: int, decrypt: bool,
+    ):
+        _require_tail_metadata(ivs)
+
+        def body(rk, dp, am, fm, cb):
+            return _packed_fixed_impl(
+                rk, None, dp, am, fm, cb,
+                chunk_bytes=chunk_bytes, n_blocks=n_blocks, decrypt=decrypt,
+            )
+
+        return shard_map_compat(
+            body, mesh=mesh, in_specs=(rep, row, rep, rep, rep),
+            out_specs=row, check_vma=False,
+        )(round_keys, data_packed, agg_mats, final_mat, const_bits)
+
+    return run
+
+
+def _packed_varlen_sharded(mesh):
+    """Varlen counterpart of `_packed_fixed_sharded`: per-row lengths ride
+    the packed tail, so each shard rebuilds its own GCM length blocks
+    in-graph and no cross-chip exchange is needed."""
+    from tieredstorage_tpu.parallel.mesh import DATA_AXIS, shard_map_compat
+    from jax.sharding import PartitionSpec as P
+
+    row, rep = P(DATA_AXIS, None), P()
+
+    def run(
+        round_keys, ivs, data_packed, lengths, len_blocks, aad_blocks,
+        agg_mats, h_mat,
+        *, aad_bit_len: int, max_bytes: int, m_max: int, m_a: int,
+        m_cap: int, decrypt: bool,
+    ):
+        _require_tail_metadata(ivs, lengths, len_blocks)
+
+        def body(rk, dp, ab, am, hm):
+            return _packed_varlen_impl(
+                rk, None, dp, None, None, ab, am, hm,
+                aad_bit_len=aad_bit_len, max_bytes=max_bytes, m_max=m_max,
+                m_a=m_a, m_cap=m_cap, decrypt=decrypt,
+            )
+
+        return shard_map_compat(
+            body, mesh=mesh, in_specs=(rep, row, rep, rep, rep),
+            out_specs=row, check_vma=False,
+        )(round_keys, data_packed, aad_blocks, agg_mats, h_mat)
+
+    return run
+
+
+@functools.lru_cache(maxsize=16)
+def _packed_jit(varlen: bool, donate: bool, mesh=None):
+    """One jit executable per (shape family, donation, mesh) combination.
+
+    With a mesh the impl runs under shard_map (row axis over the chips) but
+    the call is still ONE logical dispatch — the launch counter and
+    `DispatchStats` count it as one, which keeps the one-dispatch-per-window
+    invariant meaningful across mesh sizes. `data_packed` stays argument 2
+    in every spelling so donation always targets the staged window buffer.
+    """
+    if mesh is not None:
+        fn = _packed_varlen_sharded(mesh) if varlen else _packed_fixed_sharded(mesh)
+    else:
+        fn = _packed_varlen_impl if varlen else _packed_fixed_impl
     static = (
         ("aad_bit_len", "max_bytes", "m_max", "m_a", "m_cap", "decrypt")
         if varlen
@@ -602,6 +685,7 @@ def gcm_window_packed(
     *,
     decrypt: bool,
     donate: bool = False,
+    mesh=None,
 ):
     """Fused fixed-size window: data_packed uint8[B, chunk_bytes + 16] ->
     packed uint8[B, chunk_bytes + 16] where row i is `output_i || tag_i` —
@@ -610,10 +694,13 @@ def gcm_window_packed(
     otherwise the tail columns are ignored. The tag is over the ciphertext
     in both directions (expected tag on decrypt; the caller verifies).
     `donate=True` hands the staged input buffer to XLA for reuse as the
-    output — the caller must not touch data_packed afterwards."""
+    output — the caller must not touch data_packed afterwards. With `mesh`
+    (a 1-D data mesh; batch divisible by its size, metadata in the tail)
+    the one program fans out across every chip via shard_map, output rows
+    sharded identically to the input's so donation still aliases."""
     round_keys, agg_mats, final_mat, const_bits = _device_consts(ctx)
     _count_dispatch()
-    return _packed_jit(False, donate)(
+    return _packed_jit(False, donate, mesh)(
         round_keys,
         None if ivs is None else jnp.asarray(ivs, dtype=jnp.uint8),
         jnp.asarray(data_packed, dtype=jnp.uint8),
@@ -634,6 +721,7 @@ def gcm_varlen_window_packed(
     *,
     decrypt: bool,
     donate: bool = False,
+    mesh=None,
 ):
     """Fused variable-length window: data_packed uint8[B, max_bytes + 16]
     (rows left-aligned with a ZERO payload tail — GHASH requires it) ->
@@ -641,13 +729,13 @@ def gcm_varlen_window_packed(
     ivs=None and lengths=None the per-row metadata rides the packed tail
     ([iv 12 B][length u32 LE 4 B] at columns [max_bytes, max_bytes+16))
     and the GCM length blocks are rebuilt in-graph, so the whole window is
-    ONE host→device buffer. Same single-dispatch/donation contract as
-    `gcm_window_packed`."""
+    ONE host→device buffer. Same single-dispatch/donation/mesh contract as
+    `gcm_window_packed` (sharded windows require the tail-metadata form)."""
     if lengths is not None:
         lengths = np.asarray(lengths, dtype=np.int32)
     round_keys, aad_blocks, agg_mats, h_mat = _device_consts(ctx)
     _count_dispatch()
-    return _packed_jit(True, donate)(
+    return _packed_jit(True, donate, mesh)(
         round_keys,
         None if ivs is None else jnp.asarray(ivs, dtype=jnp.uint8),
         jnp.asarray(data_packed, dtype=jnp.uint8),

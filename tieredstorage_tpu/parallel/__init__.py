@@ -8,6 +8,12 @@ collectives on the forward path; only the per-chunk size/crc vectors are
 gathered back to the host to build the chunk index.
 """
 
-from tieredstorage_tpu.parallel.mesh import data_mesh, shard_rows
+from tieredstorage_tpu.parallel.mesh import (
+    MeshPlan,
+    data_mesh,
+    pad_batch,
+    shard_map_compat,
+    shard_rows,
+)
 
-__all__ = ["data_mesh", "shard_rows"]
+__all__ = ["MeshPlan", "data_mesh", "pad_batch", "shard_map_compat", "shard_rows"]

@@ -41,7 +41,7 @@ from tieredstorage_tpu.ops.gcm import (
     make_context,
     make_varlen_context,
 )
-from tieredstorage_tpu.parallel.mesh import data_mesh, pad_batch, shard_rows
+from tieredstorage_tpu.parallel.mesh import MeshPlan
 from tieredstorage_tpu.security.aes import IV_SIZE, TAG_SIZE
 from tieredstorage_tpu.transform.api import (
     THUFF,
@@ -106,6 +106,15 @@ class DispatchStats:
     h2d_transfers: int = 0
     d2h_fetches: int = 0
     bytes_in: int = 0
+    #: Staged window buffers XLA consumed as the output allocation —
+    #: steady-state encrypt must reuse ONE HBM allocation per in-flight
+    #: window (donated_buffers == windows), sharded or not.
+    donated_buffers: int = 0
+    #: Mesh accounting of the LAST staged window: how many chips the one
+    #: logical dispatch fanned out across, and the padded per-chip row
+    #: count — keeps the one-dispatch invariant testable at any mesh size.
+    mesh_size: int = 1
+    rows_per_device: int = 0
 
     @property
     def dispatches_per_window(self) -> float:
@@ -131,7 +140,17 @@ class TpuTransformBackend(TransformBackend):
     preferred_batch_bytes = 64 << 20
 
     def __init__(self, mesh=None):
-        self._mesh = mesh
+        # `mesh` accepts a prebuilt jax Mesh or MeshPlan (tests/bench);
+        # direct construction without one stays single-device. The config
+        # path (`configure`) instead records a `transform.mesh.devices`
+        # spec — DEFAULT "all local chips" — resolved lazily at the first
+        # staged window so configuring an RSM never blocks on jax backend
+        # acquisition (the relay can hang; the transform path initializes
+        # jax anyway the moment a window is staged).
+        self._plan: Optional[MeshPlan] = (
+            MeshPlan.wrap(mesh) if mesh is not None else MeshPlan(None)
+        )
+        self._mesh_spec = None
         self._pool: Optional[ThreadPoolExecutor] = None
         self.dispatch_stats = DispatchStats()
 
@@ -148,9 +167,18 @@ class TpuTransformBackend(TransformBackend):
             self.preferred_batch_bytes = int(configs["batch.bytes"])
         if "pipeline.depth" in configs:
             self.pipeline_depth = max(1, int(configs["pipeline.depth"]))
-        n = configs.get("mesh.devices")
-        if n:
-            self._mesh = data_mesh(int(n))
+        # Configured backends default to the full local mesh: per-broker
+        # transform throughput scales ~linearly with local chip count, and
+        # on single-chip hosts "all" IS the unsharded path (MeshPlan
+        # normalizes a 1-device mesh to the fallback plan).
+        self._mesh_spec = configs.get("mesh.devices", "all")
+        self._plan = None  # resolve lazily at the first staged window
+
+    def mesh_plan(self) -> MeshPlan:
+        """The resolved sharding plan (builds the mesh on first use)."""
+        if self._plan is None:
+            self._plan = MeshPlan.from_spec(self._mesh_spec)
+        return self._plan
 
     def _zstd_pool(self) -> ThreadPoolExecutor:
         if self._pool is None:
@@ -293,11 +321,13 @@ class TpuTransformBackend(TransformBackend):
 
     def _stage_packed(self, packed: np.ndarray, varlen: bool):
         """Mesh-pad and ship one packed window to the device — the single
-        host→device transfer of the window path (h2d counter)."""
-        import jax
-
+        host→device transfer of the window path (h2d counter). The row
+        axis lands sharded over the plan's mesh (replication-free: each
+        chip holds only its rows), or on the one device on the fallback
+        plan."""
+        plan = self.mesh_plan()
         n_bytes = packed.shape[1] - TAG_SIZE
-        pad = pad_batch(packed.shape[0], self._mesh)
+        pad = plan.pad_rows(packed.shape[0])
         if pad:
             pad_rows = np.zeros((pad, packed.shape[1]), np.uint8)
             if varlen:
@@ -305,32 +335,40 @@ class TpuTransformBackend(TransformBackend):
                 # contract; padding rows carry one block like real callers.
                 pad_rows[:, n_bytes + IV_SIZE] = 16
             packed = np.concatenate([packed, pad_rows])
-        staged = (
-            shard_rows(self._mesh, packed)
-            if self._mesh is not None
-            else jax.device_put(packed)
-        )
+        staged = plan.shard(packed)
         self.dispatch_stats.h2d_transfers += 1
+        self.dispatch_stats.mesh_size = plan.size
+        self.dispatch_stats.rows_per_device = packed.shape[0] // plan.size
         return staged
 
     def _launch_packed(self, ctx, staged, varlen: bool, *, decrypt: bool):
         """ONE fused device dispatch for a staged window (keystream → XOR →
         GHASH → tag in a single program, `output || tag` packed into a
         single buffer), with the staged buffer donated back to XLA as the
-        output allocation on the unsharded steady-state path. Starts the
-        device→host copy immediately so the result streams back while
-        later windows compute."""
+        output allocation. Input and output carry the identical shape AND
+        row sharding on both the fallback and the mesh path (shard_map
+        out_specs mirror the staged rows), so donation aliases in the
+        steady state regardless of mesh size; a genuinely mismatched
+        sharding would be the only reason to skip, and no such case exists
+        on this path. Starts the device→host copy immediately so the
+        result streams back while later windows compute."""
+        mesh = self.mesh_plan().mesh
         before = gcm_ops.device_dispatches()
         if varlen:
             out = gcm_varlen_window_packed(
-                ctx, None, staged, None, decrypt=decrypt,
-                donate=self._mesh is None,
+                ctx, None, staged, None, decrypt=decrypt, donate=True,
+                mesh=mesh,
             )
         else:
             out = gcm_window_packed(
-                ctx, None, staged, decrypt=decrypt, donate=self._mesh is None,
+                ctx, None, staged, decrypt=decrypt, donate=True, mesh=mesh,
             )
         self.dispatch_stats.dispatches += gcm_ops.device_dispatches() - before
+        try:
+            if staged.is_deleted():  # XLA consumed the staged allocation
+                self.dispatch_stats.donated_buffers += 1
+        except AttributeError:
+            pass  # non-jax arrays (mocked backends)
         try:
             out.copy_to_host_async()
         except (AttributeError, RuntimeError):
@@ -456,3 +494,44 @@ class TpuTransformBackend(TransformBackend):
         if bad:
             raise AuthenticationError(f"GCM tag mismatch on chunks {bad}")
         return [host[i, : sizes[i]].tobytes() for i in range(len(chunks))]
+
+
+def _definition():
+    """ConfigDef of the `transform.`-prefixed keys `configure()` reads —
+    rendered into docs/configs.rst (the generated-docs drift gate in
+    `make analyze` keeps it in sync with the committed file)."""
+    from tieredstorage_tpu.config.configdef import ConfigDef, ConfigKey, in_range
+
+    d = ConfigDef()
+    d.define(ConfigKey(
+        "batch.chunks", "int", default=256, validator=in_range(1, None),
+        importance="medium",
+        doc="Preferred chunks per device transform window.",
+    ))
+    d.define(ConfigKey(
+        "batch.bytes", "long", default=64 << 20, validator=in_range(1, None),
+        importance="medium",
+        doc="Window byte cap. With pipeline.depth staged windows in flight, "
+            "each window pins roughly 5x its bytes of HBM intermediates; the "
+            "default 64 MiB keeps the steady state near ~1.3 GiB of a v5e's "
+            "16 GiB.",
+    ))
+    d.define(ConfigKey(
+        "pipeline.depth", "int", default=3, validator=in_range(1, None),
+        importance="medium",
+        doc="Double-buffer depth of transform_windows: staged windows kept "
+            "in flight before blocking on the oldest (host compress || "
+            "device encrypt || device->host copy).",
+    ))
+    d.define(ConfigKey(
+        "mesh.devices", "int", default=0, validator=in_range(0, None),
+        importance="medium",
+        doc="Shard every packed transform window's row axis over a 1-D data "
+            "mesh of this many local devices: 0 (default) = all local "
+            "chips, 1 = single-chip (exactly the unsharded path), n = the "
+            "first n local devices (configuration fails at first use when "
+            "fewer are attached). One window stays ONE logical fused "
+            "dispatch at any mesh size; single-chip hosts never trace the "
+            "shard_map layer.",
+    ))
+    return d
