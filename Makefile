@@ -12,9 +12,11 @@ test-e2e:
 # Fault-injection / resilience suite, including the slow soak variants.
 # Schedules are seeded (fault.seed / FaultSchedule(seed=...)), so runs are
 # deterministic and reproducible. TSTPU_LOCK_WITNESS=1 arms the runtime
-# LockWitness (utils/locks.py): every lock acquisition order observed under
-# chaos must stay a DAG, validating the static lock-order proof against real
-# executions (conftest fails the session on any recorded violation).
+# LockWitness AND RaceWitness (utils/locks.py): every lock acquisition order
+# observed under chaos must stay a DAG, and every sampled shared-attribute
+# mutation must hold its statically inferred guard (analysis/races.py),
+# validating both static proofs against real executions (conftest fails the
+# session on any recorded violation).
 chaos:
 	TSTPU_LOCK_WITNESS=1 $(PYTHON) -m pytest tests/ -q -m chaos
 
@@ -105,11 +107,15 @@ docker:
 	docker build -t tieredstorage-tpu -f docker/Dockerfile .
 
 # Project-invariant static analysis (tieredstorage_tpu/analysis/): lock-order
-# DAG + blocking-under-lock, Deadline discipline, bounded concurrency,
-# monotonic clock, swallowed exceptions, config/metrics doc drift. Exits
-# non-zero on any unsuppressed finding or stale suppression
-# (tools/analysis_suppressions.txt is a burn-down list, not a grandfather
-# clause). The JSON artifact is uploaded by CI next to the demo reports.
+# DAG + blocking-under-lock, guarded-by data-race inference (races),
+# device-dispatch discipline on the fused window path (device-dispatch),
+# Deadline discipline, bounded concurrency, monotonic clock, swallowed
+# exceptions, config/metrics doc drift. Exits non-zero on any unsuppressed
+# finding or stale suppression (tools/analysis_suppressions.txt is a
+# burn-down list, not a grandfather clause). The JSON artifact is uploaded
+# by CI next to the demo reports. Incremental developer mode for a small
+# diff (sub-second, content-hash parse cache under artifacts/):
+#   python -m tieredstorage_tpu.analysis --paths <changed files...>
 analyze:
 	$(PYTHON) -m tieredstorage_tpu.analysis --json artifacts/analysis_report.json
 
@@ -120,7 +126,7 @@ lint: analyze
 # /root/reference/build.gradle:24): flips operators in core pure-logic
 # modules and requires the owning suites to notice.
 mutation:
-	$(PYTHON) tools/mutation_test.py --budget 48
+	$(PYTHON) tools/mutation_test.py --budget 56
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} +

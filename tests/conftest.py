@@ -51,11 +51,14 @@ def pytest_runtest_makereport(item, call):
 
 
 def pytest_sessionfinish(session, exitstatus):
-    """LockWitness gate (`make chaos` runs with TSTPU_LOCK_WITNESS=1): any
-    lock-acquisition-order violation observed during the whole session —
-    including inside daemons and pool threads no single test asserts on —
-    fails the run, validating the static lock-order checker's DAG against
-    real executions."""
+    """LockWitness + RaceWitness gates (`make chaos` runs with
+    TSTPU_LOCK_WITNESS=1): any lock-acquisition-order violation observed
+    during the whole session — including inside daemons and pool threads no
+    single test asserts on — fails the run, validating the static
+    lock-order checker's DAG against real executions; and every sampled
+    shared-attribute mutation must have held its statically inferred guard
+    (or be a declared single-thread/unguarded site), validating the
+    guarded-by race inference the same way."""
     from tieredstorage_tpu.utils.locks import witness, witness_enabled
 
     if not witness_enabled():
@@ -70,6 +73,23 @@ def pytest_sessionfinish(session, exitstatus):
         print(
             f"\nLockWitness: DAG held ({len(witness().edges())} distinct "
             "acquisition-order edges observed, 0 violations)",
+            flush=True,
+        )
+
+    from tieredstorage_tpu.analysis import races
+
+    crosscheck = races.runtime_crosscheck()
+    if crosscheck["violations"]:
+        print("\nRaceWitness: guarded-by cross-check violations:", flush=True)
+        for v in crosscheck["violations"]:
+            print(f"  {v}", flush=True)
+        session.exitstatus = 1
+    else:
+        print(
+            f"RaceWitness: {len(crosscheck['validated'])} site(s) validated "
+            f"against the static inference, 0 violations "
+            f"({len(crosscheck['unobserved'])} inferred guard(s) not "
+            "exercised this session)",
             flush=True,
         )
 

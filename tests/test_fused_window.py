@@ -319,3 +319,52 @@ def test_module_counter_is_monotone(key_pair):
     data = np.zeros((2, 256 + TAG_SIZE), np.uint8)
     gcm.gcm_window_packed(ctx, None, data, decrypt=False)
     assert gcm.device_dispatches() == before + 1
+
+
+def test_two_threads_one_backend_exact_counters(key_pair):
+    """ISSUE 10: the DispatchStats discipline is a GUARD (`_stats_lock`),
+    not a single-thread convention — one backend instance serves concurrent
+    upload/fetch windows on the gateway worker pool. Two threads driving
+    the SAME backend concurrently must land exact counters: a torn
+    `+=` would lose updates, and a process-global launch delta would let
+    one thread's dispatch inflate the other's window (the per-thread
+    `ops.gcm.thread_dispatches` delta source keeps attribution exact)."""
+    import threading
+
+    rng = random.Random(23)
+    per_thread = 5
+    chunk = bytes(rng.getrandbits(8) for _ in range(2048))
+    tpu = TpuTransformBackend()
+    opts = TransformOptions(encryption=key_pair)
+    # Compile the one (shape, donation) executable before the race so both
+    # threads hit the steady-state dispatch path.
+    tpu.transform([chunk, chunk], opts)
+    tpu.reset_dispatch_stats()
+
+    barrier = threading.Barrier(2)
+    errors: list = []
+
+    def work():
+        try:
+            barrier.wait()
+            for _ in range(per_thread):
+                out = tpu.transform([chunk, chunk], opts)
+                assert len(out) == 2
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=work, daemon=True) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert errors == []
+
+    stats = tpu.dispatch_stats
+    total = 2 * per_thread
+    assert stats.windows == total
+    assert stats.dispatches == total
+    assert stats.h2d_transfers == total
+    assert stats.d2h_fetches == total
+    assert stats.bytes_in == total * 2 * len(chunk)
+    assert stats.dispatches_per_window == 1.0

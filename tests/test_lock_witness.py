@@ -260,3 +260,120 @@ class TestProductionWiring:
         finally:
             pool.shutdown(wait=True)
         assert fresh_witness.violations == []
+
+
+# ------------------------------------------------------------- race witness
+class TestRaceWitness:
+    """RaceWitness (ISSUE 10): sampled held-lock/thread recording at
+    mutation sites, the new_unguarded declaration, and zero work disabled."""
+
+    @pytest.fixture
+    def fresh_race(self, fresh_witness, monkeypatch):
+        race = locks.RaceWitness(witness=fresh_witness)
+        monkeypatch.setattr(locks, "_RACE_WITNESS", race)
+        return race
+
+    def test_disabled_note_mutation_records_nothing(self, monkeypatch):
+        monkeypatch.delenv(locks.ENV_FLAG, raising=False)
+        race = locks.RaceWitness()
+        monkeypatch.setattr(locks, "_RACE_WITNESS", race)
+        locks.note_mutation("mod.C.count")
+        assert race.counts == {}
+
+    def test_disabled_new_unguarded_is_passthrough(self, monkeypatch):
+        monkeypatch.delenv(locks.ENV_FLAG, raising=False)
+        race = locks.RaceWitness()
+        monkeypatch.setattr(locks, "_RACE_WITNESS", race)
+        marker = object()
+        assert locks.new_unguarded("mod.C.x", marker) is marker
+        assert race.unguarded_names == set()
+
+    def test_records_innermost_held_lock(self, fresh_race):
+        lock = new_lock("mod.C._lock")
+        with lock:
+            locks.note_mutation("mod.C.count")
+        locks.note_mutation("mod.C.count")  # outside any lock
+        assert fresh_race.held_at["mod.C.count"] == {"mod.C._lock", None}
+        assert fresh_race.counts["mod.C.count"] == 2
+
+    def test_innermost_wins_with_nesting(self, fresh_race):
+        outer, inner = new_lock("mod.A._mu"), new_lock("mod.B._mu")
+        with outer:
+            with inner:
+                locks.note_mutation("mod.B.count")
+        assert fresh_race.held_at["mod.B.count"] == {"mod.B._mu"}
+
+    def test_threads_recorded_per_site(self, fresh_race):
+        locks.note_mutation("mod.C.count")
+        t = threading.Thread(
+            target=lambda: locks.note_mutation("mod.C.count"), daemon=True
+        )
+        t.start()
+        t.join()
+        assert len(fresh_race.threads_at["mod.C.count"]) == 2
+
+    def test_sampling_thins_observations_not_counts(
+        self, fresh_witness, monkeypatch
+    ):
+        monkeypatch.setenv(locks.SAMPLE_ENV, "3")
+        race = locks.RaceWitness(witness=fresh_witness)
+        lock = new_lock("mod.C._lock")
+        for i in range(7):
+            if i % 2:
+                with lock:
+                    race.note_mutation("mod.C.count")
+            else:
+                race.note_mutation("mod.C.count")
+        assert race.counts["mod.C.count"] == 7
+        # Only mutations 0, 3, 6 were sampled (0 and 6 unlocked, 3 locked).
+        assert race.held_at["mod.C.count"] == {None, "mod.C._lock"}
+
+    def test_new_unguarded_registers_when_enabled(self, fresh_race):
+        assert locks.new_unguarded("mod.C.count", 5) == 5
+        assert "mod.C.count" in fresh_race.unguarded_names
+
+    def test_snapshot_and_reset(self, fresh_race):
+        lock = new_lock("mod.C._lock")
+        with lock:
+            locks.note_mutation("mod.C.count")
+        snap = fresh_race.snapshot()
+        assert snap["sites"]["mod.C.count"]["held"] == ["mod.C._lock"]
+        assert snap["sites"]["mod.C.count"]["mutations"] == 1
+        fresh_race.reset()
+        assert fresh_race.snapshot() == {"sites": {}, "unguarded_names": []}
+
+    def test_acquired_names_tracked_even_without_edges(self, fresh_witness):
+        lone = new_lock("mod.C._only")
+        with lone:
+            pass
+        assert "mod.C._only" in fresh_witness.acquired_names()
+        assert fresh_witness.lock_names() == set()  # no nested pair: no edge
+
+    def test_held_names_snapshot(self, fresh_witness):
+        a, b = new_lock("mod.A._mu"), new_lock("mod.B._mu")
+        with a:
+            with b:
+                assert fresh_witness.held_names() == ["mod.A._mu", "mod.B._mu"]
+        assert fresh_witness.held_names() == []
+
+    def test_production_hooks_feed_the_race_witness(self, fresh_race):
+        """The LoadingCache listener-failure path is a hooked site: a
+        failing listener must record the mutation under the cache lock."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from tieredstorage_tpu.utils.caching import LoadingCache
+
+        def bad_listener(key, value, cause):
+            raise RuntimeError("boom")
+
+        pool = ThreadPoolExecutor(max_workers=1)
+        try:
+            cache = LoadingCache(executor=pool, removal_listener=bad_listener)
+            assert cache.get("k", lambda: 1) == 1
+            cache.invalidate("k")
+        finally:
+            pool.shutdown(wait=True)
+        assert cache.stats.listener_failures == 1
+        assert fresh_race.held_at["caching.LoadingCache.stats"] == {
+            "caching.LoadingCache._lock"
+        }

@@ -1,0 +1,553 @@
+"""Guarded-by race inference checker (ISSUE 10): positive/negative
+fixtures per rule, escape-hatch validation, the run-on-repo model smoke,
+and the runtime cross-check."""
+
+from __future__ import annotations
+
+import pathlib
+import textwrap
+
+from tieredstorage_tpu.analysis import races
+from tieredstorage_tpu.analysis.core import load_project, run_analysis
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+def make_project(tmp_path, files: dict[str, str]):
+    for rel, src in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+    return load_project(tmp_path, sorted(files))
+
+
+def analyze(tmp_path, files):
+    return run_analysis(make_project(tmp_path, files), only=["races"])
+
+
+def details(report):
+    return sorted(f.detail for f in report.findings)
+
+
+LOCKED_COUNTER = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+
+        def bump(self):
+            with self._lock:
+                self.count += 1
+"""
+
+
+class TestTornRmw:
+    def test_unguarded_rmw_in_lock_owning_class_flagged(self, tmp_path):
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/mod.py": """
+                import threading
+
+                class C:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self.count = 0
+
+                    def bump(self):
+                        self.count += 1
+            """,
+        })
+        assert details(report) == ["torn-rmw:C.count"]
+
+    def test_guarded_rmw_not_flagged(self, tmp_path):
+        report = analyze(tmp_path, {"tieredstorage_tpu/mod.py": LOCKED_COUNTER})
+        assert report.findings == []
+
+    def test_class_without_locks_or_threads_not_flagged(self, tmp_path):
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/mod.py": """
+                class C:
+                    def __init__(self):
+                        self.count = 0
+
+                    def bump(self):
+                        self.count += 1
+            """,
+        })
+        assert report.findings == []
+
+    def test_thread_target_makes_class_shared(self, tmp_path):
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/mod.py": """
+                import threading
+
+                class Daemon:
+                    def __init__(self):
+                        self.ticks = 0
+                        self._thread = threading.Thread(
+                            target=self._run, daemon=True
+                        )
+
+                    def _run(self):
+                        self.ticks += 1
+            """,
+        })
+        assert details(report) == ["torn-rmw:Daemon.ticks"]
+
+    def test_executor_submit_makes_class_shared(self, tmp_path):
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/mod.py": """
+                class Loader:
+                    def __init__(self, pool):
+                        self._pool = pool
+                        self.loads = 0
+
+                    def start(self):
+                        self._pool.submit(self._load)
+
+                    def _load(self):
+                        self.loads += 1
+            """,
+        })
+        assert details(report) == ["torn-rmw:Loader.loads"]
+
+    def test_reachability_crosses_modules(self, tmp_path):
+        """A class reachable from a spawned thread THROUGH another module's
+        call chain is shared even without owning a lock."""
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/daemon.py": """
+                import threading
+
+                from tieredstorage_tpu.stats import Stats
+
+                class Daemon:
+                    def __init__(self):
+                        self._stats = Stats()
+                        self._thread = threading.Thread(
+                            target=self._run, daemon=True
+                        )
+
+                    def _run(self):
+                        self._stats.record()
+            """,
+            "tieredstorage_tpu/stats.py": """
+                class Stats:
+                    def __init__(self):
+                        self.records = 0
+
+                    def record(self):
+                        self.records += 1
+            """,
+        })
+        assert "torn-rmw:Stats.records" in details(report)
+
+    def test_init_writes_exempt(self, tmp_path):
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/mod.py": """
+                import threading
+
+                class C:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self.count = 0
+                        self.count += 1
+            """,
+        })
+        assert report.findings == []
+
+    def test_nested_def_runs_without_the_lock(self, tmp_path):
+        """A callback defined under the lock executes later, lock-free:
+        its writes analyze with an empty held stack."""
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/mod.py": """
+                import threading
+
+                class C:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self.count = 0
+
+                    def deferred(self):
+                        with self._lock:
+                            def cb():
+                                self.count += 1
+                        return cb
+
+                    def bump(self):
+                        with self._lock:
+                            self.count += 1
+            """,
+        })
+        assert details(report) == ["torn-rmw:C.count"]
+
+
+class TestGuardInference:
+    def test_majority_guard_flags_minority_site(self, tmp_path):
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/mod.py": """
+                import threading
+
+                class C:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self.value = 0
+
+                    def set_a(self, v):
+                        with self._lock:
+                            self.value = v
+
+                    def set_b(self, v):
+                        with self._lock:
+                            self.value = v
+
+                    def set_unlocked(self, v):
+                        self.value = v
+            """,
+        })
+        assert details(report) == ["unguarded-write:C.value"]
+
+    def test_dotted_attribute_paths_share_root_guard(self, tmp_path):
+        """All `self.stats.*` writes share one inferred guard — the
+        LoadingCache.stats shape."""
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/mod.py": """
+                import threading
+
+                class C:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self.stats = object()
+
+                    def hit(self):
+                        with self._lock:
+                            self.stats.hits += 1
+
+                    def miss(self):
+                        with self._lock:
+                            self.stats.misses += 1
+
+                    def fail(self):
+                        self.stats.failures += 1
+            """,
+        })
+        assert details(report) == ["torn-rmw:C.stats.failures"]
+
+    def test_locked_helper_inherits_entry_held(self, tmp_path):
+        """A private method only ever called under the lock analyzes with
+        the lock held (the *_locked idiom needs no annotation)."""
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/mod.py": """
+                import threading
+
+                class C:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self.evictions = 0
+                        self.total = 0
+
+                    def put(self):
+                        with self._lock:
+                            self.total += 1
+                            self._evict_locked()
+
+                    def drop(self):
+                        with self._lock:
+                            self._evict_locked()
+
+                    def _evict_locked(self):
+                        self.evictions += 1
+            """,
+        })
+        assert report.findings == []
+
+    def test_public_helper_does_not_inherit(self, tmp_path):
+        """A PUBLIC method is callable from anywhere: no inherited locks."""
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/mod.py": """
+                import threading
+
+                class C:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self.evictions = 0
+
+                    def put(self):
+                        with self._lock:
+                            self.evict()
+
+                    def evict(self):
+                        self.evictions += 1
+            """,
+        })
+        assert details(report) == ["torn-rmw:C.evictions"]
+
+    def test_stored_method_reference_resets_entry_held(self, tmp_path):
+        """`self._cb` handed off as a callable can run from anywhere."""
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/mod.py": """
+                import threading
+
+                class C:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self.fired = 0
+
+                    def arm(self, pool):
+                        with self._lock:
+                            pool.submit(self._cb)
+
+                    def _cb(self):
+                        self.fired += 1
+            """,
+        })
+        assert details(report) == ["torn-rmw:C.fired"]
+
+
+class TestEscapeHatches:
+    def test_single_thread_annotation_exempts(self, tmp_path):
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/mod.py": """
+                import threading
+
+                class C:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self.count = 0
+
+                    def bump(self):
+                        self.count += 1  # tsa: single-thread
+            """,
+        })
+        assert report.findings == []
+
+    def test_dead_annotation_flagged(self, tmp_path):
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/mod.py": """
+                class C:
+                    def decide(self, x):
+                        return x + 1  # tsa: single-thread
+            """,
+        })
+        assert details(report) == ["dead-annotation"]
+
+    def test_annotation_in_docstring_is_not_an_annotation(self, tmp_path):
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/mod.py": '''
+                class C:
+                    """Mentions # tsa: single-thread in prose only."""
+
+                    def decide(self, x):
+                        return x + 1
+            ''',
+        })
+        assert report.findings == []
+
+    def test_contradictory_annotation_flagged(self, tmp_path):
+        """Annotating one site single-thread while the other writes infer a
+        guard is a contradiction, not an exemption."""
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/mod.py": """
+                import threading
+
+                class C:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self.value = 0
+
+                    def set_a(self, v):
+                        with self._lock:
+                            self.value = v
+
+                    def set_b(self, v):
+                        with self._lock:
+                            self.value = v
+
+                    def set_c(self, v):
+                        self.value = v  # tsa: single-thread
+            """,
+        })
+        assert details(report) == ["contradictory-annotation:C.value"]
+
+    def test_new_unguarded_exempts_attribute(self, tmp_path):
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/mod.py": """
+                import threading
+
+                from tieredstorage_tpu.utils.locks import new_unguarded
+
+                class C:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self.count = new_unguarded("mod.C.count", 0)
+
+                    def bump(self):
+                        self.count += 1
+            """,
+        })
+        assert report.findings == []
+
+    def test_new_unguarded_bad_name_flagged(self, tmp_path):
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/mod.py": """
+                from tieredstorage_tpu.utils.locks import new_unguarded
+
+                class C:
+                    def __init__(self):
+                        self.count = new_unguarded("mod.C.wrong", 0)
+            """,
+        })
+        assert details(report) == ["bad-unguarded-name:C.count"]
+
+    def test_new_unguarded_non_literal_name_flagged(self, tmp_path):
+        report = analyze(tmp_path, {
+            "tieredstorage_tpu/mod.py": """
+                from tieredstorage_tpu.utils.locks import new_unguarded
+
+                NAME = "mod.C.count"
+
+                class C:
+                    def __init__(self):
+                        self.count = new_unguarded(NAME, 0)
+            """,
+        })
+        assert details(report) == ["bad-unguarded-name:C.count"]
+
+
+class TestFingerprints:
+    def test_fingerprint_is_line_independent(self, tmp_path):
+        src = """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+
+                def bump(self):
+                    self.count += 1
+        """
+        a = analyze(tmp_path / "a", {"tieredstorage_tpu/mod.py": src})
+        b = analyze(
+            tmp_path / "b",
+            {"tieredstorage_tpu/mod.py": "\n\n\n" + textwrap.dedent(src)},
+        )
+        assert [f.fingerprint for f in a.findings] == [
+            f.fingerprint for f in b.findings
+        ]
+        assert a.findings[0].line != b.findings[0].line
+
+
+class TestRepoModel:
+    """The run-on-repo smoke: the real tree's model must carry the guards
+    this PR made load-bearing (tests/test_static_analysis.py asserts the
+    zero-unsuppressed gate; this pins the MODEL content)."""
+
+    def test_repo_guards_and_declarations(self):
+        project = load_project(REPO_ROOT)
+        model, findings = races.build_race_model(project)
+        assert findings == [], "\n".join(f.render() for f in findings)
+        guards = model.site_guards()
+        assert (
+            guards["tpu.TpuTransformBackend.dispatch_stats"]
+            == "tpu.TpuTransformBackend._stats_lock"
+        )
+        assert (
+            guards["caching.LoadingCache.stats"] == "caching.LoadingCache._lock"
+        )
+        for counter in ("forwards", "peer_hits", "peer_misses",
+                        "forward_failures"):
+            assert (
+                guards[f"peer_cache.PeerChunkCache.{counter}"]
+                == "peer_cache.PeerChunkCache._lock"
+            )
+        unguarded = model.unguarded_sites()
+        assert "chunk_cache.ChunkCache.degradations" in unguarded
+        assert "chunk_cache.ChunkCache.prefetch_failures" in unguarded
+
+    def test_shared_class_inventory_matches_tree(self):
+        """Every SHARED_CLASSES key must name a real class (the inventory
+        burns down with the code it covers, like suppressions)."""
+        project = load_project(REPO_ROOT)
+        model, _ = races.build_race_model(project)
+        for key in races.SHARED_CLASSES:
+            assert key in model.classes, f"stale SHARED_CLASSES entry {key}"
+            assert model.classes[key].shared
+
+
+class TestRuntimeCrosscheck:
+    def _fixture_model(self, tmp_path):
+        files = {
+            "tieredstorage_tpu/mod.py": """
+                from tieredstorage_tpu.utils.locks import new_lock
+
+                class C:
+                    def __init__(self):
+                        self._lock = new_lock("mod.C._lock")
+                        self.count = 0
+
+                    def bump(self):
+                        with self._lock:
+                            self.count += 1
+
+                    def tick(self):
+                        self.solo = 1  # tsa: single-thread
+            """,
+        }
+        return make_project(tmp_path, files)
+
+    def test_observed_guard_validates(self, tmp_path):
+        from tieredstorage_tpu.utils.locks import LockWitness, RaceWitness
+
+        lw, race = LockWitness(), RaceWitness(witness=LockWitness())
+        race.held_at["mod.C.count"] = {"mod.C._lock"}
+        race.threads_at["mod.C.count"] = {1}
+        result = races.runtime_crosscheck(
+            self._fixture_model(tmp_path), race=race, lock_witness=lw
+        )
+        assert result["violations"] == []
+        assert "mod.C.count" in result["validated"]
+
+    def test_wrong_lock_is_a_violation(self, tmp_path):
+        from tieredstorage_tpu.utils.locks import LockWitness, RaceWitness
+
+        lw, race = LockWitness(), RaceWitness(witness=LockWitness())
+        race.held_at["mod.C.count"] = {"other.D._mu", None}
+        race.threads_at["mod.C.count"] = {1, 2}
+        result = races.runtime_crosscheck(
+            self._fixture_model(tmp_path), race=race, lock_witness=lw
+        )
+        assert len(result["violations"]) == 1
+        assert "mod.C.count" in result["violations"][0]
+
+    def test_single_thread_site_with_two_threads_is_a_violation(self, tmp_path):
+        from tieredstorage_tpu.utils.locks import LockWitness, RaceWitness
+
+        lw, race = LockWitness(), RaceWitness(witness=LockWitness())
+        race.held_at["mod.C.solo"] = {None}
+        race.threads_at["mod.C.solo"] = {1, 2}
+        result = races.runtime_crosscheck(
+            self._fixture_model(tmp_path), race=race, lock_witness=lw
+        )
+        assert any("single-thread" in v for v in result["violations"])
+
+    def test_unknown_site_is_a_violation(self, tmp_path):
+        from tieredstorage_tpu.utils.locks import LockWitness, RaceWitness
+
+        lw, race = LockWitness(), RaceWitness(witness=LockWitness())
+        race.held_at["gone.X.y"] = {None}
+        race.threads_at["gone.X.y"] = {1}
+        result = races.runtime_crosscheck(
+            self._fixture_model(tmp_path), race=race, lock_witness=lw
+        )
+        assert any("unknown" in v for v in result["violations"])
+
+    def test_unobserved_guard_is_informational(self, tmp_path):
+        from tieredstorage_tpu.utils.locks import LockWitness, RaceWitness
+
+        lw, race = LockWitness(), RaceWitness(witness=LockWitness())
+        result = races.runtime_crosscheck(
+            self._fixture_model(tmp_path), race=race, lock_witness=lw
+        )
+        assert result["violations"] == []
+        assert any("mod.C.count" in s for s in result["unobserved"])

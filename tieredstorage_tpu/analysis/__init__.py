@@ -7,6 +7,15 @@ merge gate, but aimed at *project invariants* instead of test strength:
 
 - ``lock-order``        cross-module lock-acquisition graph stays a DAG; no
                         blocking calls (socket/HTTP/waits) under a held lock
+- ``races``             guarded-by data-race inference: every shared mutable
+                        attribute of a thread-reachable class has one
+                        inferred guarding lock, held at every write
+                        (``# tsa: single-thread`` / ``new_unguarded`` are
+                        checked escape hatches)
+- ``device-dispatch``   the fused window path stays one launch/transfer/
+                        fetch per window: no hidden materialization or sync
+                        in its closure, no unvetted jit (retrace hazard),
+                        no donated-buffer use after launch
 - ``deadline``          blocking waits in request-path modules clamp to the
                         end-to-end ``Deadline`` budget
 - ``bounded-concurrency``  no unsanctioned ``threading.Thread`` and no
@@ -19,12 +28,14 @@ merge gate, but aimed at *project invariants* instead of test strength:
                         (configs.rst / metrics.rst) match the live code
 
 Entry points: ``python -m tieredstorage_tpu.analysis`` / ``make analyze``
-(CI-gated). Findings carry stable line-independent fingerprints; legacy
-violations live in ``tools/analysis_suppressions.txt`` with one-line
-justifications and are burned down, never silently grandfathered. The
-static lock-order proof is cross-validated at runtime by
-``tieredstorage_tpu.utils.locks.LockWitness`` (``TSTPU_LOCK_WITNESS=1``
-under ``make chaos`` / ``make fleet-demo``).
+(CI-gated; ``--paths <files...>`` is the sub-second incremental mode over
+a content-hash parse cache). Findings carry stable line-independent
+fingerprints; legacy violations live in ``tools/analysis_suppressions.txt``
+with one-line justifications and are burned down, never silently
+grandfathered. The static lock-order and guarded-by proofs are
+cross-validated at runtime by ``tieredstorage_tpu.utils.locks.LockWitness``
+and ``RaceWitness`` (``TSTPU_LOCK_WITNESS=1`` under ``make chaos`` /
+``make fleet-demo``).
 """
 
 from tieredstorage_tpu.analysis.core import (

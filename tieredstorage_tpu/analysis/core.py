@@ -16,7 +16,9 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import hashlib
 import json
+import pickle
 from pathlib import Path
 from typing import Callable, Iterable, Optional
 
@@ -91,10 +93,30 @@ class Project:
         return None
 
 
-def load_project(root: Path, scan_dirs: Optional[list[str]] = None) -> Project:
-    """Parse every ``.py`` file under ``scan_dirs`` (default: the package)."""
+def load_project(
+    root: Path,
+    scan_dirs: Optional[list[str]] = None,
+    *,
+    cache_path: Optional[Path] = None,
+) -> Project:
+    """Parse every ``.py`` file under ``scan_dirs`` (default: the package).
+
+    With ``cache_path``, parsed+annotated trees are reused from a
+    content-hash pickle (the incremental ``--paths`` mode's parse cache —
+    whole-tree runs parse faster than they unpickle, so the CI gate never
+    passes one). The cache is strictly best-effort: any read/write failure
+    degrades to a plain parse.
+    """
     root = Path(root).resolve()
     dirs = scan_dirs or ["tieredstorage_tpu"]
+    cache: dict[str, tuple[str, ParsedFile]] = {}
+    if cache_path is not None and cache_path.exists():
+        try:
+            cache = pickle.loads(cache_path.read_bytes())
+        except Exception as e:  # noqa: BLE001 — corrupt/foreign cache: reparse
+            _note_cache_failure(e)
+            cache = {}
+    changed = False
     files: list[ParsedFile] = []
     for d in dirs:
         base = root / d
@@ -103,8 +125,35 @@ def load_project(root: Path, scan_dirs: Optional[list[str]] = None) -> Project:
             if "__pycache__" in path.parts:
                 continue
             rel = path.relative_to(root).as_posix()
-            files.append(ParsedFile(path, rel, path.read_text()))
+            source = path.read_text()
+            digest = hashlib.sha256(source.encode()).hexdigest()
+            hit = cache.get(rel)
+            if hit is not None and hit[0] == digest:
+                files.append(hit[1])
+                continue
+            pf = ParsedFile(path, rel, source)
+            cache[rel] = (digest, pf)
+            changed = True
+            files.append(pf)
+    if cache_path is not None and changed:
+        try:
+            cache_path.parent.mkdir(parents=True, exist_ok=True)
+            cache_path.write_bytes(
+                pickle.dumps(cache, protocol=pickle.HIGHEST_PROTOCOL)
+            )
+        except Exception as e:  # noqa: BLE001 — cache is an optimization only
+            _note_cache_failure(e)
     return Project(root, files)
+
+
+#: Last parse-cache read/write failure, for inspection (the cache is a pure
+#: optimization — every failure degrades to a plain parse, but must not
+#: vanish without a trace: swallowed-exception checker).
+_CACHE_LAST_ERROR: list[str] = []
+
+
+def _note_cache_failure(exc: BaseException) -> None:
+    _CACHE_LAST_ERROR[:] = [repr(exc)]
 
 
 # --------------------------------------------------------------- suppressions
@@ -260,10 +309,12 @@ CheckerFn = Callable[[Project], list[Finding]]
 
 def checker_registry() -> dict[str, CheckerFn]:
     """Name -> checker function (import deferred to avoid cycles)."""
-    from tieredstorage_tpu.analysis import checkers, drift, lockorder
+    from tieredstorage_tpu.analysis import checkers, dispatch, drift, lockorder, races
 
     return {
         "lock-order": lockorder.check_lock_order,
+        "races": races.check_races,
+        "device-dispatch": dispatch.check_device_dispatch,
         "deadline": checkers.check_deadline_discipline,
         "bounded-concurrency": checkers.check_bounded_concurrency,
         "monotonic-clock": checkers.check_monotonic_clock,

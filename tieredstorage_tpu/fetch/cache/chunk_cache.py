@@ -30,7 +30,7 @@ from tieredstorage_tpu.manifest.segment_manifest import SegmentManifestV1
 from tieredstorage_tpu.storage.core import ObjectKey
 from tieredstorage_tpu.utils.caching import LoadingCache, RemovalCause
 from tieredstorage_tpu.utils.deadline import check_deadline, remaining_s
-from tieredstorage_tpu.utils.locks import new_lock
+from tieredstorage_tpu.utils.locks import new_lock, new_unguarded
 from tieredstorage_tpu.utils.tracing import NOOP_TRACER
 
 log = logging.getLogger(__name__)
@@ -76,9 +76,15 @@ class ChunkCache(ChunkManager, Generic[T], abc.ABC):
         self._executor: Optional[ThreadPoolExecutor] = None
         #: Times a cache failure (I/O error or wedged load) was bypassed by
         #: fetching straight from the delegate instead of failing the read.
-        self.degradations = 0
+        #: Deliberately lock-free (new_unguarded, races checker): best-effort
+        #: degradation tallies bumped on reader/pool threads — a torn update
+        #: under-counts one rare failure, which is not worth a lock on the
+        #: degraded read path.
+        self.degradations = new_unguarded("chunk_cache.ChunkCache.degradations", 0)
         #: Background prefetch loads that failed; never propagated.
-        self.prefetch_failures = 0
+        self.prefetch_failures = new_unguarded(
+            "chunk_cache.ChunkCache.prefetch_failures", 0
+        )
         #: Per-chunk single-flight across readers AND the async prefetch:
         #: a chunk whose fetch+detransform is in flight (delegate call
         #: issued, cache entry not yet registered) has a Future[bytes]

@@ -42,7 +42,7 @@ from tieredstorage_tpu.storage.core import ObjectKey
 from tieredstorage_tpu.storage.httpclient import HttpClient, HttpError, NO_RETRY
 from tieredstorage_tpu.utils.deadline import DEADLINE_HEADER, current_deadline
 from tieredstorage_tpu.utils.tracing import TRACEPARENT_HEADER, NOOP_TRACER
-from tieredstorage_tpu.utils.locks import new_lock
+from tieredstorage_tpu.utils.locks import new_lock, note_mutation
 
 
 def encode_chunk_frames(chunks: Sequence[bytes]) -> bytes:
@@ -239,7 +239,9 @@ class PeerChunkCache(ChunkManager):
     ) -> Optional[list[bytes]]:
         """One GET /chunk against the owner; None means 'serve locally'
         (miss, peer down, torn frame) — never an error."""
-        self.forwards += 1
+        with self._lock:
+            self.forwards += 1
+            note_mutation("peer_cache.PeerChunkCache.forwards")
         self.tracer.event(
             "fleet.forward", peer=owner, key=objects_key.value,
             chunks=len(chunk_ids),
@@ -263,7 +265,9 @@ class PeerChunkCache(ChunkManager):
         try:
             resp = self._client(owner, url).request("GET", path, headers=headers)
         except HttpError as e:
-            self.forward_failures += 1
+            with self._lock:
+                self.forward_failures += 1
+                note_mutation("peer_cache.PeerChunkCache.forward_failures")
             self._mark_down(owner, f"{type(e).__name__}")
             return None
         elapsed_ms = (time.monotonic() - start) * 1000.0
@@ -271,11 +275,15 @@ class PeerChunkCache(ChunkManager):
             try:
                 window = decode_chunk_frames(resp.body, expected=hi - lo + 1)
             except ValueError as e:
-                self.forward_failures += 1
+                with self._lock:
+                    self.forward_failures += 1
+                    note_mutation("peer_cache.PeerChunkCache.forward_failures")
                 self._mark_down(owner, str(e))
                 return None
             chunks = [window[cid - lo] for cid in chunk_ids]
-            self.peer_hits += 1
+            with self._lock:
+                self.peer_hits += 1
+                note_mutation("peer_cache.PeerChunkCache.peer_hits")
             if self.on_forward is not None:
                 self.on_forward(elapsed_ms)
             self.tracer.event(
@@ -287,8 +295,12 @@ class PeerChunkCache(ChunkManager):
             # The owner cannot serve this key (not uploaded / already
             # deleted there): the authoritative answer comes from the local
             # storage stack.
-            self.peer_misses += 1
+            with self._lock:
+                self.peer_misses += 1
+                note_mutation("peer_cache.PeerChunkCache.peer_misses")
             return None
-        self.forward_failures += 1
+        with self._lock:
+            self.forward_failures += 1
+            note_mutation("peer_cache.PeerChunkCache.forward_failures")
         self._mark_down(owner, f"http {resp.status}")
         return None

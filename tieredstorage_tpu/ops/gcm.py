@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import threading
 import weakref
 
 import jax
@@ -31,6 +32,7 @@ import numpy as np
 from tieredstorage_tpu.ops import gf128
 from tieredstorage_tpu.ops.aes import aes_encrypt_blocks, key_expansion
 from tieredstorage_tpu.ops.aes_bitsliced import ctr_keystream_batch
+from tieredstorage_tpu.utils.locks import new_lock
 
 TAG_SIZE = 16
 
@@ -250,11 +252,15 @@ def _gcm_process_batch(
 # --- dispatch accounting ---
 
 #: Device-program launches issued by this module's public entry points.
-#: The transform backend reads deltas around each window, which makes the
-#: "one fused dispatch per window" invariant testable without a TPU (the
-#: counter is a single int mutated under the GIL by the one dispatching
-#: thread; readers only ever need a snapshot).
+#: The transform backend reads per-thread deltas around each window, which
+#: makes the "one fused dispatch per window" invariant testable without a
+#: TPU. The process-wide total is guarded (concurrent backends on gateway
+#: worker threads would tear a bare increment — races checker); the delta
+#: source is THREAD-LOCAL so one backend's window never absorbs a sibling
+#: thread's launches into its own count.
 _DISPATCHES = [0]
+_DISPATCH_MU = new_lock("gcm._DISPATCH_MU")
+_DISPATCH_TLS = threading.local()
 
 
 def device_dispatches() -> int:
@@ -262,8 +268,16 @@ def device_dispatches() -> int:
     return _DISPATCHES[0]
 
 
+def thread_dispatches() -> int:
+    """GCM launches issued by the CALLING thread (exact delta source for
+    per-window accounting under concurrent backends)."""
+    return getattr(_DISPATCH_TLS, "count", 0)
+
+
 def _count_dispatch() -> None:
-    _DISPATCHES[0] += 1
+    with _DISPATCH_MU:
+        _DISPATCHES[0] += 1
+    _DISPATCH_TLS.count = getattr(_DISPATCH_TLS, "count", 0) + 1
 
 
 # Device-resident copies of each context's constant arrays, uploaded once

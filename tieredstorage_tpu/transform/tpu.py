@@ -43,6 +43,7 @@ from tieredstorage_tpu.ops.gcm import (
 )
 from tieredstorage_tpu.parallel.mesh import MeshPlan
 from tieredstorage_tpu.security.aes import IV_SIZE, TAG_SIZE
+from tieredstorage_tpu.utils.locks import new_lock, note_mutation
 from tieredstorage_tpu.transform.api import (
     THUFF,
     TLZHUFF,
@@ -99,7 +100,12 @@ class DispatchStats:
     measured harness (PROFILE.md), so launch-count regressions are
     throughput regressions; bench.py reports `dispatches_per_window` and
     `bytes_per_dispatch` from these counters next to the GiB/s numbers.
-    Mutated only from the dispatching thread (the transform generator)."""
+    Guarded by the owning backend's `_stats_lock` (one backend instance
+    serves concurrent upload/fetch windows on the gateway worker pool —
+    the guarded-by race checker infers and enforces the guard, and the
+    RaceWitness cross-validates it under `make chaos`/`make fleet-demo`);
+    launch deltas come from `ops.gcm.thread_dispatches()` so a sibling
+    thread's launches never land in this window's count."""
 
     windows: int = 0
     dispatches: int = 0
@@ -152,12 +158,14 @@ class TpuTransformBackend(TransformBackend):
         )
         self._mesh_spec = None
         self._pool: Optional[ThreadPoolExecutor] = None
+        self._stats_lock = new_lock("tpu.TpuTransformBackend._stats_lock")
         self.dispatch_stats = DispatchStats()
 
     def reset_dispatch_stats(self) -> DispatchStats:
         """Swap in fresh counters; returns the retired snapshot."""
-        retired = self.dispatch_stats
-        self.dispatch_stats = DispatchStats()
+        with self._stats_lock:
+            retired = self.dispatch_stats
+            self.dispatch_stats = DispatchStats()
         return retired
 
     def configure(self, configs: dict) -> None:
@@ -336,9 +344,11 @@ class TpuTransformBackend(TransformBackend):
                 pad_rows[:, n_bytes + IV_SIZE] = 16
             packed = np.concatenate([packed, pad_rows])
         staged = plan.shard(packed)
-        self.dispatch_stats.h2d_transfers += 1
-        self.dispatch_stats.mesh_size = plan.size
-        self.dispatch_stats.rows_per_device = packed.shape[0] // plan.size
+        with self._stats_lock:
+            self.dispatch_stats.h2d_transfers += 1
+            self.dispatch_stats.mesh_size = plan.size
+            self.dispatch_stats.rows_per_device = packed.shape[0] // plan.size
+            note_mutation("tpu.TpuTransformBackend.dispatch_stats")
         return staged
 
     def _launch_packed(self, ctx, staged, varlen: bool, *, decrypt: bool):
@@ -353,7 +363,7 @@ class TpuTransformBackend(TransformBackend):
         on this path. Starts the device→host copy immediately so the
         result streams back while later windows compute."""
         mesh = self.mesh_plan().mesh
-        before = gcm_ops.device_dispatches()
+        before = gcm_ops.thread_dispatches()
         if varlen:
             out = gcm_varlen_window_packed(
                 ctx, None, staged, None, decrypt=decrypt, donate=True,
@@ -363,12 +373,16 @@ class TpuTransformBackend(TransformBackend):
             out = gcm_window_packed(
                 ctx, None, staged, decrypt=decrypt, donate=True, mesh=mesh,
             )
-        self.dispatch_stats.dispatches += gcm_ops.device_dispatches() - before
+        delta = gcm_ops.thread_dispatches() - before
         try:
-            if staged.is_deleted():  # XLA consumed the staged allocation
-                self.dispatch_stats.donated_buffers += 1
+            donated = staged.is_deleted()  # XLA consumed the staged allocation
         except AttributeError:
-            pass  # non-jax arrays (mocked backends)
+            donated = False  # non-jax arrays (mocked backends)
+        with self._stats_lock:
+            self.dispatch_stats.dispatches += delta
+            if donated:
+                self.dispatch_stats.donated_buffers += 1
+            note_mutation("tpu.TpuTransformBackend.dispatch_stats")
         try:
             out.copy_to_host_async()
         except (AttributeError, RuntimeError):
@@ -394,8 +408,10 @@ class TpuTransformBackend(TransformBackend):
         packed = self._build_packed(chunks, sizes, ivs, n_bytes, varlen)
         staged = self._stage_packed(packed, varlen)
         out = self._launch_packed(ctx, staged, varlen, decrypt=False)
-        self.dispatch_stats.windows += 1
-        self.dispatch_stats.bytes_in += sum(sizes)
+        with self._stats_lock:
+            self.dispatch_stats.windows += 1
+            self.dispatch_stats.bytes_in += sum(sizes)
+            note_mutation("tpu.TpuTransformBackend.dispatch_stats")
         return ivs, sizes, n_bytes, out
 
     @_spanned("transform.encrypt_finish", count=lambda staged: len(staged[1]),
@@ -406,7 +422,9 @@ class TpuTransformBackend(TransformBackend):
         (IV || ct || tag per chunk)."""
         ivs, sizes, n_bytes, out = staged
         host = np.asarray(out)
-        self.dispatch_stats.d2h_fetches += 1
+        with self._stats_lock:
+            self.dispatch_stats.d2h_fetches += 1
+            note_mutation("tpu.TpuTransformBackend.dispatch_stats")
         return [
             ivs[i].tobytes()
             + host[i, : sizes[i]].tobytes()
@@ -479,11 +497,15 @@ class TpuTransformBackend(TransformBackend):
         packed = self._build_packed(payloads, sizes, ivs, n_bytes, varlen)
         staged = self._stage_packed(packed, varlen)
         out = self._launch_packed(ctx, staged, varlen, decrypt=True)
-        self.dispatch_stats.windows += 1
-        self.dispatch_stats.bytes_in += sum(sizes)
+        with self._stats_lock:
+            self.dispatch_stats.windows += 1
+            self.dispatch_stats.bytes_in += sum(sizes)
+            note_mutation("tpu.TpuTransformBackend.dispatch_stats")
 
         host = np.asarray(out)
-        self.dispatch_stats.d2h_fetches += 1
+        with self._stats_lock:
+            self.dispatch_stats.d2h_fetches += 1
+            note_mutation("tpu.TpuTransformBackend.dispatch_stats")
         bad = [
             i
             for i in range(len(chunks))

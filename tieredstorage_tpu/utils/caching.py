@@ -28,7 +28,7 @@ from collections import OrderedDict
 from concurrent.futures import Executor, Future
 from dataclasses import dataclass, field
 from typing import Any, Callable, Generic, Hashable, Optional, TypeVar
-from tieredstorage_tpu.utils.locks import new_lock
+from tieredstorage_tpu.utils.locks import new_lock, note_mutation
 
 K = TypeVar("K", bound=Hashable)
 V = TypeVar("V")
@@ -101,40 +101,54 @@ class LoadingCache(Generic[K, V]):
         return self.get_future(key, loader).result(timeout)
 
     def get_future(self, key: K, loader: Callable[[], V]) -> "Future[V]":
+        load: Optional[tuple] = None
         with self._lock:
-            self._expire_stale_locked()
+            expired = self._expire_stale_locked()
             entry = self._entries.get(key)
             if entry is not None:
                 entry.last_access = self._now()
                 self._entries.move_to_end(key)
                 self.stats.hits += 1
-                return entry.future
-            self.stats.misses += 1
-            future: "Future[V]" = Future()
-            self._entries[key] = _Entry(future, self._now())
-            self._executor.submit(self._load, key, loader, future)
-            return future
+                future = entry.future
+            else:
+                self.stats.misses += 1
+                future = Future()
+                self._entries[key] = _Entry(future, self._now())
+                # Dispatch AFTER release: Executor.submit synchronizes on the
+                # pool's own queue lock, and an inline executor (tests) would
+                # run the whole load under _lock. Concurrent getters of the
+                # key already share this future, so only the creator submits.
+                load = (key, loader, future)
+        self._dispatch_expired(expired)
+        if load is not None:
+            self._executor.submit(self._load, *load)
+        return future
 
     def get_if_present(self, key: K) -> Optional["Future[V]"]:
         with self._lock:
-            self._expire_stale_locked()
+            expired = self._expire_stale_locked()
             entry = self._entries.get(key)
             if entry is None:
                 self.stats.misses += 1
-                return None
-            entry.last_access = self._now()
-            self._entries.move_to_end(key)
-            self.stats.hits += 1
-            return entry.future
+                future = None
+            else:
+                entry.last_access = self._now()
+                self._entries.move_to_end(key)
+                self.stats.hits += 1
+                future = entry.future
+        self._dispatch_expired(expired)
+        return future
 
     def peek(self, key: K) -> Optional["Future[V]"]:
         """Presence probe that records NO stats and does not refresh recency —
         for internal prefetch/window planning, so exported hit rates reflect
         only real accesses."""
         with self._lock:
-            self._expire_stale_locked()
+            expired = self._expire_stale_locked()
             entry = self._entries.get(key)
-            return None if entry is None else entry.future
+            future = None if entry is None else entry.future
+        self._dispatch_expired(expired)
+        return future
 
     # ----------------------------------------------------------------- writes
     def _load(self, key: K, loader: Callable[[], V], future: "Future[V]") -> None:
@@ -208,9 +222,13 @@ class LoadingCache(Generic[K, V]):
             evicted.append((key, entry.future, RemovalCause.SIZE))
         return evicted
 
-    def _expire_stale_locked(self) -> None:
+    def _expire_stale_locked(self) -> list[tuple[K, Any, RemovalCause]]:
+        """Drop expired entries; returns them for the CALLER to hand to
+        `_dispatch_expired` after releasing `_lock` (Executor.submit takes
+        the pool's queue lock — nothing blocking may run under `_lock`,
+        lock-order checker)."""
         if self._expire is None:
-            return
+            return []
         deadline = self._now() - self._expire
         stale = [
             key
@@ -224,9 +242,12 @@ class LoadingCache(Generic[K, V]):
             self.stats.evictions[RemovalCause.EXPIRED] += 1
             self.stats.eviction_weight += entry.weight
             expired.append((key, entry.future, RemovalCause.EXPIRED))
+        return expired
+
+    def _dispatch_expired(self, expired: list) -> None:
+        """Enqueue expiry notifications (outside `_lock`; listeners run on
+        pool threads as before)."""
         if expired:
-            # Listener runs outside the lock; schedule after unlock via executor
-            # to keep this method safe to call from locked sections.
             self._executor.submit(self._notify, expired)
 
     def _notify(self, removed: list) -> None:
@@ -241,7 +262,9 @@ class LoadingCache(Generic[K, V]):
             try:
                 self._listener(key, value, cause)
             except Exception:  # noqa: BLE001 — listener failures must not poison the cache
-                self.stats.listener_failures += 1
+                with self._lock:
+                    self.stats.listener_failures += 1
+                    note_mutation("caching.LoadingCache.stats")
 
     # ------------------------------------------------------------- inspection
     @property

@@ -1,4 +1,4 @@
-"""Named lock factories + the runtime LockWitness (ISSUE 7).
+"""Named lock factories + the runtime LockWitness/RaceWitness (ISSUES 7, 10).
 
 The static lock-order checker (tieredstorage_tpu/analysis/lockorder.py)
 proves, from the AST, that the cross-module lock-acquisition graph is a DAG.
@@ -18,15 +18,28 @@ Granularity is the CLASS attribute, not the instance: all instances of
 static graph (which cannot see instances either). Reentrant acquisition of
 the same name (RLock, or two instances of one class) is not an edge.
 
+The same flag arms the **RaceWitness** — the runtime half of the
+guarded-by race checker (tieredstorage_tpu/analysis/races.py). Shared
+mutable attributes whose mutation sites carry a ``note_mutation(site)``
+hook record the witnessed lock actually held (and the mutating thread) at
+every sampled write; ``races.runtime_crosscheck`` then validates the
+STATICALLY inferred guard of each site against what real executions
+observed: an inferred-guarded site mutated with the wrong (or no) lock
+held, or a ``# tsa: single-thread`` site mutated from more than one
+thread, is a cross-check violation (``new_unguarded`` sites accept torn
+updates by declaration and are only checked for being known). ``make
+chaos`` and ``make fleet-demo`` fail on any.
+
 When the flag is unset the factories return the raw ``threading``
-primitives — zero wrappers, zero overhead, asserted by the unit tests.
+primitives and ``note_mutation``/``new_unguarded`` are no-ops returning
+immediately — zero wrappers, zero overhead, asserted by the unit tests.
 """
 
 from __future__ import annotations
 
 import os
 import threading
-from typing import Optional
+from typing import Optional, TypeVar
 
 ENV_FLAG = "TSTPU_LOCK_WITNESS"
 
@@ -59,6 +72,10 @@ class LockWitness:
         self._succ: dict[str, set[str]] = {}
         #: first-seen (holder, acquired) pairs, insertion-ordered
         self._edge_sites: dict[tuple[str, str], int] = {}
+        #: every witnessed lock name EVER acquired (edges only cover nested
+        #: acquisitions; the race cross-check needs outermost locks too).
+        #: Mutated via set.add (atomic under the GIL), snapshot-read.
+        self._acquired_names: set[str] = set()
         self.violations: list[str] = []
 
     # ------------------------------------------------------------- thread TLS
@@ -70,6 +87,7 @@ class LockWitness:
 
     # ---------------------------------------------------------------- events
     def note_acquire(self, name: str) -> None:
+        self._acquired_names.add(name)
         held = self._held()
         for holder in dict.fromkeys(held):  # distinct, preserve order
             if holder != name:  # reentrant / same-class sibling: not an edge
@@ -125,6 +143,14 @@ class LockWitness:
         with self._mu:
             return {n for edge in self._edge_sites for n in edge}
 
+    def acquired_names(self) -> set[str]:
+        """Every witnessed lock name acquired at least once this process."""
+        return set(self._acquired_names)
+
+    def held_names(self) -> list[str]:
+        """The CURRENT thread's held witnessed-lock stack (outermost first)."""
+        return list(self._held())
+
     def assert_dag(self) -> None:
         with self._mu:
             violations = list(self.violations)
@@ -138,6 +164,7 @@ class LockWitness:
         with self._mu:
             self._succ.clear()
             self._edge_sites.clear()
+            self._acquired_names.clear()
             self.violations.clear()
 
 
@@ -229,3 +256,123 @@ def new_condition(name: str, lock: Optional[threading.Lock] = None) -> threading
         inner = lock if lock is not None else threading.RLock()
         return threading.Condition(_WitnessedLock(name, inner))
     return threading.Condition(lock)
+
+
+# --------------------------------------------------------------- RaceWitness
+SAMPLE_ENV = "TSTPU_RACE_SAMPLE"
+
+
+class RaceWitness:
+    """Sampling recorder of the lock actually held at attribute mutation
+    sites (the runtime half of ``analysis/races.py``).
+
+    A *site* is a ``<module stem>.<Class>.<attr>`` name passed to
+    ``note_mutation`` from inside the mutation's critical section (or from
+    an annotated lock-free site). Per site the witness keeps the SET of
+    innermost witnessed-lock names observed held (``None`` when the
+    mutating thread held no witnessed lock) and the set of mutating thread
+    idents — enough for the static↔runtime cross-check: an inferred guard
+    must be the only lock ever observed, an annotated single-thread site
+    must only ever see one thread. Sampling (``TSTPU_RACE_SAMPLE=n``
+    records every n-th mutation per site, default 1) bounds the overhead
+    on hot sites; set-insertion makes steady state O(1) regardless.
+    """
+
+    def __init__(self, witness: Optional[LockWitness] = None) -> None:
+        self._witness = witness if witness is not None else _WITNESS
+        self._mu = threading.Lock()
+        try:
+            self._sample_every = max(1, int(os.environ.get(SAMPLE_ENV, "1")))
+        except ValueError:
+            self._sample_every = 1
+        #: site -> set of innermost held witnessed-lock names (None = none)
+        self.held_at: dict[str, set[Optional[str]]] = {}
+        #: site -> set of mutating thread idents
+        self.threads_at: dict[str, set[int]] = {}
+        #: site -> raw mutation events seen (pre-sampling)
+        self.counts: dict[str, int] = {}
+        #: names declared deliberately lock-free via ``new_unguarded``
+        self.unguarded_names: set[str] = set()
+
+    def note_mutation(self, site: str) -> None:
+        held = self._witness._held()
+        innermost = held[-1] if held else None
+        ident = threading.get_ident()
+        with self._mu:
+            count = self.counts.get(site, 0)
+            self.counts[site] = count + 1
+            if count % self._sample_every:
+                return
+            self.held_at.setdefault(site, set()).add(innermost)
+            self.threads_at.setdefault(site, set()).add(ident)
+
+    def register_unguarded(self, name: str) -> None:
+        with self._mu:
+            self.unguarded_names.add(name)
+
+    def sites(self) -> list[str]:
+        with self._mu:
+            return sorted(self.held_at)
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {
+                "sites": {
+                    site: {
+                        "held": sorted(
+                            "<none>" if h is None else h
+                            for h in self.held_at[site]
+                        ),
+                        "threads": len(self.threads_at.get(site, ())),
+                        "mutations": self.counts.get(site, 0),
+                    }
+                    for site in sorted(self.held_at)
+                },
+                "unguarded_names": sorted(self.unguarded_names),
+            }
+
+    def reset(self) -> None:
+        with self._mu:
+            self.held_at.clear()
+            self.threads_at.clear()
+            self.counts.clear()
+            self.unguarded_names.clear()
+
+
+_RACE_WITNESS = RaceWitness()
+
+
+def race_witness() -> RaceWitness:
+    """The process-wide race witness (pairs with ``witness()``)."""
+    return _RACE_WITNESS
+
+
+def note_mutation(site: str) -> None:
+    """Record a shared-attribute mutation at ``site`` (no-op unless the
+    witness flag is armed). Call INSIDE the guarded section so the held
+    witnessed lock is observable; annotated single-thread sites call it
+    wherever the mutation happens."""
+    if witness_enabled():
+        _RACE_WITNESS.note_mutation(site)
+
+
+_T = TypeVar("_T")
+
+
+def new_unguarded(name: str, value: _T) -> _T:
+    """Declare a DELIBERATELY lock-free shared attribute.
+
+    Returns ``value`` unchanged (zero overhead, no wrapper); the name is the
+    same ``<module stem>.<Class>.<attr>`` convention as ``new_lock``. The
+    declaration says a torn update is an ACCEPTED cost (best-effort
+    counters on hot paths) — distinct from ``# tsa: single-thread``, which
+    claims only one thread ever writes. It is load-bearing twice over: the
+    static race checker (analysis/races.py) exempts the attribute from
+    guarded-by inference but validates the name against the assignment
+    target, and under the witness flag the name registers with the
+    RaceWitness so runtime observations of the site classify as declared
+    rather than unknown.
+    """
+    if witness_enabled():
+        _RACE_WITNESS.register_unguarded(name)
+    return value

@@ -63,6 +63,10 @@ DEFAULT_TARGETS = [
     # checker that silently stops finding violations is worse than none.
     ("tieredstorage_tpu/analysis/core.py", ["tests/test_static_analysis.py"]),
     ("tieredstorage_tpu/utils/locks.py", ["tests/test_lock_witness.py"]),
+    # ISSUE 10: the race and dispatch checkers gate the perf arc's
+    # load-bearing invariants; an operator flip that blinds them must fail.
+    ("tieredstorage_tpu/analysis/races.py", ["tests/test_race_checker.py"]),
+    ("tieredstorage_tpu/analysis/dispatch.py", ["tests/test_dispatch_checker.py"]),
 ]
 
 _CMP_SWAP = {
