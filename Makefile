@@ -1,7 +1,7 @@
 # Developer entry points (counterpart of /root/reference/Makefile).
 PYTHON ?= python
 
-.PHONY: test test-e2e chaos bench demo trace-demo scrub-demo tail-demo failover-demo fleet-demo transform-demo multichip-demo docs docker lint analyze mutation clean
+.PHONY: test test-e2e chaos bench demo trace-demo scrub-demo tail-demo failover-demo fleet-demo fleet-soak transform-demo multichip-demo docs docker lint analyze mutation clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q --ignore=tests/e2e
@@ -76,6 +76,20 @@ failover-demo:
 fleet-demo:
 	TSTPU_LOCK_WITNESS=1 $(PYTHON) tools/fleet_demo.py --out artifacts/fleet_report.json
 
+# Fleet soak gate: N REAL sidecar processes (python -m tieredstorage_tpu.sidecar)
+# joined by --fleet-peers into a gossip-membership fleet with R=2 replicated
+# ownership, under a seeded Zipfian fetch load. One instance is SIGKILLed
+# mid-load and later restarted. Gates: zero byte diffs across the kill and
+# rejoin, gossip convergence to each new view within the bounded number of
+# protocol periods, ordered-owner failover onto the surviving replica
+# (failover_hits >= 1) with the repeat pass served by the cache tier (no
+# cache arc lost), and — every process running TSTPU_LOCK_WITNESS=1 — zero
+# lock-order and zero guarded-by violations reported by each member's
+# runtime witnesses (GET /fleet/ping?witness=1). Writes and re-validates
+# artifacts/fleet_soak_report.json.
+fleet-soak:
+	$(PYTHON) tools/fleet_soak.py --out artifacts/fleet_soak_report.json
+
 # Fused-window gate: one pipelined multi-window transform through the
 # production TpuTransformBackend path on the host platform must cost exactly
 # ONE fused GCM device dispatch (plus one h2d staging transfer and one d2h
@@ -126,7 +140,7 @@ lint: analyze
 # /root/reference/build.gradle:24): flips operators in core pure-logic
 # modules and requires the owning suites to notice.
 mutation:
-	$(PYTHON) tools/mutation_test.py --budget 56
+	$(PYTHON) tools/mutation_test.py --budget 64
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} +

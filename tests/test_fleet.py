@@ -104,6 +104,20 @@ class TestHashRing:
         with pytest.raises(ValueError):
             HashRing(["a"], vnodes=0)
 
+    def test_empty_membership_is_a_clear_error(self):
+        with pytest.raises(ValueError, match="at least one instance"):
+            HashRing([], vnodes=64)
+        with pytest.raises(ValueError, match="at least one instance"):
+            HashRing((), vnodes=64)
+
+    def test_duplicate_instances_rejected(self):
+        # A silently-deduped ring would halve the duplicated member's real
+        # capacity and desync members that deduped differently.
+        with pytest.raises(ValueError, match="duplicate ring instances: g1"):
+            HashRing(["g0", "g1", "g1", "g2"], vnodes=64)
+        with pytest.raises(ValueError, match="g0, g1"):
+            HashRing(["g0", "g0", "g1", "g1"], vnodes=64)
+
 
 class TestParseInstances:
     def test_names_and_urls(self):
@@ -149,6 +163,128 @@ class TestFleetRouter:
                 assert router.owner(key) == old
         router.remove_instance("g0")  # removing self is refused
         assert "g0" in router.instances
+
+    def test_route_owners_preference_order_and_urls(self):
+        router = FleetRouter("g0", vnodes=64)
+        router.set_membership({"g0": None, "g1": "u1", "g2": "u2"})
+        for i in range(50):
+            key = f"k/{i:020d}.log"
+            owners = router.route_owners(key, 2)
+            assert len(owners) == 2
+            assert owners[0][0] == router.owner(key)
+            names = [o for o, _ in owners]
+            assert len(set(names)) == 2
+            for name, url in owners:
+                assert url == (None if name == "g0" else router.peer_url(name))
+
+    def test_epoch_numbered_views_refuse_staleness(self):
+        router = FleetRouter("g0", vnodes=16)
+        assert router.set_membership({"g0": None, "g1": "u1"}, epoch=3)
+        assert router.view_epoch == 3
+        gen = router.generation
+        # A reordered (older) view must not roll the ring back.
+        assert not router.set_membership({"g0": None}, epoch=3)
+        assert not router.set_membership({"g0": None}, epoch=2)
+        assert router.view_epoch == 3 and router.generation == gen
+        assert sorted(router.instances) == ["g0", "g1"]
+        # The next agreed epoch applies.
+        assert router.set_membership({"g0": None}, epoch=4)
+        assert sorted(router.instances) == ["g0"]
+        # Un-numbered (bootstrap) membership always applies, epoch untouched.
+        assert router.set_membership({"g0": None, "g9": "u9"})
+        assert router.view_epoch == 4
+        assert "g9" in router.instances
+
+
+# ------------------------------------------------------------- 100-node scale
+class TestRingScale:
+    """ROADMAP item 2(d): the ring properties at fleet sizes that match
+    'millions of users' — 100 instances, seeded keys, all in-process."""
+
+    N = 100
+    VNODES = 128
+    NAMES = [f"gw-{i:03d}" for i in range(100)]
+    KEYS = [f"tiered/topic-{i % 17}/{i % 5}/{i:020d}.log" for i in range(3000)]
+
+    @pytest.fixture(scope="class")
+    def ring(self):
+        return HashRing(self.NAMES, vnodes=self.VNODES)
+
+    def test_balance_within_bound(self, ring):
+        fractions = [ring.ownership_fraction(n) for n in self.NAMES]
+        assert abs(sum(fractions) - 1.0) < 1e-9
+        # With 128 vnodes the arc-length variance concentrates ownership
+        # near 1/N; 3x is a loose envelope that still catches a broken hash
+        # or a lost vnode loop instantly.
+        assert max(fractions) < 3.0 / self.N
+        assert min(fractions) > 1.0 / (4.0 * self.N)
+
+    def test_r_successors_distinct_at_every_key(self, ring):
+        for key in self.KEYS:
+            for r in (2, 3):
+                owners = ring.owners(key, r)
+                assert len(owners) == r
+                assert len(set(owners)) == r, f"duplicate owner for {key}"
+                assert owners[0] == ring.owner(key)
+
+    def test_single_join_moves_bounded_keys_only_to_joiner(self, ring):
+        after = HashRing(self.NAMES + ["gw-new"], vnodes=self.VNODES)
+        moved = 0
+        for key in self.KEYS:
+            old, new = ring.owner(key), after.owner(key)
+            if old != new:
+                moved += 1
+                assert new == "gw-new", f"{key} moved {old}->{new}"
+        # ~1/(N+1) of keys move; 3x envelope, and never zero.
+        assert 0 < moved < 3 * len(self.KEYS) / (self.N + 1)
+
+    def test_single_leave_moves_only_the_leavers_keys(self, ring):
+        leaver = self.NAMES[37]
+        after = HashRing(
+            [n for n in self.NAMES if n != leaver], vnodes=self.VNODES
+        )
+        moved = 0
+        for key in self.KEYS:
+            old, new = ring.owner(key), after.owner(key)
+            if old != leaver:
+                assert new == old, f"survivor key {key} moved {old}->{new}"
+            elif old != new:
+                moved += 1
+        assert moved > 0  # the leaver's arcs really did redistribute
+
+    def test_re_ring_convergence_from_any_member_order(self, ring):
+        # Every member computes the identical ring from its own (arbitrarily
+        # ordered) copy of the membership — no coordinator anywhere.
+        import random as _random
+
+        rng = _random.Random(1234)
+        for _ in range(3):
+            shuffled = list(self.NAMES)
+            rng.shuffle(shuffled)
+            other = HashRing(shuffled, vnodes=self.VNODES)
+            sample = rng.sample(self.KEYS, 300)
+            assert [ring.owner(k) for k in sample] == [
+                other.owner(k) for k in sample
+            ]
+            assert [ring.owners(k, 2) for k in sample[:100]] == [
+                other.owners(k, 2) for k in sample[:100]
+            ]
+
+    def test_router_convergence_through_membership_churn(self):
+        # Two routers applying the same epoch-numbered views in DIFFERENT
+        # delivery orders converge to the same ring (the stale epoch is
+        # refused on the laggard).
+        members = {n: f"http://{n}" for n in self.NAMES[:20]}
+        smaller = {n: u for n, u in members.items() if n != "gw-003"}
+        a = FleetRouter("gw-000", vnodes=32)
+        b = FleetRouter("gw-000", vnodes=32)
+        a.set_membership(members, epoch=1)
+        a.set_membership(smaller, epoch=2)
+        b.set_membership(smaller, epoch=2)
+        b.set_membership(members, epoch=1)  # late duplicate of the old view
+        assert a.instances == b.instances
+        keys = [f"x/{i:020d}.log" for i in range(300)]
+        assert [a.owner(k) for k in keys] == [b.owner(k) for k in keys]
 
 
 # -------------------------------------------------------------- single-flight
@@ -332,7 +468,29 @@ def _peer_router(owner_url: str) -> FleetRouter:
         def owner(self, key):
             return "owner"
 
+        def owners(self, key, n):
+            return ["owner", "me"][:n]
+
     router._ring = _AllOwner()  # deterministic: every key is peer-owned
+    return router
+
+
+def _two_owner_router(url1: str, url2: str) -> FleetRouter:
+    """Router where every key's replica owners are [o1, o2] and this
+    instance ('me') is a non-owner — the ordered-failover fixture."""
+    router = FleetRouter("me", vnodes=4)
+    router.set_membership({"o1": url1, "o2": url2})
+
+    class _TwoOwners:
+        instances = ("me", "o1", "o2")
+
+        def owner(self, key):
+            return "o1"
+
+        def owners(self, key, n):
+            return ["o1", "o2", "me"][:n]
+
+    router._ring = _TwoOwners()
     return router
 
 
@@ -493,6 +651,119 @@ class TestPeerChunkCache:
             server.shutdown()
             server.server_close()
             cache.close()
+
+
+# ------------------------------------------------- ordered-owner failover (R=2)
+class TestOrderedOwnerFailover:
+    """ISSUE 11 tentpole (a): misses try the key's R replica owners in ring
+    order, so a dead first owner fails over to the second with ONE forward
+    hop, both owners down falls back byte-identically to the local backend,
+    and the down cooldown is tracked per owner."""
+
+    def test_first_owner_down_second_serves_with_one_hop(self):
+        dead = _PeerStub()
+        url1 = f"http://127.0.0.1:{dead.port}"
+        dead.stop()  # first owner hard down
+        chunks = [b"replica" * 3]
+        second = _PeerStub(chunks=chunks)
+        delegate = _RecordingManager()
+        cache = PeerChunkCache(
+            delegate,
+            _two_owner_router(url1, f"http://127.0.0.1:{second.port}"),
+            replication=2, forward_timeout_s=0.5,
+        )
+        try:
+            got = cache.get_chunks(ObjectKey("seg/a.log"), None, [0])
+            assert got == chunks  # the second owner's bytes, not the backend's
+            assert delegate.calls == []
+            # The failed o1 attempt plus the o2 serve; o1 now in cooldown.
+            assert cache.forwards == 2
+            assert cache.failover_hits == 1 and cache.peer_hits == 1
+            assert cache.forward_failures == 1 and cache.peers_down == 1
+            # While o1 is down: ONE forward hop straight to the second owner.
+            got = cache.get_chunks(ObjectKey("seg/a.log"), None, [1])
+            assert got == chunks
+            assert cache.forwards == 3 and cache.failover_hits == 2
+            assert cache.forward_failures == 1  # no new o1 attempt
+            assert delegate.calls == []
+        finally:
+            second.stop()
+            cache.close()
+
+    def test_both_owners_down_falls_back_byte_identically(self):
+        s1, s2 = _PeerStub(), _PeerStub()
+        url1 = f"http://127.0.0.1:{s1.port}"
+        url2 = f"http://127.0.0.1:{s2.port}"
+        s1.stop()
+        s2.stop()
+        delegate = _RecordingManager()
+        cache = PeerChunkCache(
+            delegate, _two_owner_router(url1, url2),
+            replication=2, forward_timeout_s=0.5,
+        )
+        try:
+            got = cache.get_chunks(ObjectKey("seg/a.log"), None, [3])
+            # Byte-identical to what the local backend path produces.
+            assert got == delegate.get_chunks(ObjectKey("seg/a.log"), None, [3])
+            assert cache.forward_failures == 2 and cache.peers_down == 2
+            assert cache.peer_hits == 0
+            # Both in cooldown: the next read goes straight to the backend.
+            cache.get_chunks(ObjectKey("seg/a.log"), None, [4])
+            assert cache.forwards == 2
+        finally:
+            cache.close()
+
+    def test_down_cooldown_tracked_per_owner(self):
+        dead = _PeerStub()
+        url1 = f"http://127.0.0.1:{dead.port}"
+        dead.stop()
+        second = _PeerStub(chunks=[b"x" * 8])
+        delegate = _RecordingManager()
+        clock = [0.0]
+        cache = PeerChunkCache(
+            delegate,
+            _two_owner_router(url1, f"http://127.0.0.1:{second.port}"),
+            replication=2, forward_timeout_s=0.5, down_cooldown_s=5.0,
+            time_source=lambda: clock[0],
+        )
+        try:
+            cache.get_chunks(ObjectKey("seg/a.log"), None, [0])
+            assert cache.peers_down == 1  # o1 down, o2 healthy
+            # Within o1's cooldown: only o2 is attempted.
+            cache.get_chunks(ObjectKey("seg/a.log"), None, [1])
+            assert cache.forward_failures == 1
+            # Past o1's cooldown: the next read probes o1 again (and fails
+            # over), while o2's health tracking never flapped.
+            clock[0] = 6.0
+            cache.get_chunks(ObjectKey("seg/a.log"), None, [2])
+            assert cache.forward_failures == 2
+            assert cache.peer_hits == 3 and cache.failover_hits == 3
+        finally:
+            second.stop()
+            cache.close()
+
+    def test_replication_1_restores_single_owner_routing(self):
+        dead = _PeerStub()
+        url1 = f"http://127.0.0.1:{dead.port}"
+        dead.stop()
+        second = _PeerStub(chunks=[b"never"])
+        delegate = _RecordingManager()
+        cache = PeerChunkCache(
+            delegate,
+            _two_owner_router(url1, f"http://127.0.0.1:{second.port}"),
+            replication=1, forward_timeout_s=0.5,
+        )
+        try:
+            got = cache.get_chunks(ObjectKey("seg/a.log"), None, [2])
+            assert got == [bytes([2]) * 16]  # local backend, not owner 2
+            assert cache.forwards == 1 and cache.failover_hits == 0
+        finally:
+            second.stop()
+            cache.close()
+
+    def test_replication_validated(self):
+        with pytest.raises(ValueError):
+            PeerChunkCache(_RecordingManager(), FleetRouter("me"), replication=0)
 
 
 # ------------------------------------------------------ config + RSM wiring

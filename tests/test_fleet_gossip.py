@@ -1,0 +1,532 @@
+"""Gossip membership suite (ISSUE 11): SWIM precedence, epochs, rejoin.
+
+Layers under test, bottom-up:
+- the merge precedence rules: (incarnation, status rank) total order, so
+  every member converges to the same view from any delivery order;
+- the failure-detector state machine on a fake clock + injected transport:
+  alive -> suspect after `suspect.periods` without a heartbeat advance,
+  suspect -> dead after `dead.periods` without refutation, DEAD members
+  leave the ring through an epoch-numbered `FleetRouter.set_membership`;
+- refutation and rejoin: a member spreading my obituary is answered with an
+  incarnation bump; a kill -9'd member that restarts converges back in;
+- heartbeat dissemination: second-hand freshness (relayed heartbeats) keeps
+  a member alive even when direct probes to it fail — one probe per period
+  stays O(1) per member;
+- bounded key movement: only a dead member's arcs move, suspicion moves
+  nothing;
+- config keys, RSM wiring, and the gateway's POST /fleet/gossip and
+  GET /fleet/ping routes over real HTTP.
+
+The multi-PROCESS half — real sidecars, SIGKILL, restart — lives in
+tools/fleet_soak.py (`make fleet-soak`).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+
+import pytest
+
+from tieredstorage_tpu.config.configdef import ConfigException
+from tieredstorage_tpu.config.rsm_config import RemoteStorageManagerConfig
+from tieredstorage_tpu.fleet import FleetRouter, GossipAgent
+from tieredstorage_tpu.fleet.gossip import ALIVE, DEAD, SUSPECT, _fresher
+from tieredstorage_tpu.rsm import RemoteStorageManager
+from tieredstorage_tpu.sidecar.http_gateway import SidecarHttpGateway
+
+pytestmark = pytest.mark.chaos
+
+BASE_CONFIG = {
+    "storage.backend.class": "tieredstorage_tpu.storage.memory.InMemoryStorage",
+    "chunk.size": 1024,
+}
+
+
+class _Cluster:
+    """N gossip agents joined by an in-process transport and one fake
+    clock; `tick()` is one protocol period across every live member."""
+
+    def __init__(self, names=("a", "b", "c"), *, suspect_periods=3,
+                 dead_periods=3, partitions=None):
+        self.clock = [0.0]
+        self.alive = set(names)
+        self.seeds = {n: f"http://{n}" for n in names}
+        #: (src, dst) pairs whose direct exchanges fail (one-way).
+        self.partitions = partitions or set()
+        self.routers = {}
+        self.agents = {}
+        for name in names:
+            router = FleetRouter(name, vnodes=16)
+            router.set_membership(self.seeds)
+            self.routers[name] = router
+            self.agents[name] = GossipAgent(
+                router,
+                interval_s=1.0,
+                suspect_periods=suspect_periods,
+                dead_periods=dead_periods,
+                transport=self._transport_for(name),
+                time_source=lambda: self.clock[0],
+            )
+
+    def _transport_for(self, src):
+        def transport(url, payload):
+            dst = url.split("//")[1]
+            if dst not in self.alive or (src, dst) in self.partitions:
+                raise ConnectionRefusedError(f"{src}->{dst} unreachable")
+            return self.agents[dst].on_gossip(payload)
+
+        return transport
+
+    def tick(self, periods=1):
+        for _ in range(periods):
+            self.clock[0] += 1.0
+            for name in sorted(self.alive):
+                self.agents[name].run_period()
+
+    def views(self):
+        return {n: sorted(self.agents[n].routing_view()) for n in self.alive}
+
+
+# ------------------------------------------------------------ merge precedence
+class TestPrecedence:
+    @pytest.mark.parametrize("a, b, a_wins", [
+        ((1, 0, ALIVE), (0, 9, DEAD), True),    # higher incarnation beats dead
+        ((0, 5, DEAD), (0, 5, SUSPECT), True),  # dead beats suspect at equal pair
+        ((0, 5, SUSPECT), (0, 5, ALIVE), True),  # suspect beats alive at equal pair
+        ((0, 5, ALIVE), (0, 5, ALIVE), False),  # equal state: nothing to apply
+        ((0, 5, ALIVE), (0, 5, SUSPECT), False),  # same-beat alive can't erase it
+        ((0, 6, ALIVE), (0, 5, SUSPECT), True),  # a heartbeat advance CAN
+        ((0, 0, ALIVE), (1, 0, ALIVE), False),  # lower incarnation never wins
+        ((2, 0, SUSPECT), (1, 9, DEAD), True),  # incarnation dominates all
+    ])
+    def test_total_order(self, a, b, a_wins):
+        assert _fresher(*a, *b) is a_wins
+
+    def test_merge_is_delivery_order_independent(self):
+        entries = [
+            {"name": "x", "url": "http://x", "incarnation": 1, "status": ALIVE,
+             "heartbeat": 4},
+            {"name": "x", "url": "http://x", "incarnation": 0, "status": DEAD,
+             "heartbeat": 9},
+            {"name": "x", "url": "http://x", "incarnation": 1, "status": SUSPECT,
+             "heartbeat": 2},
+        ]
+        finals = []
+        for order in ([0, 1, 2], [2, 1, 0], [1, 0, 2], [2, 0, 1]):
+            agent = GossipAgent(
+                FleetRouter("me", vnodes=4), transport=lambda u, p: p,
+                time_source=lambda: 0.0,
+            )
+            for i in order:
+                agent.merge({"members": [entries[i]]})
+            m = agent.members()["x"]
+            finals.append((m.incarnation, m.status))
+        assert len(set(finals)) == 1  # same fixed point from every order
+        # alive@1-hb4 outranks suspect@1-hb2 (heartbeat advance) and dead@0.
+        assert finals[0] == (1, ALIVE)
+
+    def test_malformed_entries_do_not_poison_the_view(self):
+        agent = GossipAgent(
+            FleetRouter("me", vnodes=4), transport=lambda u, p: p,
+            time_source=lambda: 0.0,
+        )
+        changed = agent.merge({"members": [
+            {"name": "ok", "url": None, "incarnation": 0, "status": ALIVE,
+             "heartbeat": 1},
+            {"incarnation": 0, "status": ALIVE},         # no name
+            {"name": "bad-inc", "incarnation": "NaN", "status": ALIVE},
+            {"name": "bad-status", "incarnation": 0, "status": "zombie"},
+        ]})
+        assert "ok" in agent.members()
+        assert "bad-status" not in agent.members()
+        assert changed == 1
+
+    def test_on_gossip_requires_members_list(self):
+        agent = GossipAgent(
+            FleetRouter("me", vnodes=4), transport=lambda u, p: p,
+            time_source=lambda: 0.0,
+        )
+        with pytest.raises(ValueError):
+            agent.on_gossip({"from": "x"})
+
+
+# ------------------------------------------------------- the failure detector
+class TestFailureDetector:
+    def test_full_view_converges_and_holds(self):
+        cluster = _Cluster()
+        cluster.tick(8)
+        assert cluster.views() == {n: ["a", "b", "c"] for n in "abc"}
+        # A stable fleet never re-rings: epoch 0 means the seeded view was
+        # never replaced.
+        assert all(a.epoch == 0 for a in cluster.agents.values())
+
+    def test_dead_member_leaves_ring_within_bounded_periods(self):
+        cluster = _Cluster(suspect_periods=3, dead_periods=3)
+        cluster.tick(5)
+        cluster.alive.discard("c")
+        # suspect(3) + dead(3) + slack for the last pre-kill refresh.
+        cluster.tick(3 + 3 + 2)
+        assert cluster.views() == {"a": ["a", "b"], "b": ["a", "b"]}
+        for name in ("a", "b"):
+            assert sorted(cluster.routers[name].instances) == ["a", "b"]
+            assert cluster.agents[name].members()["c"].status == DEAD
+            assert cluster.routers[name].view_epoch >= 1
+
+    def test_suspicion_alone_moves_no_keys(self):
+        cluster = _Cluster(suspect_periods=2, dead_periods=50)
+        cluster.tick(4)
+        keys = [f"k/{i:020d}.log" for i in range(200)]
+        before = {k: cluster.routers["a"].owner(k) for k in keys}
+        cluster.alive.discard("c")
+        cluster.tick(6)  # long past suspicion, well short of death
+        assert cluster.agents["a"].members()["c"].status == SUSPECT
+        # SUSPECT stays in the ring: routing unchanged, zero key movement.
+        assert {k: cluster.routers["a"].owner(k) for k in keys} == before
+        assert cluster.agents["a"].epoch == 0
+
+    def test_death_moves_only_the_dead_members_arcs(self):
+        cluster = _Cluster()
+        cluster.tick(5)
+        keys = [f"k/{i:020d}.log" for i in range(300)]
+        before = {k: cluster.routers["a"].owner(k) for k in keys}
+        cluster.alive.discard("c")
+        cluster.tick(10)
+        after = {k: cluster.routers["a"].owner(k) for k in keys}
+        for k in keys:
+            if before[k] != "c":
+                assert after[k] == before[k], f"survivor key {k} moved"
+            else:
+                assert after[k] != "c"
+
+    def test_partitioned_member_stays_alive_via_relayed_heartbeats(self):
+        # a cannot reach c in either direction; b relays. c must stay ALIVE
+        # at a indefinitely — second-hand heartbeat advances are liveness.
+        cluster = _Cluster(partitions={("a", "c"), ("c", "a")})
+        cluster.tick(30)
+        assert cluster.views()["a"] == ["a", "b", "c"]
+        assert cluster.agents["a"].members()["c"].status == ALIVE
+        assert cluster.agents["a"].probe_failures > 0  # it DID try directly
+
+    def test_refutation_bumps_incarnation(self):
+        cluster = _Cluster(suspect_periods=2, dead_periods=50)
+        cluster.tick(3)
+        # Partition c away long enough to be suspected, then heal.
+        cluster.partitions |= {("a", "c"), ("c", "a"), ("b", "c"), ("c", "b")}
+        cluster.tick(5)
+        assert cluster.agents["a"].members()["c"].status == SUSPECT
+        cluster.partitions.clear()
+        cluster.tick(6)
+        # c saw its own suspicion and re-announced with a higher incarnation.
+        assert cluster.agents["c"].refutations >= 1
+        me = cluster.agents["a"].members()["c"]
+        assert me.status == ALIVE and me.incarnation >= 1
+
+    def test_kill_restart_rejoins_with_higher_incarnation(self):
+        cluster = _Cluster()
+        cluster.tick(5)
+        cluster.alive.discard("c")
+        cluster.tick(10)
+        assert cluster.views()["a"] == ["a", "b"]
+        # Restart: fresh router + agent, same name, seeds only.
+        router = FleetRouter("c", vnodes=16)
+        router.set_membership(cluster.seeds)
+        cluster.routers["c"] = router
+        cluster.agents["c"] = GossipAgent(
+            router, interval_s=1.0, suspect_periods=3, dead_periods=3,
+            transport=cluster._transport_for("c"),
+            time_source=lambda: cluster.clock[0],
+        )
+        cluster.alive.add("c")
+        cluster.tick(8)
+        assert cluster.views() == {n: ["a", "b", "c"] for n in "abc"}
+        # The obituary lost to a higher incarnation, everywhere.
+        for name in ("a", "b"):
+            m = cluster.agents[name].members()["c"]
+            assert m.status == ALIVE and m.incarnation >= 1
+
+    def test_epoch_increases_once_per_view_change(self):
+        cluster = _Cluster()
+        cluster.tick(6)
+        assert cluster.agents["a"].epoch == 0
+        cluster.alive.discard("c")
+        cluster.tick(10)
+        death_epoch = cluster.agents["a"].epoch
+        assert death_epoch >= 1
+        cluster.tick(10)  # stable: no further re-rings
+        assert cluster.agents["a"].epoch == death_epoch
+        assert cluster.routers["a"].view_epoch == death_epoch
+
+    def test_stopped_agent_refuses_exchanges(self):
+        from tieredstorage_tpu.fleet.gossip import GossipStoppedError
+
+        agent = GossipAgent(
+            FleetRouter("me", vnodes=4), transport=lambda u, p: p,
+            time_source=lambda: 0.0,
+        )
+        payload = agent.view_payload()
+        agent.on_gossip(payload)  # running: fine
+        agent.stop()
+        # A stopped agent answering would read as first-hand liveness and
+        # keep this member in every ring forever (gateway keep-alive
+        # handler threads outlive a stop, so this state is reachable).
+        with pytest.raises(GossipStoppedError):
+            agent.on_gossip(payload)
+
+    def test_seed_adds_members_but_never_resurrects(self):
+        cluster = _Cluster()
+        cluster.tick(5)
+        cluster.alive.discard("c")
+        cluster.tick(10)
+        agent = cluster.agents["a"]
+        assert agent.members()["c"].status == DEAD
+        agent.seed({**cluster.seeds, "d": "http://d"})
+        assert agent.members()["c"].status == DEAD  # reseed is not evidence
+        assert "d" in agent.members()
+
+
+# ------------------------------------------------------------- config wiring
+class TestGossipConfig:
+    def test_gossip_requires_fleet(self):
+        with pytest.raises(ConfigException, match="fleet.enabled"):
+            RemoteStorageManagerConfig({
+                **BASE_CONFIG, "fleet.gossip.enabled": True,
+            })
+
+    def test_defaults(self):
+        config = RemoteStorageManagerConfig(BASE_CONFIG)
+        assert config.fleet_replication_factor == 2
+        assert config.fleet_gossip_enabled is False
+        assert config.fleet_gossip_interval_ms == 1_000
+        assert config.fleet_gossip_probe_timeout_ms == 750
+        assert config.fleet_gossip_suspect_periods == 3
+        assert config.fleet_gossip_dead_periods == 3
+
+    def test_replication_factor_validated(self):
+        with pytest.raises(ConfigException):
+            RemoteStorageManagerConfig({
+                **BASE_CONFIG, "fleet.replication.factor": 0,
+            })
+
+    def test_rsm_wires_gossip_agent_and_gauges(self):
+        rsm = RemoteStorageManager()
+        rsm.configure({
+            **BASE_CONFIG,
+            "fleet.enabled": True,
+            "fleet.instance.id": "g0",
+            "fleet.instances": ["g0", "g1=http://127.0.0.1:9"],
+            "fleet.gossip.enabled": True,
+            "fleet.gossip.interval.ms": 50,
+            "fleet.replication.factor": 3,
+        })
+        try:
+            agent = rsm.gossip_agent
+            assert agent is not None
+            # Seeded from fleet.instances, NOT started until the gateway is.
+            assert sorted(agent.members()) == ["g0", "g1"]
+            assert agent._thread is None
+            assert rsm.peer_chunk_cache.replication == 3
+            names = {mn.name for mn in rsm.metrics.registry.metric_names
+                     if mn.group == "fleet-metrics"}
+            assert {"fleet-members-alive", "fleet-members-dead",
+                    "fleet-gossip-probes-total", "fleet-view-epoch",
+                    "fleet-replication-factor",
+                    "fleet-failover-hits-total"} <= names
+            started = rsm.start_fleet_gossip()
+            assert started is agent and agent._thread is not None
+        finally:
+            rsm.close()
+        assert agent._thread is None  # close() stopped the daemon
+
+    def test_set_fleet_peers_reseeds_gossip(self):
+        rsm = RemoteStorageManager()
+        rsm.configure({
+            **BASE_CONFIG,
+            "fleet.enabled": True,
+            "fleet.instance.id": "g0",
+            "fleet.gossip.enabled": True,
+        })
+        try:
+            rsm.set_fleet_peers({"g0": "http://127.0.0.1:1",
+                                 "g1": "http://127.0.0.1:2"})
+            assert sorted(rsm.gossip_agent.members()) == ["g0", "g1"]
+            assert rsm.gossip_agent.self_url == "http://127.0.0.1:1"
+        finally:
+            rsm.close()
+
+    def test_non_gossip_fleet_has_no_agent(self):
+        rsm = RemoteStorageManager()
+        rsm.configure({
+            **BASE_CONFIG, "fleet.enabled": True, "fleet.instance.id": "g0",
+        })
+        try:
+            assert rsm.gossip_agent is None
+            assert rsm.start_fleet_gossip() is None
+        finally:
+            rsm.close()
+
+
+# ------------------------------------------------------------ gateway routes
+@pytest.fixture()
+def gossip_pair():
+    """Two RSMs with gossip enabled behind real gateways, peered."""
+    rsms, gateways = {}, {}
+    for name in ("a", "b"):
+        rsm = RemoteStorageManager()
+        rsm.configure({
+            **BASE_CONFIG,
+            "fleet.enabled": True,
+            "fleet.instance.id": name,
+            "fleet.gossip.enabled": True,
+            "fleet.gossip.interval.ms": 50,
+            "fleet.gossip.probe.timeout.ms": 500,
+        })
+        rsms[name] = rsm
+        gateways[name] = SidecarHttpGateway(rsm).start()
+    peers = {n: f"http://127.0.0.1:{g.port}" for n, g in gateways.items()}
+    for rsm in rsms.values():
+        rsm.set_fleet_peers(peers)
+    try:
+        yield rsms, gateways
+    finally:
+        for g in gateways.values():
+            g.stop()
+        for r in rsms.values():
+            r.close()
+
+
+def _http_json(port, method, path, body=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request(method, path, body=body)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+class TestGatewayGossipRoutes:
+    def test_gossip_exchange_merges_and_answers(self, gossip_pair):
+        rsms, gateways = gossip_pair
+        payload = rsms["a"].gossip_agent.view_payload()
+        status, body = _http_json(
+            gateways["b"].port, "POST", "/fleet/gossip",
+            body=json.dumps(payload).encode(),
+        )
+        assert status == 200
+        view = json.loads(body)
+        assert view["from"] == "b"
+        assert {m["name"] for m in view["members"]} == {"a", "b"}
+        # The exchange itself was first-hand evidence that a is alive.
+        assert rsms["b"].gossip_agent.members()["a"].status == ALIVE
+
+    def test_ping_reports_ring_gossip_and_counters(self, gossip_pair):
+        rsms, gateways = gossip_pair
+        status, body = _http_json(gateways["a"].port, "GET", "/fleet/ping")
+        assert status == 200
+        ping = json.loads(body)
+        assert ping["instance"] == "a"
+        assert sorted(ping["ring_instances"]) == ["a", "b"]
+        assert ping["gossip"]["members"]["b"]["status"] == ALIVE
+        assert ping["peer_cache"]["replication"] == 2
+        assert "witness" not in ping  # only on request: it is expensive
+
+    def test_ping_witness_section_on_request(self, gossip_pair):
+        _, gateways = gossip_pair
+        status, body = _http_json(
+            gateways["a"].port, "GET", "/fleet/ping?witness=1"
+        )
+        assert status == 200
+        witness = json.loads(body)["witness"]
+        assert witness["lock_violations"] == []
+        assert witness["race_violations"] == []
+
+    def test_live_daemons_converge_over_real_http(self, gossip_pair):
+        import time
+
+        rsms, _ = gossip_pair
+        for rsm in rsms.values():
+            rsm.start_fleet_gossip()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if all(
+                r.gossip_agent.acks >= 2
+                and sorted(r.gossip_agent.routing_view()) == ["a", "b"]
+                for r in rsms.values()
+            ):
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("gossip daemons never converged over HTTP")
+
+    def test_closed_member_ages_out_of_the_survivors_ring(self, gossip_pair):
+        import time
+
+        rsms, gateways = gossip_pair
+        for rsm in rsms.values():
+            rsm.start_fleet_gossip()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if all(r.gossip_agent.acks >= 2 for r in rsms.values()):
+                break
+            time.sleep(0.05)
+        # Close b's RSM but leave its gateway listening: the stopped agent
+        # refuses exchanges (500), so a's probes fail and b ages out —
+        # "closed but still answering TCP" must read as death, not life.
+        rsms["b"].close()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if sorted(rsms["a"].fleet_router.instances) == ["a"]:
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError(
+                "survivor never dropped the closed member: "
+                f"{rsms['a'].gossip_agent.members()}"
+            )
+        assert rsms["a"].gossip_agent.members()["b"].status == DEAD
+        assert rsms["a"].fleet_router.view_epoch >= 1
+
+    def test_gossip_route_404_when_disabled(self):
+        rsm = RemoteStorageManager()
+        rsm.configure({
+            **BASE_CONFIG, "fleet.enabled": True, "fleet.instance.id": "solo",
+        })
+        gateway = SidecarHttpGateway(rsm).start()
+        try:
+            status, _ = _http_json(
+                gateway.port, "POST", "/fleet/gossip", body=b"{}"
+            )
+            assert status == 404
+            # /fleet/ping still answers: fleet mode is on, gossip is not.
+            status, body = _http_json(gateway.port, "GET", "/fleet/ping")
+            assert status == 200
+            assert "gossip" not in json.loads(body)
+        finally:
+            gateway.stop()
+            rsm.close()
+
+    def test_routes_404_without_fleet_mode(self):
+        rsm = RemoteStorageManager()
+        rsm.configure(BASE_CONFIG)
+        gateway = SidecarHttpGateway(rsm).start()
+        try:
+            assert _http_json(gateway.port, "GET", "/fleet/ping")[0] == 404
+            assert _http_json(
+                gateway.port, "POST", "/fleet/gossip", body=b"{}"
+            )[0] == 404
+        finally:
+            gateway.stop()
+            rsm.close()
+
+    def test_bad_gossip_payload_is_400(self, gossip_pair):
+        _, gateways = gossip_pair
+        status, _ = _http_json(
+            gateways["a"].port, "POST", "/fleet/gossip", body=b"[1,2]"
+        )
+        assert status == 400
+        status, _ = _http_json(
+            gateways["a"].port, "POST", "/fleet/gossip",
+            body=json.dumps({"from": "x"}).encode(),
+        )
+        assert status == 400

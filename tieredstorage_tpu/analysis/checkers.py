@@ -37,6 +37,7 @@ DEADLINE_NAME_FRAGMENTS = (
 DAEMON_LOOP_FUNCTIONS = {
     "tieredstorage_tpu/storage/replicated.py:HealthProber._run",
     "tieredstorage_tpu/sidecar/server.py:main",
+    "tieredstorage_tpu/fleet/gossip.py:GossipAgent._run",
 }
 
 #: Blocking-wait method names checked for a clamped timeout argument.
@@ -126,6 +127,8 @@ SANCTIONED_THREAD_SPAWNS = {
         "scrub daemon (one per RSM)",
     "tieredstorage_tpu/sidecar/http_gateway.py:SidecarHttpGateway.start":
         "gateway accept loop (workers ride the bounded executor)",
+    "tieredstorage_tpu/fleet/gossip.py:GossipAgent.start":
+        "gossip membership daemon (one per fleet member, stopped via stop)",
 }
 
 

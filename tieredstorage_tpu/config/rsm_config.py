@@ -409,6 +409,53 @@ def _base_def() -> ConfigDef:
             "forward after the cooldown is the health probe.",
     ))
     d.define(ConfigKey(
+        "fleet.replication.factor", "int", default=2,
+        validator=in_range(1, 16), importance="medium",
+        doc="Replica owners per segment key: the R distinct ring successors "
+            "of the key's hash. Non-owner misses try the owners in ring "
+            "order (first-owner preference keeps the hot arc concentrated; "
+            "a dead first owner fails over to the next with one forward "
+            "hop), so a hard-killed instance loses no cache tier. 1 "
+            "restores single-owner routing.",
+    ))
+    d.define(ConfigKey(
+        "fleet.gossip.enabled", "bool", default=False, importance="medium",
+        doc="Run the SWIM-style gossip membership daemon (fleet/gossip.py): "
+            "periodic probes over the shim-wire gateway (POST /fleet/gossip) "
+            "carry membership deltas, unreachable members degrade "
+            "alive -> suspect -> dead, and each agreed view is applied to "
+            "the ring as an epoch-numbered membership. fleet.instances "
+            "becomes the SEED set only. Requires fleet.enabled and the "
+            "HTTP gateway.",
+    ))
+    d.define(ConfigKey(
+        "fleet.gossip.interval.ms", "long", default=1_000,
+        validator=in_range(10, None), importance="low",
+        doc="Gossip protocol period: one probe/exchange per period, and the "
+            "unit the suspect/dead thresholds are counted in.",
+    ))
+    d.define(ConfigKey(
+        "fleet.gossip.probe.timeout.ms", "long", default=750,
+        validator=in_range(1, None), importance="low",
+        doc="Socket timeout for one gossip probe round trip; keep it below "
+            "fleet.gossip.interval.ms so a wedged peer cannot stall the "
+            "protocol period.",
+    ))
+    d.define(ConfigKey(
+        "fleet.gossip.suspect.periods", "int", default=3,
+        validator=in_range(1, None), importance="low",
+        doc="Protocol periods without hearing from a member before it is "
+            "marked SUSPECT (still in the ring — suspicion is refutable by "
+            "an incarnation bump, so a slow member does not thrash keys).",
+    ))
+    d.define(ConfigKey(
+        "fleet.gossip.dead.periods", "int", default=3,
+        validator=in_range(1, None), importance="low",
+        doc="Protocol periods a member stays SUSPECT without refutation "
+            "before it is declared DEAD and removed from the ring (bounded "
+            "key movement: only the dead member's arcs move).",
+    ))
+    d.define(ConfigKey(
         "replication.antientropy.enabled", "bool", default=False, importance="medium",
         doc="Run the background anti-entropy repairer when the storage "
             "backend is a ReplicatedStorageBackend: periodic passes diff "
@@ -493,6 +540,10 @@ class RemoteStorageManagerConfig:
         if self._values["fleet.enabled"] and not self._values["fleet.instance.id"]:
             raise ConfigException(
                 "fleet.instance.id must be provided if fleet.enabled is"
+            )
+        if self._values["fleet.gossip.enabled"] and not self._values["fleet.enabled"]:
+            raise ConfigException(
+                "fleet.enabled must be enabled if fleet.gossip.enabled is"
             )
         if self.encryption_enabled:
             if not self._values["encryption.key.pair.id"]:
@@ -724,6 +775,30 @@ class RemoteStorageManagerConfig:
     @property
     def fleet_peer_down_cooldown_ms(self) -> int:
         return self._values["fleet.peer.down.cooldown.ms"]
+
+    @property
+    def fleet_replication_factor(self) -> int:
+        return self._values["fleet.replication.factor"]
+
+    @property
+    def fleet_gossip_enabled(self) -> bool:
+        return self._values["fleet.gossip.enabled"]
+
+    @property
+    def fleet_gossip_interval_ms(self) -> int:
+        return self._values["fleet.gossip.interval.ms"]
+
+    @property
+    def fleet_gossip_probe_timeout_ms(self) -> int:
+        return self._values["fleet.gossip.probe.timeout.ms"]
+
+    @property
+    def fleet_gossip_suspect_periods(self) -> int:
+        return self._values["fleet.gossip.suspect.periods"]
+
+    @property
+    def fleet_gossip_dead_periods(self) -> int:
+        return self._values["fleet.gossip.dead.periods"]
 
     @property
     def replication_antientropy_enabled(self) -> bool:

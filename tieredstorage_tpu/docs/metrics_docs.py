@@ -98,6 +98,7 @@ def _collect_fleet() -> dict[str, list[str]]:
     from tieredstorage_tpu.fleet import (
         FleetMetrics,
         FleetRouter,
+        GossipAgent,
         PeerChunkCache,
         register_fleet_metrics,
     )
@@ -106,12 +107,16 @@ def _collect_fleet() -> dict[str, list[str]]:
     registry = MetricsRegistry()
     router = FleetRouter("docs", vnodes=4)
     peer_cache = PeerChunkCache(None, router)
+    gossip = GossipAgent(router, transport=lambda url, payload: payload)
     try:
-        register_fleet_metrics(registry, router=router, peer_cache=peer_cache)
+        register_fleet_metrics(
+            registry, router=router, peer_cache=peer_cache, gossip=gossip
+        )
         FleetMetrics(registry).record_forward(1.0)
         return _group_names(registry)
     finally:
         peer_cache.close()
+        gossip.stop()
 
 
 def _collect_scrub() -> dict[str, list[str]]:

@@ -6,10 +6,15 @@ consistent-hash ring (virtual nodes, bounded key movement under membership
 change), ``fleet/peer_cache.py`` resolves non-owner misses with one hop to
 the owner's chunk cache over the shim-wire gateway (``GET /chunk``), and
 ``fleet/singleflight.py`` collapses concurrent duplicate fetches — local or
-forwarded — to exactly one backend read. ``fleet/metrics.py`` exports the
-``fleet-metrics`` group. See docs/fleet.rst.
+forwarded — to exactly one backend read. Each key has R replica owners
+(``fleet.replication.factor`` ring successors, tried in order) so an
+instance death loses no cache tier, and ``fleet/gossip.py`` runs SWIM-style
+gossip membership (probe → suspect → dead, epoch-numbered views) so the
+fleet self-organizes through joins, failures, and rolling restarts.
+``fleet/metrics.py`` exports the ``fleet-metrics`` group. See docs/fleet.rst.
 """
 
+from tieredstorage_tpu.fleet.gossip import GossipAgent
 from tieredstorage_tpu.fleet.metrics import (
     FLEET_METRIC_GROUP,
     FleetMetrics,
@@ -27,6 +32,7 @@ __all__ = [
     "FLEET_METRIC_GROUP",
     "FleetMetrics",
     "FleetRouter",
+    "GossipAgent",
     "HashRing",
     "PeerChunkCache",
     "SingleFlight",

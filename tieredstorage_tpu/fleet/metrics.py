@@ -47,6 +47,7 @@ def register_fleet_metrics(
     *,
     router=None,
     peer_cache=None,
+    gossip=None,
 ) -> None:
     """Publish fleet counters as gauges (group ``fleet-metrics``)."""
 
@@ -63,16 +64,49 @@ def register_fleet_metrics(
               "Virtual nodes per instance on the ring")
         gauge("fleet-membership-generation", lambda: float(router.generation),
               "Membership changes applied (starts at 1)")
+        gauge("fleet-view-epoch", lambda: float(router.view_epoch),
+              "Epoch of the last gossip-agreed membership view applied "
+              "(0 = static membership only)")
         gauge(
             "fleet-local-ownership",
             lambda: float(router.local_ownership_fraction()),
             "Fraction of the hash circle owned by this instance (~1/N)",
         )
+    if gossip is not None:
+        from tieredstorage_tpu.fleet.gossip import ALIVE, DEAD, SUSPECT
+
+        gauge("fleet-members-alive", lambda: float(gossip.count_status(ALIVE)),
+              "Members the gossip view currently believes alive")
+        gauge("fleet-members-suspect", lambda: float(gossip.count_status(SUSPECT)),
+              "Members under unrefuted suspicion (still in the ring)")
+        gauge("fleet-members-dead", lambda: float(gossip.count_status(DEAD)),
+              "Members declared dead and removed from the ring")
+        gauge("fleet-gossip-periods-total", lambda: float(gossip.periods),
+              "Gossip protocol periods run")
+        gauge("fleet-gossip-probes-total", lambda: float(gossip.probes_sent),
+              "Gossip probes sent (one per period with a live target)")
+        gauge("fleet-gossip-acks-total", lambda: float(gossip.acks),
+              "Gossip probes answered (response view merged)")
+        gauge(
+            "fleet-gossip-probe-failures-total",
+            lambda: float(gossip.probe_failures),
+            "Gossip probes that failed in transport (missed heartbeat)",
+        )
+        gauge("fleet-gossip-refutations-total", lambda: float(gossip.refutations),
+              "Times this member refuted its own suspicion/obituary with "
+              "an incarnation bump")
+        gauge("fleet-gossip-deltas-total", lambda: float(gossip.deltas_applied),
+              "Membership delta entries merged from received views")
     if peer_cache is not None:
+        gauge("fleet-replication-factor", lambda: float(peer_cache.replication),
+              "Replica owners per segment key (ring successors tried in "
+              "order on a non-owner miss)")
         gauge("fleet-forwards-total", lambda: float(peer_cache.forwards),
               "Chunk windows forwarded to their owner instance")
         gauge("fleet-peer-hits-total", lambda: float(peer_cache.peer_hits),
               "Forwards answered by the owner's chunk tier")
+        gauge("fleet-failover-hits-total", lambda: float(peer_cache.failover_hits),
+              "Forwards answered by a non-first replica owner (failover)")
         gauge("fleet-peer-misses-total", lambda: float(peer_cache.peer_misses),
               "Forwards the owner could not serve (local fallback)")
         gauge(

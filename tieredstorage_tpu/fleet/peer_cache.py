@@ -90,14 +90,21 @@ class PeerChunkCache(ChunkManager):
         delegate: ChunkManager,
         router: FleetRouter,
         *,
+        replication: int = 2,
         forward_timeout_s: float = 2.0,
         down_cooldown_s: float = 5.0,
         tracer=NOOP_TRACER,
         on_forward=None,
         time_source=time.monotonic,
     ) -> None:
+        if replication < 1:
+            raise ValueError(f"replication factor must be >= 1, got {replication}")
         self._delegate = delegate
         self._router = router
+        #: R replica owners per key (`fleet.replication.factor`): misses try
+        #: them in ring order, so the death of the first owner fails over to
+        #: the second with one forward hop instead of losing the cache arc.
+        self.replication = replication
         self._flight = SingleFlight(tracer=tracer)
         self.tracer = tracer
         #: Optional `(elapsed_ms)` hook per completed forward; the RSM wires
@@ -118,6 +125,9 @@ class PeerChunkCache(ChunkManager):
         self.peer_hits = 0
         self.peer_misses = 0
         self.forward_failures = 0
+        #: Forwards answered by a non-first owner (the replication win:
+        #: requests that would have been backend reads pre-R>1).
+        self.failover_hits = 0
 
     @property
     def delegate(self) -> ChunkManager:
@@ -223,28 +233,38 @@ class PeerChunkCache(ChunkManager):
     def _resolve(
         self, objects_key: ObjectKey, manifest: SegmentManifestV1, chunk_ids: Sequence[int]
     ) -> list[bytes]:
-        owner, url = self._router.route(objects_key.value)
-        if (
-            url is not None
-            and not self._is_pinned(objects_key.value)
-            and not self._is_down(owner)
-        ):
-            forwarded = self._try_forward(owner, url, objects_key, chunk_ids)
-            if forwarded is not None:
-                return forwarded
+        """Try the key's R replica owners in ring order; serve locally when
+        this instance is the highest-priority reachable owner (or every
+        owner is down/unreachable — forwarding is never a dependency)."""
+        if not self._is_pinned(objects_key.value):
+            owners = self._router.route_owners(objects_key.value, self.replication)
+            for rank, (owner, url) in enumerate(owners):
+                if url is None:
+                    # This instance (or an address-less member) is the first
+                    # live owner: the local chunk path IS the replica serve,
+                    # and it warms this instance's arc copy.
+                    break
+                if self._is_down(owner):
+                    continue
+                forwarded = self._try_forward(
+                    owner, url, objects_key, chunk_ids, rank=rank
+                )
+                if forwarded is not None:
+                    return forwarded
         return self._delegate.get_chunks(objects_key, manifest, list(chunk_ids))
 
     def _try_forward(
-        self, owner: str, url: str, objects_key: ObjectKey, chunk_ids: Sequence[int]
+        self, owner: str, url: str, objects_key: ObjectKey,
+        chunk_ids: Sequence[int], *, rank: int = 0,
     ) -> Optional[list[bytes]]:
-        """One GET /chunk against the owner; None means 'serve locally'
-        (miss, peer down, torn frame) — never an error."""
+        """One GET /chunk against the owner; None means 'try the next owner,
+        then serve locally' (miss, peer down, torn frame) — never an error."""
         with self._lock:
             self.forwards += 1
             note_mutation("peer_cache.PeerChunkCache.forwards")
         self.tracer.event(
             "fleet.forward", peer=owner, key=objects_key.value,
-            chunks=len(chunk_ids),
+            chunks=len(chunk_ids), rank=rank,
         )
         # The wire carries a contiguous lo-hi window; a sparse id list (the
         # cache's missing-subset can have gaps) over-fetches the covering
@@ -284,6 +304,9 @@ class PeerChunkCache(ChunkManager):
             with self._lock:
                 self.peer_hits += 1
                 note_mutation("peer_cache.PeerChunkCache.peer_hits")
+                if rank > 0:
+                    self.failover_hits += 1
+                    note_mutation("peer_cache.PeerChunkCache.failover_hits")
             if self.on_forward is not None:
                 self.on_forward(elapsed_ms)
             self.tracer.event(

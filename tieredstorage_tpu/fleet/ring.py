@@ -45,9 +45,16 @@ class HashRing:
     def __init__(self, instances, vnodes: int = 64) -> None:
         if vnodes < 1:
             raise ValueError(f"vnodes must be >= 1, got {vnodes}")
-        names = sorted(set(instances))
-        if not names:
+        listed = list(instances)
+        if not listed:
             raise ValueError("a hash ring needs at least one instance")
+        names = sorted(set(listed))
+        if len(names) != len(listed):
+            # A duplicated name would silently halve the fleet's real
+            # capacity (two "members" sharing one arc set) and desync rings
+            # across members that happened to dedupe differently.
+            dupes = sorted({n for n in names if listed.count(n) > 1})
+            raise ValueError(f"duplicate ring instances: {', '.join(dupes)}")
         self.vnodes = vnodes
         self.instances = tuple(names)
         points: list[tuple[int, str]] = []
@@ -141,20 +148,38 @@ class FleetRouter:
         self._ring = HashRing([instance_id], vnodes)
         #: Membership generations applied (starts at 1 for the solo ring).
         self.generation = 1
+        #: Epoch of the last agreed view applied (0 = static membership
+        #: only). Gossip (fleet/gossip.py) numbers its views so a delayed
+        #: delivery can never roll the ring back to an older membership.
+        self.view_epoch = 0
 
-    def set_membership(self, peers: Mapping[str, Optional[str]]) -> None:
+    def set_membership(
+        self, peers: Mapping[str, Optional[str]], *, epoch: Optional[int] = None
+    ) -> bool:
         """Replace the fleet membership with {name: base_url|None}. The
-        local instance is always a member (added if absent)."""
+        local instance is always a member (added if absent).
+
+        `epoch` numbers gossip-agreed views: an epoch at or below the last
+        applied one is stale (a reordered delivery) and is ignored, so
+        routing stays a pure function of the NEWEST agreed view. Un-numbered
+        calls (bootstrap / tests / --fleet-peers) always apply. Returns
+        whether the view was applied."""
         members = dict(peers)
         members.setdefault(self.instance_id, None)
         ring = HashRing(members, self.vnodes)
         with self._lock:
+            if epoch is not None:
+                if epoch <= self.view_epoch:
+                    return False
+                self.view_epoch = epoch
             self._peers = members
             self._ring = ring
             self.generation += 1
         self.tracer.event(
-            "fleet.membership", instances=len(members), generation=self.generation
+            "fleet.membership", instances=len(members),
+            generation=self.generation, epoch=epoch if epoch is not None else 0,
         )
+        return True
 
     def remove_instance(self, name: str) -> None:
         """Drop a dead member; its arcs redistribute to the ring successors
@@ -195,6 +220,18 @@ class FleetRouter:
             if owner == self.instance_id:
                 return owner, None
             return owner, self._peers.get(owner)
+
+    def route_owners(self, key: str, r: int) -> list[tuple[str, Optional[str]]]:
+        """The R replica owners of `key` as ordered (owner, base_url) pairs —
+        ring-successor preference order, one consistent (ring, peers)
+        snapshot. base_url is None for the local instance and for members
+        whose address is unknown (both mean: serve locally when reached)."""
+        with self._lock:
+            owners = self._ring.owners(key, r)
+            return [
+                (o, None if o == self.instance_id else self._peers.get(o))
+                for o in owners
+            ]
 
     def peer_url(self, name: str) -> Optional[str]:
         with self._lock:

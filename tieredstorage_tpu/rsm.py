@@ -69,6 +69,7 @@ from tieredstorage_tpu.fetch.hedge import HedgeBudget, Hedger
 from tieredstorage_tpu.fleet import (
     FleetMetrics,
     FleetRouter,
+    GossipAgent,
     PeerChunkCache,
     parse_instances,
     register_fleet_metrics,
@@ -150,6 +151,7 @@ class RemoteStorageManager:
         self.fleet_router: Optional[FleetRouter] = None
         self._peer_cache: Optional[PeerChunkCache] = None
         self._fleet_metrics: Optional[FleetMetrics] = None
+        self._gossip: Optional[GossipAgent] = None
 
     # ------------------------------------------------------------------ setup
     def configure(self, configs: Mapping[str, object]) -> None:
@@ -288,10 +290,26 @@ class RemoteStorageManager:
         static = parse_instances(config.fleet_instances)
         if static:
             self.fleet_router.set_membership(static)
+        if config.fleet_gossip_enabled:
+            # Seeded from the static list (which becomes the SEED set only:
+            # gossip owns membership from here). Started explicitly via
+            # start_fleet_gossip once the gateway is up — probing peers
+            # before this instance can answer them would just spread
+            # suspicion of ourselves.
+            self._gossip = GossipAgent(
+                self.fleet_router,
+                interval_s=config.fleet_gossip_interval_ms / 1000.0,
+                probe_timeout_s=config.fleet_gossip_probe_timeout_ms / 1000.0,
+                suspect_periods=config.fleet_gossip_suspect_periods,
+                dead_periods=config.fleet_gossip_dead_periods,
+                tracer=self.tracer,
+            )
         self._fleet_metrics = FleetMetrics(self._metrics.registry)
         log.info(
-            "Fleet mode enabled: instance=%s vnodes=%d members=%s",
+            "Fleet mode enabled: instance=%s vnodes=%d replication=%d "
+            "gossip=%s members=%s",
             config.fleet_instance_id, config.fleet_vnodes,
+            config.fleet_replication_factor, config.fleet_gossip_enabled,
             sorted(self.fleet_router.peers) or [config.fleet_instance_id],
         )
 
@@ -299,15 +317,87 @@ class RemoteStorageManager:
     def peer_chunk_cache(self) -> Optional[PeerChunkCache]:
         return self._peer_cache
 
+    @property
+    def gossip_agent(self) -> Optional[GossipAgent]:
+        return self._gossip
+
     def set_fleet_peers(self, peers: Mapping[str, Optional[str]]) -> None:
         """Replace fleet membership with {name: base_url|None} — the
         bootstrap hook for deployments whose gateway ports are only known
         after bind (tools/fleet_demo.py), and the demotion hook when a
         member is declared dead (bounded key movement: only the arcs of the
-        changed instances move)."""
+        changed instances move). Under gossip this reseeds the agent: the
+        entries join the probe set, and membership is gossip's from there."""
         if self.fleet_router is None:
             raise RemoteStorageException("fleet mode is not enabled")
         self.fleet_router.set_membership(peers)
+        if self._gossip is not None:
+            self._gossip.seed(peers)
+
+    def start_fleet_gossip(self) -> Optional[GossipAgent]:
+        """Start the gossip membership daemon (`fleet.gossip.enabled`).
+        Called once the HTTP gateway is bound — the sidecar CLI does this
+        after SIDECAR_READY so inbound /fleet/gossip probes can be
+        answered from the first period."""
+        if self._gossip is not None:
+            self._gossip.start()
+        return self._gossip
+
+    def fleet_gossip(self, payload: Mapping) -> dict:
+        """Serve one inbound gossip exchange (the gateway's POST
+        /fleet/gossip): merge the sender's view, answer with ours."""
+        if self._gossip is None:
+            raise RemoteStorageException("fleet gossip is not enabled")
+        return self._gossip.on_gossip(payload)
+
+    def fleet_ping(self, *, include_witness: bool = False) -> dict:
+        """Liveness/status body for the gateway's GET /fleet/ping: ring
+        state, the gossip view, peer-tier counters, and (on request) the
+        runtime lock/race witness verdicts — the observability surface the
+        multi-process soak (tools/fleet_soak.py) drives its convergence
+        and zero-violation gates through."""
+        if self.fleet_router is None:
+            raise RemoteStorageException("fleet mode is not enabled")
+        router = self.fleet_router
+        status: dict = {
+            "instance": router.instance_id,
+            "generation": router.generation,
+            "view_epoch": router.view_epoch,
+            "ring_instances": sorted(router.instances),
+        }
+        if self._gossip is not None:
+            status["gossip"] = {
+                "epoch": self._gossip.epoch,
+                "periods": self._gossip.periods,
+                "members": {
+                    name: {"status": m.status, "incarnation": m.incarnation}
+                    for name, m in self._gossip.members().items()
+                },
+            }
+        if self._peer_cache is not None:
+            cache = self._peer_cache
+            status["peer_cache"] = {
+                "replication": cache.replication,
+                "forwards": cache.forwards,
+                "peer_hits": cache.peer_hits,
+                "peer_misses": cache.peer_misses,
+                "forward_failures": cache.forward_failures,
+                "failover_hits": cache.failover_hits,
+            }
+        if self._fault_schedule is not None:
+            status["storage_fetch_calls"] = self._fault_schedule.calls("fetch")
+        if include_witness:
+            from tieredstorage_tpu.analysis import races
+            from tieredstorage_tpu.utils.locks import witness, witness_enabled
+
+            crosscheck = races.runtime_crosscheck()
+            status["witness"] = {
+                "enabled": witness_enabled(),
+                "lock_violations": list(witness().violations),
+                "race_violations": crosscheck["violations"],
+                "race_sites_observed": crosscheck["validated"],
+            }
+        return status
 
     def fleet_fetch_chunks(
         self, object_key_value: str, first: int, last: int
@@ -543,6 +633,7 @@ class RemoteStorageManager:
                 self._metrics.registry,
                 router=self.fleet_router,
                 peer_cache=self._peer_cache,
+                gossip=self._gossip,
             )
 
     def _register_cache_metrics(self) -> None:
@@ -582,6 +673,7 @@ class RemoteStorageManager:
                 self._peer_cache = PeerChunkCache(
                     default,
                     self.fleet_router,
+                    replication=config.fleet_replication_factor,
                     forward_timeout_s=config.fleet_forward_timeout_ms / 1000.0,
                     down_cooldown_s=config.fleet_peer_down_cooldown_ms / 1000.0,
                     tracer=self.tracer,
@@ -1017,6 +1109,8 @@ class RemoteStorageManager:
             ) from failures[0][1]
 
     def close(self) -> None:
+        if self._gossip is not None:
+            self._gossip.stop()
         if self._antientropy_scheduler is not None:
             self._antientropy_scheduler.stop()
         if self._scrub_scheduler is not None:

@@ -33,6 +33,16 @@ Fleet mode (ISSUE 6) adds two things at this boundary:
   handled by a fixed executor instead of one unbounded thread each, so a
   fleet instance under fan-in keeps a bounded thread count and excess
   connections queue instead of multiplying stacks.
+
+Gossip membership (ISSUE 11) adds the SWIM exchange pair:
+
+- ``POST /fleet/gossip`` — one membership exchange: the sender's JSON view
+  is merged (fleet/gossip.py precedence rules) and this member's full view
+  is the response. NOT admission-gated: gossip is the failure detector, and
+  shedding it under load would make overload read as mass death.
+- ``GET /fleet/ping[?witness=1]`` — liveness + status: ring generation and
+  epoch, the gossip view, peer-tier counters; ``witness=1`` adds the
+  runtime lock/race witness verdicts (the multi-process soak's gate).
 """
 
 from __future__ import annotations
@@ -220,6 +230,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(200)
         elif parts.path in ("/chunk", "/v1/chunk"):
             self._peer_chunk(parts.query)
+        elif parts.path in ("/fleet/ping", "/v1/fleet/ping"):
+            self._fleet_ping(parts.query)
         elif self.path in ("/scrub", "/v1/scrub"):
             # Integrity-scrubber status: scheduler state, cumulative
             # counters, and the last pass summary ({"enabled": false} when
@@ -269,7 +281,55 @@ class _Handler(BaseHTTPRequestHandler):
 
         self._reply(200, encode_chunk_frames(chunks))
 
+    def _fleet_ping(self, query: str) -> None:
+        """Fleet liveness/status: ring + gossip view (+ witness verdicts on
+        ``?witness=1`` — a full static-vs-runtime crosscheck, so only drills
+        like tools/fleet_soak.py ask for it)."""
+        import json
+
+        ping = getattr(self.rsm, "fleet_ping", None)
+        if ping is None or getattr(self.rsm, "fleet_router", None) is None:
+            self._reply(404, b"fleet mode disabled")
+            return
+        params = parse_qs(query, keep_blank_values=False, strict_parsing=False)
+        include_witness = params.get("witness", ["0"])[0] in ("1", "true")
+        try:
+            status = ping(include_witness=include_witness)
+        except Exception as exc:  # noqa: BLE001 — boundary translation
+            self._fail(exc)
+            return
+        self._reply(200, json.dumps(status, indent=1).encode("utf-8"))
+
+    def _fleet_gossip(self) -> None:
+        """One SWIM membership exchange: merge the sender's JSON view,
+        answer with ours. Not admission-gated (see module docstring)."""
+        import json
+
+        serve = getattr(self.rsm, "fleet_gossip", None)
+        if serve is None or getattr(self.rsm, "gossip_agent", None) is None:
+            self._reply(404, b"fleet gossip disabled")
+            return
+        try:
+            body = self._body()
+        except Exception as exc:  # noqa: BLE001 — body-framing failure
+            self._fail(exc)
+            self.close_connection = True
+            return
+        try:
+            with contextlib.closing(body):
+                payload = json.loads(body.read())
+                if not isinstance(payload, dict):
+                    raise ValueError("gossip payload must be a JSON object")
+                view = serve(payload)
+        except Exception as exc:  # noqa: BLE001 — boundary translation
+            self._fail(exc)
+            return
+        self._reply(200, json.dumps(view).encode("utf-8"))
+
     def do_POST(self) -> None:
+        if self.path in ("/fleet/gossip", "/v1/fleet/gossip"):
+            self._fleet_gossip()
+            return
         routes = {
             "/v1/copy": self._copy,
             "/v1/fetch": self._fetch,

@@ -300,6 +300,10 @@ def main(argv: Optional[list[str]] = None) -> None:
         from tieredstorage_tpu.sidecar.http_gateway import SidecarHttpGateway
 
         gateway = SidecarHttpGateway(rsm, port=args.http_port, host=args.host).start()
+    # Gossip membership starts only once the gateway can answer inbound
+    # /fleet/gossip probes (fleet.gossip.enabled is a no-op otherwise).
+    if gateway is not None:
+        rsm.start_fleet_gossip()
     server = SidecarServer(rsm, port=args.port, host=args.host).start()
     print(
         f"SIDECAR_READY port={server.port}"

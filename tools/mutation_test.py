@@ -67,6 +67,11 @@ DEFAULT_TARGETS = [
     # load-bearing invariants; an operator flip that blinds them must fail.
     ("tieredstorage_tpu/analysis/races.py", ["tests/test_race_checker.py"]),
     ("tieredstorage_tpu/analysis/dispatch.py", ["tests/test_dispatch_checker.py"]),
+    # ISSUE 11: the fleet's correctness is ring arithmetic + gossip merge
+    # precedence; an operator flip in either silently mis-routes or
+    # mis-converges a production fleet.
+    ("tieredstorage_tpu/fleet/ring.py", ["tests/test_fleet.py"]),
+    ("tieredstorage_tpu/fleet/gossip.py", ["tests/test_fleet_gossip.py"]),
 ]
 
 _CMP_SWAP = {
