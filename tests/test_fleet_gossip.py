@@ -257,6 +257,31 @@ class TestFailureDetector:
         assert cluster.agents["a"].epoch == death_epoch
         assert cluster.routers["a"].view_epoch == death_epoch
 
+    def test_suspect_holds_until_dead_periods_fully_elapse(self):
+        # The suspect->dead timer must count dead_periods from the moment
+        # of suspicion — not fire early (premature death would thrash keys
+        # on every slow member).
+        cluster = _Cluster(suspect_periods=2, dead_periods=4)
+        cluster.tick(3)
+        cluster.alive.discard("c")
+        cluster.tick(4)  # past suspicion, dead timer still running
+        assert cluster.agents["a"].members()["c"].status == SUSPECT
+        cluster.tick(6)  # now well past dead_periods
+        assert cluster.agents["a"].members()["c"].status == DEAD
+
+    def test_entry_without_url_never_erases_a_known_address(self):
+        agent = GossipAgent(
+            FleetRouter("me", vnodes=4), transport=lambda u, p: p,
+            time_source=lambda: 0.0,
+        )
+        agent.seed({"x": "http://x:1"})
+        agent.merge({"members": [
+            {"name": "x", "url": None, "incarnation": 0, "status": ALIVE,
+             "heartbeat": 1},
+        ]})
+        # The address-less relay refreshed liveness but kept the address.
+        assert agent.members()["x"].url == "http://x:1"
+
     def test_stopped_agent_refuses_exchanges(self):
         from tieredstorage_tpu.fleet.gossip import GossipStoppedError
 
