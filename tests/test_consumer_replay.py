@@ -1,0 +1,163 @@
+"""Massed consumer-group replay: ROADMAP item 5's first scenario contract.
+
+The reference's hottest fetch shape (SURVEY L1/L3): a consumer-group
+rebalance sends hundreds of consumers re-reading the SAME segment from
+offset 0 through the full fetch chain. Contract under that storm, with the
+ISSUE-12 hot tier armed::
+
+    ChunkCache (deliberately tiny - always evicting)
+      -> DeviceHotCache -> DefaultChunkManager -> storage
+
+- every reader sees byte-identical plaintext;
+- over a WARM store the replay performs ZERO further GCM device dispatches
+  and ZERO further storage reads (decrypt-once, serve-many);
+- the hot tier's counters account every request (hits + misses == requests).
+
+The 200-reader variant is ``chaos``-marked so it doubles as the hot-tier
+soak under ``make chaos`` (lock witness + guarded-by runtime crosscheck
+armed there).
+"""
+
+from __future__ import annotations
+
+import io
+import random
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from tieredstorage_tpu.fetch.cache.device_hot import DeviceHotCache  # noqa: E402
+from tieredstorage_tpu.fetch.cache.memory import MemoryChunkCache  # noqa: E402
+from tieredstorage_tpu.fetch.chunk_manager import DefaultChunkManager  # noqa: E402
+from tieredstorage_tpu.manifest.chunk_index import FixedSizeChunkIndex  # noqa: E402
+from tieredstorage_tpu.manifest.encryption_metadata import (  # noqa: E402
+    SegmentEncryptionMetadataV1,
+)
+from tieredstorage_tpu.manifest.segment_indexes import (  # noqa: E402
+    IndexType,
+    SegmentIndexesV1Builder,
+)
+from tieredstorage_tpu.manifest.segment_manifest import SegmentManifestV1  # noqa: E402
+from tieredstorage_tpu.ops import gcm  # noqa: E402
+from tieredstorage_tpu.security.aes import AesEncryptionProvider  # noqa: E402
+from tieredstorage_tpu.storage.core import ObjectKey  # noqa: E402
+from tieredstorage_tpu.transform.api import TransformOptions  # noqa: E402
+from tieredstorage_tpu.transform.tpu import TpuTransformBackend  # noqa: E402
+
+CHUNK = 4 << 10
+N_CHUNKS = 16
+WINDOW = 4
+KEY = ObjectKey("replay/topic-replay/0/00000000000000000000-seg.log")
+
+
+class CountingFetcher:
+    """ObjectFetcher over the transformed blob, counting ranged reads."""
+
+    def __init__(self, blob: bytes) -> None:
+        self._blob = blob
+        self.reads = 0
+        self._lock = threading.Lock()
+
+    def fetch(self, key, r):
+        with self._lock:
+            self.reads += 1
+        return io.BytesIO(self._blob[r.from_position : r.to_position + 1])
+
+
+def build_chain():
+    """Full fetch chain over one encrypted segment; the chunk cache is
+    sized to hold ONE chunk so every repeat read falls through to the hot
+    tier (the cache tier's own hit path is covered elsewhere)."""
+    rng = random.Random(5)
+    chunks = [
+        bytes(rng.getrandbits(8) for _ in range(CHUNK)) for _ in range(N_CHUNKS)
+    ]
+    dk = AesEncryptionProvider.create_data_key_and_aad()
+    backend = TpuTransformBackend()
+    ivs = [i.to_bytes(4, "big") * 3 for i in range(1, N_CHUNKS + 1)]
+    blob = b"".join(backend.transform(chunks, TransformOptions(encryption=dk, ivs=ivs)))
+    fetcher = CountingFetcher(blob)
+    index = FixedSizeChunkIndex(
+        original_chunk_size=CHUNK, original_file_size=CHUNK * N_CHUNKS,
+        transformed_chunk_size=CHUNK + 28, final_transformed_chunk_size=CHUNK + 28,
+    )
+    builder = SegmentIndexesV1Builder()
+    for t in (IndexType.OFFSET, IndexType.TIMESTAMP,
+              IndexType.PRODUCER_SNAPSHOT, IndexType.LEADER_EPOCH):
+        builder.add(t, 0)
+    manifest = SegmentManifestV1(
+        chunk_index=index, segment_indexes=builder.build(), compression=False,
+        encryption=SegmentEncryptionMetadataV1(dk.data_key, dk.aad),
+        remote_log_segment_metadata=None,
+    )
+    default = DefaultChunkManager(fetcher, backend)
+    hot = DeviceHotCache(
+        default, backend, innermost=default,
+        budget_bytes=1 << 30, admission_hits=2,
+    )
+    cache = MemoryChunkCache(hot)
+    cache.configure({"size": CHUNK, "prefetch.max.size": 0})
+    return chunks, manifest, cache, hot, fetcher
+
+
+def replay_full_segment(cache, manifest, chunks, errors, reader_id):
+    """One consumer: re-read the whole segment from offset 0 in windows."""
+    for lo in range(0, N_CHUNKS, WINDOW):
+        ids = list(range(lo, lo + WINDOW))
+        got = cache.get_chunks(KEY, manifest, ids)
+        if got != chunks[lo : lo + WINDOW]:
+            errors.append((reader_id, lo))
+
+
+def run_replay(n_readers: int) -> None:
+    chunks, manifest, cache, hot, fetcher = build_chain()
+    try:
+        # Warm sequentially: sweep 1 decrypts (below the promotion
+        # threshold), sweep 2 admits every window.
+        for _ in range(2):
+            errors: list = []
+            replay_full_segment(cache, manifest, chunks, errors, -1)
+            assert errors == []
+        assert hot.resident_windows == N_CHUNKS // WINDOW
+        assert hot.device_windows == N_CHUNKS // WINDOW
+
+        dispatches_before = gcm.device_dispatches()
+        reads_before = fetcher.reads
+        hits_before, misses_before = hot.hits, hot.misses
+        errors = []
+        with ThreadPoolExecutor(max_workers=min(32, n_readers)) as pool:
+            futures = [
+                pool.submit(replay_full_segment, cache, manifest, chunks,
+                            errors, i)
+                for i in range(n_readers)
+            ]
+            for f in futures:
+                f.result(timeout=120)
+        assert errors == [], f"byte diffs from readers {errors[:5]}"
+        # Decrypt-once, serve-many: the massed replay decrypts NOTHING and
+        # never reaches storage again.
+        assert gcm.device_dispatches() - dispatches_before == 0
+        assert fetcher.reads == reads_before
+        # Every request that reached the hot tier was a hit. The count is
+        # BELOW readers x windows by design: the chunk cache's per-chunk
+        # single-flight coalesces concurrent identical loads, so the storm
+        # collapses before it even reaches this tier.
+        requests = (hot.hits - hits_before) + (hot.misses - misses_before)
+        assert hot.misses - misses_before == 0
+        assert 0 < requests <= n_readers * (N_CHUNKS // WINDOW)
+    finally:
+        cache.close()
+
+
+class TestConsumerGroupReplay:
+    def test_rebalance_replay_24_consumers(self):
+        run_replay(24)
+
+    @pytest.mark.chaos
+    def test_massed_rebalance_replay_200_consumers_soak(self):
+        """Hundreds of concurrent consumers — the hot-tier soak (runs under
+        `make chaos` with the lock witness + race witness armed)."""
+        run_replay(200)

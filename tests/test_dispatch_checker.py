@@ -98,6 +98,12 @@ class TestClosure:
             "tieredstorage_tpu/ops/gcm.py:_gcm_varlen_batch",
             "tieredstorage_tpu/ops/aes_bitsliced.py:ctr_keystream_batch",
             "tieredstorage_tpu/ops/ghash_pallas.py:ghash_level1_pallas",
+            # ISSUE 12: the device hot-cache serve path is hot-path too — a
+            # materialization there turns every "free" hit into a d2h fetch.
+            "tieredstorage_tpu/fetch/cache/device_hot.py:DeviceHotCache.get_chunks",
+            "tieredstorage_tpu/fetch/cache/device_hot.py:DeviceHotCache._serve_hot",
+            "tieredstorage_tpu/fetch/cache/device_hot.py:DeviceHotCache.device_rows",
+            "tieredstorage_tpu/fetch/cache/device_hot.py:DeviceHotCache._maybe_admit",
         ):
             assert key in closure, key
 
@@ -129,6 +135,7 @@ class TestSeededRegression:
         for rel in (
             "tieredstorage_tpu/transform/tpu.py",
             "tieredstorage_tpu/ops/gcm.py",
+            "tieredstorage_tpu/fetch/cache/device_hot.py",
         ):
             dest = tmp_path / rel
             dest.parent.mkdir(parents=True, exist_ok=True)
@@ -169,6 +176,25 @@ class TestSeededRegression:
         tpu.write_text(src)
         report = run(load_project(root))
         assert "sync:block_until_ready" in details(report)
+
+    def test_seeded_asarray_on_hot_serve_path_is_one_finding(self, tmp_path):
+        """ISSUE 12 gate: a hidden materialization of the retained device
+        rows on the hot SERVE path is a static finding."""
+        root = self._real_copy(tmp_path)
+        hot = root / "tieredstorage_tpu/fetch/cache/device_hot.py"
+        src = hot.read_text()
+        anchor = "        served = self._serve_hot(file, chunk_ids)\n"
+        assert anchor in src
+        src = src.replace(
+            anchor,
+            anchor + "        _dbg = np.asarray("
+                     "self.device_rows(objects_key, chunk_ids))\n",
+        )
+        hot.write_text(src)
+        report = run(load_project(root))
+        assert details(report) == ["materialize:asarray"]
+        (finding,) = report.findings
+        assert finding.qualname == "DeviceHotCache.get_chunks"
 
 
 class TestMaterialization:

@@ -461,6 +461,18 @@ class TestRepoModel:
                 guards[f"peer_cache.PeerChunkCache.{counter}"]
                 == "peer_cache.PeerChunkCache._lock"
             )
+        # ISSUE 12: every hot-tier counter and residency map mutates under
+        # the tier's one lock; the sketch rows under the sketch's own.
+        for counter in ("hits", "misses", "admissions", "rejections",
+                        "evictions", "device_windows"):
+            assert (
+                guards[f"device_hot.DeviceHotCache.{counter}"]
+                == "device_hot.DeviceHotCache._lock"
+            )
+        assert (
+            guards["device_hot.FrequencySketch._counts"]
+            == "device_hot.FrequencySketch._lock"
+        )
         unguarded = model.unguarded_sites()
         assert "chunk_cache.ChunkCache.degradations" in unguarded
         assert "chunk_cache.ChunkCache.prefetch_failures" in unguarded

@@ -60,13 +60,19 @@ from typing import Optional
 from tieredstorage_tpu.analysis import lockorder
 from tieredstorage_tpu.analysis.core import Finding, Project
 
-#: Entry points of the hot window path (summary keys).
+#: Entry points of the hot window path (summary keys). The device hot-cache
+#: roots (ISSUE 12) cover the serve side: a resident decrypt buffer must be
+#: SLICED device-side, never materialized mid-serve — a hidden np.asarray
+#: on the hot serve path would turn every "free" hit into a device->host
+#: fetch and is a static finding here.
 HOT_PATH_ROOTS = (
     "tieredstorage_tpu/transform/tpu.py:TpuTransformBackend.transform_windows",
     "tieredstorage_tpu/transform/tpu.py:TpuTransformBackend._encrypt_dispatch",
     "tieredstorage_tpu/transform/tpu.py:TpuTransformBackend._decrypt_batch",
     "tieredstorage_tpu/ops/gcm.py:gcm_window_packed",
     "tieredstorage_tpu/ops/gcm.py:gcm_varlen_window_packed",
+    "tieredstorage_tpu/fetch/cache/device_hot.py:DeviceHotCache.get_chunks",
+    "tieredstorage_tpu/fetch/cache/device_hot.py:DeviceHotCache.device_rows",
 )
 
 #: Modules the closure may traverse: the window path and the kernel stack
@@ -81,6 +87,7 @@ HOT_PATH_MODULES = (
     "tieredstorage_tpu/ops/aes_pallas.py",
     "tieredstorage_tpu/ops/ghash_pallas.py",
     "tieredstorage_tpu/parallel/mesh.py",
+    "tieredstorage_tpu/fetch/cache/device_hot.py",
 )
 
 #: Functions allowed to materialize device values, with the reason. This is
@@ -117,6 +124,8 @@ DEVICE_PRODUCER_NAMES = {
     "aes_encrypt_blocks", "ctr_keystream_batch",
     "aes_encrypt_planes_pallas", "ghash_level1_pallas",
     "device_put", "shard",
+    # Device hot-cache tier: retained decrypt rows stay device values.
+    "device_rows", "offer_decrypt_window",
 }
 DEVICE_PRODUCER_PREFIXES = ("jnp.", "jax.numpy.", "jax.device_put")
 

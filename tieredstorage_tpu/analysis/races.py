@@ -75,6 +75,13 @@ SHARED_CLASSES = {
     "tieredstorage_tpu/fleet/peer_cache.py:PeerChunkCache":
         "one peer tier per instance, hit by every gateway worker thread "
         "and the chunk cache's loader pool",
+    "tieredstorage_tpu/fetch/cache/device_hot.py:DeviceHotCache":
+        "one hot-window tier per RSM, hit by every gateway worker thread "
+        "and the chunk cache's loader pool (serve/admit/evict counters and "
+        "the resident-window maps)",
+    "tieredstorage_tpu/fetch/cache/device_hot.py:FrequencySketch":
+        "the hot tier's admission sketch, touched from every thread the "
+        "tier itself is (count-min rows + decay op counter)",
 }
 
 #: Executor dispatch method names whose first argument runs on a pool thread.

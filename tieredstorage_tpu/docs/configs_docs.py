@@ -92,6 +92,17 @@ def generate() -> str:
     )
     out += _section("DiskChunkCacheConfig (additional keys)")
     out.append(render_config_def(cache_config._disk_cache_extra()))
+    out += _section("DeviceHotCacheConfig")
+    from tieredstorage_tpu.fetch.cache import device_hot
+
+    out.extend([
+        "The device-resident hot-window cache tier (decrypt once, serve",
+        "many): top-level keys read by the ChunkManagerFactory. The tier",
+        "sits between the chunk cache and the fleet peer tier and is",
+        "disabled unless ``cache.device.bytes`` is set.",
+        "",
+    ])
+    out.append(render_config_def(device_hot._definition()))
     out += _section("SegmentManifestCacheConfig (prefix: fetch.manifest.cache.)")
     out.append(
         render_config_def(

@@ -154,6 +154,11 @@ def _collect_caches() -> dict[str, list[str]]:
     pool = ThreadPoolExecutor(max_workers=1)
     register_thread_pool_metrics(registry, "chunk-cache-pool", pool)
     pool.shutdown(wait=False)
+
+    from tieredstorage_tpu.fetch.cache.device_hot import DeviceHotCache
+    from tieredstorage_tpu.metrics.cache_metrics import register_hot_cache_metrics
+
+    register_hot_cache_metrics(registry, DeviceHotCache(None))
     return _group_names(registry)
 
 

@@ -1,5 +1,13 @@
 from tieredstorage_tpu.fetch.cache.chunk_cache import ChunkCache, ChunkKey
+from tieredstorage_tpu.fetch.cache.device_hot import DeviceHotCache, FrequencySketch
 from tieredstorage_tpu.fetch.cache.disk import DiskChunkCache
 from tieredstorage_tpu.fetch.cache.memory import MemoryChunkCache
 
-__all__ = ["ChunkCache", "ChunkKey", "DiskChunkCache", "MemoryChunkCache"]
+__all__ = [
+    "ChunkCache",
+    "ChunkKey",
+    "DeviceHotCache",
+    "DiskChunkCache",
+    "FrequencySketch",
+    "MemoryChunkCache",
+]

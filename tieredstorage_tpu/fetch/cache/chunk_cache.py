@@ -139,6 +139,12 @@ class ChunkCache(ChunkManager, Generic[T], abc.ABC):
         # backend (delegate.get_chunks -> backend.detransform).
         if self._executor is not None:
             self._executor.shutdown(wait=True, cancel_futures=True)
+        # Chain down the tier stack (DeviceHotCache releases its retained
+        # device buffers, PeerChunkCache its peer clients); lower tiers'
+        # close() is idempotent, so the RSM's explicit peer-cache close
+        # stays safe.
+        if hasattr(self._delegate, "close"):
+            self._delegate.close()
 
     # ------------------------------------------------------------------ reads
     def get_chunk(

@@ -68,6 +68,12 @@ class DefaultChunkManager(ChunkManager):
     #: storage GET of a chunk window is raced against a delayed second
     #: attempt and the first success wins (`hedge.enabled`).
     hedger = None
+    #: Optional pre-detransform hook `(opts)` — the device hot-window tier
+    #: (fetch/cache/device_hot.py `note_detransform`) records the window's
+    #: DetransformOptions so admission can tell whether the decrypt output
+    #: rows ARE the final plaintext (encryption-only segments) and the
+    #: device buffer may be retained for hot serving.
+    on_detransform = None
 
     def __init__(
         self,
@@ -154,6 +160,8 @@ class DefaultChunkManager(ChunkManager):
             if fetch_span is not None:
                 fetch_span.attributes["bytes"] = stored_bytes
         opts = DetransformOptions.from_manifest(manifest)
+        if self.on_detransform is not None:
+            self.on_detransform(opts)
         try:
             with self.tracer.span(
                 "chunk.detransform", chunks=len(stored), bytes_in=stored_bytes,

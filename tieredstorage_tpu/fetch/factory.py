@@ -1,9 +1,15 @@
-"""ChunkManagerFactory: optionally wrap the default manager in a chunk cache.
+"""ChunkManagerFactory: optionally wrap the default manager in cache tiers.
 
 Reference: core/.../fetch/ChunkManagerFactory.java:36-52 (reflective wrap of
 DefaultChunkManager in the configured ChunkCache subclass) and
 config/ChunkManagerFactoryConfig.java:29-55 (`fetch.chunk.cache.class`,
 subclass-of-ChunkCache validated, no cache when unset).
+
+Extended TPU-first with the device hot-window tier (ISSUE 12): when
+``cache.device.bytes`` > 0 a `DeviceHotCache` is inserted between the chunk
+cache and the fleet peer tier, so the chain reads::
+
+    ChunkCache -> DeviceHotCache -> [PeerChunkCache] -> DefaultChunkManager
 """
 
 from __future__ import annotations
@@ -17,7 +23,9 @@ from tieredstorage_tpu.config.configdef import (
     subset_with_prefix,
 )
 from tieredstorage_tpu.config.rsm_config import FETCH_CHUNK_CACHE_PREFIX
+from tieredstorage_tpu.fetch.cache import device_hot
 from tieredstorage_tpu.fetch.cache.chunk_cache import ChunkCache
+from tieredstorage_tpu.fetch.cache.device_hot import DeviceHotCache
 from tieredstorage_tpu.fetch.chunk_manager import ChunkManager, DefaultChunkManager
 from tieredstorage_tpu.storage.core import ObjectFetcher
 from tieredstorage_tpu.transform.api import TransformBackend
@@ -33,12 +41,27 @@ class ChunkManagerFactoryConfig:
                 "included: MemoryChunkCache and DiskChunkCache. Unset means "
                 "no chunk caching.",
         ))
+        for key in device_hot._definition().keys.values():
+            d.define(key)
         self._values = d.parse(props)
         self._props = dict(props)
 
     @property
     def chunk_cache_class(self) -> Optional[type]:
         return self._values["fetch.chunk.cache.class"]
+
+    @property
+    def device_cache_bytes(self) -> int:
+        """HBM budget of the hot-window tier; 0 disables it."""
+        return self._values["cache.device.bytes"]
+
+    @property
+    def device_admission_hits(self) -> int:
+        return self._values["cache.device.admission.hits"]
+
+    @property
+    def device_sketch_width(self) -> int:
+        return self._values["cache.device.sketch.width"]
 
     def chunk_cache_configs(self) -> dict[str, Any]:
         # The stray "class" key the strip produces is ignored by the cache's
@@ -49,6 +72,10 @@ class ChunkManagerFactoryConfig:
 class ChunkManagerFactory:
     def __init__(self) -> None:
         self._config: Optional[ChunkManagerFactoryConfig] = None
+        #: The hot tier built by the last `init_chunk_manager` call (None
+        #: when `cache.device.bytes` is 0) — the RSM wires its tracer and
+        #: hot-cache-metrics gauges through this handle.
+        self.device_hot_cache: Optional[DeviceHotCache] = None
 
     def configure(self, configs: Mapping[str, Any]) -> None:
         self._config = ChunkManagerFactoryConfig(configs)
@@ -58,12 +85,27 @@ class ChunkManagerFactory:
         inner_wrapper=None,
     ) -> ChunkManager:
         """`inner_wrapper`, when given, wraps the DefaultChunkManager BELOW
-        the cache (fleet mode inserts the PeerChunkCache tier there: local
-        cache first, then route-to-owner, then backend)."""
+        the cache tiers (fleet mode inserts the PeerChunkCache tier there:
+        local cache first, then the hot tier, then route-to-owner, then
+        backend)."""
         default = DefaultChunkManager(fetcher, transform_backend)
         inner: ChunkManager = (
             inner_wrapper(default) if inner_wrapper is not None else default
         )
+        self.device_hot_cache = None
+        if self._config.device_cache_bytes > 0:
+            # Between ChunkCache and PeerChunkCache: a local chunk-cache
+            # miss tries the resident decrypted window BEFORE paying a peer
+            # forward or a storage fetch + detransform.
+            self.device_hot_cache = DeviceHotCache(
+                inner,
+                transform_backend,
+                innermost=default,
+                budget_bytes=self._config.device_cache_bytes,
+                admission_hits=self._config.device_admission_hits,
+                sketch_width=self._config.device_sketch_width,
+            )
+            inner = self.device_hot_cache
         cache_class = self._config.chunk_cache_class
         if cache_class is None:
             return inner

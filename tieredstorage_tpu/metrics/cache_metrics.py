@@ -20,6 +20,7 @@ from tieredstorage_tpu.utils.caching import CacheStats, RemovalCause
 
 CACHE_METRIC_GROUP = "cache-metrics"
 THREAD_POOL_METRIC_GROUP = "thread-pool-metrics"
+HOT_CACHE_METRIC_GROUP = "hot-cache-metrics"
 
 
 def register_cache_metrics(
@@ -57,6 +58,46 @@ def register_cache_metrics(
         gauge("cache-size-total", size_supplier, "Number of cached entries")
     if weight_supplier is not None:
         gauge("cache-weight-total", weight_supplier, "Total cached weight (bytes)")
+
+
+def register_hot_cache_metrics(registry: MetricsRegistry, hot_cache) -> None:
+    """Publish the device hot-window tier's counters as supplier gauges
+    (group ``hot-cache-metrics``; fetch/cache/device_hot.py)."""
+
+    def gauge(name: str, supplier, description: str = "") -> None:
+        registry.add_gauge(
+            MetricName.of(name, HOT_CACHE_METRIC_GROUP, description), supplier
+        )
+
+    gauge("hot-cache-hits-total", lambda: float(hot_cache.hits),
+          "Window reads fully served from resident decrypted windows "
+          "(zero GCM dispatches)")
+    gauge("hot-cache-misses-total", lambda: float(hot_cache.misses),
+          "Window reads with at least one non-resident chunk (delegated)")
+    gauge("hot-cache-hit-rate", lambda: float(hot_cache.hit_rate),
+          "hits / (hits + misses) since start")
+    gauge("hot-cache-chunks-served-total", lambda: float(hot_cache.chunks_served),
+          "Chunks sliced out of resident windows")
+    gauge("hot-cache-admissions-total", lambda: float(hot_cache.admissions),
+          "Windows admitted to the hot tier")
+    gauge("hot-cache-admission-rejections-total",
+          lambda: float(hot_cache.rejections),
+          "Admissions refused (below the promotion threshold, over budget, "
+          "or colder than the LRU victim)")
+    gauge("hot-cache-evictions-total", lambda: float(hot_cache.evictions),
+          "Windows evicted to fit the byte budget")
+    gauge("hot-cache-windows-resident", lambda: float(hot_cache.resident_windows),
+          "Windows currently resident")
+    gauge("hot-cache-device-windows-resident",
+          lambda: float(hot_cache.device_windows),
+          "Resident windows retaining their device-resident decrypt buffer")
+    gauge("hot-cache-bytes-resident", lambda: float(hot_cache.resident_bytes),
+          "Bytes resident (device buffers + pinned host mirrors)")
+    gauge("hot-cache-device-bytes-resident",
+          lambda: float(hot_cache.resident_device_bytes),
+          "Device-buffer bytes resident (HBM share of the budget)")
+    gauge("hot-cache-budget-bytes", lambda: float(hot_cache.budget_bytes),
+          "Configured cache.device.bytes budget")
 
 
 class DiskCacheMetrics:

@@ -131,6 +131,9 @@ class RemoteStorageManager:
         self._rsa: Optional[RsaEncryptionProvider] = None
         self._rate_bucket: Optional[TokenBucket] = None
         self._chunk_manager: Optional[ChunkManager] = None
+        #: Device hot-window tier (`cache.device.bytes`): retained decrypt
+        #: windows served without further GCM dispatches.
+        self._device_hot = None
         self._manifest_cache: Optional[MemorySegmentManifestCache] = None
         self._indexes_cache: Optional[MemorySegmentIndexesCache] = None
         self._metrics = None
@@ -316,6 +319,12 @@ class RemoteStorageManager:
     @property
     def peer_chunk_cache(self) -> Optional[PeerChunkCache]:
         return self._peer_cache
+
+    @property
+    def device_hot_cache(self):
+        """The device hot-window tier, or None when `cache.device.bytes`
+        is 0 (fetch/cache/device_hot.py)."""
+        return self._device_hot
 
     @property
     def gossip_agent(self) -> Optional[GossipAgent]:
@@ -571,6 +580,8 @@ class RemoteStorageManager:
         if isinstance(cm, ChunkCache):
             cm.tracer = self.tracer
             cm.on_get = self._metrics.record_cache_get
+        if self._device_hot is not None:
+            self._device_hot.tracer = self.tracer
 
     def _wrap_storage_resilience(
         self, config: RemoteStorageManagerConfig, storage: StorageBackend
@@ -661,6 +672,12 @@ class RemoteStorageManager:
 
             if isinstance(chunk_cache, DiskChunkCache):
                 chunk_cache.set_metrics_recorder(DiskCacheMetrics(registry))
+        if self._device_hot is not None:
+            from tieredstorage_tpu.metrics.cache_metrics import (
+                register_hot_cache_metrics,
+            )
+
+            register_hot_cache_metrics(registry, self._device_hot)
 
     def _build_chunk_manager(self, backend) -> ChunkManager:
         factory = ChunkManagerFactory()
@@ -681,7 +698,9 @@ class RemoteStorageManager:
                 )
                 return self._peer_cache
 
-        return factory.init_chunk_manager(self._storage, backend, wrapper)
+        manager = factory.init_chunk_manager(self._storage, backend, wrapper)
+        self._device_hot = factory.device_hot_cache
+        return manager
 
     @staticmethod
     def _innermost_chunk_manager(cm) -> Optional[DefaultChunkManager]:

@@ -138,6 +138,16 @@ class DispatchStats:
 
 
 class TpuTransformBackend(TransformBackend):
+    #: Optional decrypt-retention hook (`fetch/cache/device_hot.py`'s
+    #: ``offer_decrypt_window``): called with ``(out, sizes, n_bytes,
+    #: mesh_size)`` after each VERIFIED decrypt window, while the packed
+    #: ``output || tags`` buffer is still device-resident (row-sharded
+    #: under a mesh), so the hot tier can retain it without a second
+    #: decrypt or a host→device restage. The buffer is a fresh output
+    #: allocation — decrypt donates the STAGED ciphertext input, never
+    #: this — so retention can never alias a donated operand.
+    on_decrypt_window = None
+
     preferred_batch_chunks = 256
     # Window byte cap: with pipeline_depth=3 up to 4 windows are in flight
     # (compress k ∥ encrypt k-1..k-2 ∥ download k-3), each pinning padded
@@ -515,6 +525,9 @@ class TpuTransformBackend(TransformBackend):
         ]
         if bad:
             raise AuthenticationError(f"GCM tag mismatch on chunks {bad}")
+        hook = self.on_decrypt_window
+        if hook is not None:
+            hook(out, sizes, n_bytes, self.mesh_plan().size)
         return [host[i, : sizes[i]].tobytes() for i in range(len(chunks))]
 
 

@@ -72,6 +72,10 @@ DEFAULT_TARGETS = [
     # mis-converges a production fleet.
     ("tieredstorage_tpu/fleet/ring.py", ["tests/test_fleet.py"]),
     ("tieredstorage_tpu/fleet/gossip.py", ["tests/test_fleet_gossip.py"]),
+    # ISSUE 12: the hot tier's admission sketch, budget arithmetic, and
+    # eviction ordering are pure logic; a flipped comparison silently turns
+    # the cache into a scan-thrashed or never-admitting tier.
+    ("tieredstorage_tpu/fetch/cache/device_hot.py", ["tests/test_device_hot.py"]),
 ]
 
 _CMP_SWAP = {
