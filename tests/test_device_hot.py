@@ -147,6 +147,12 @@ class TestAdmission:
             hot.get_chunks(KEY, None, [0, 1])
         assert hot.resident_windows == 0
         assert len(delegate.calls) == 3
+        # budget_bytes == 0 means the tier is OFF: no admission accounting
+        # at all (no sketch touches, no rejection counts) — not merely
+        # "rejected as oversize".
+        assert (hot.admissions, hot.rejections, hot.evictions) == (0, 0, 0)
+        assert hot._sketch.estimate(_window_key(KEY.value.rsplit("/", 1)[-1],
+                                                (0, 1))) == 0
 
     def test_oversize_window_rejected(self):
         hot, _ = make_hot(0.5)  # budget: half a window
@@ -510,6 +516,55 @@ class TestDeviceCapture:
         window = hot._build_window("f#0-1", "f", (0, 1), got, cap)
         assert window.device is None  # compressed: mirror only
         assert window.nbytes == 2 * ENC_CHUNK
+
+    def test_hookless_collaborators_left_untouched(self):
+        """Constructor wiring is gated on hasattr BOTH sides: a backend or
+        innermost manager without the hook attribute must not grow one."""
+
+        class Bare:
+            pass
+
+        backend, innermost = Bare(), Bare()
+        DeviceHotCache(None, backend, innermost=innermost, budget_bytes=1)
+        assert not hasattr(backend, "on_decrypt_window")
+        assert not hasattr(innermost, "on_detransform")
+
+    def test_device_nbytes_prefers_buffer_attr(self):
+        """HBM accounting takes the buffer's own nbytes when it has one
+        (padded/sharded buffers are bigger than B rows)."""
+
+        class StubBuf:
+            nbytes = 99_999
+
+            def is_deleted(self):
+                return False
+
+        hot, _ = make_hot()
+        cap = type("C", (), {})()
+        chunks = [b"x" * 8, b"y" * 8]
+        cap.windows = [(StubBuf(), (8, 8), 8, 1)]
+        cap.opts = type("O", (), {"compression": False})()
+        window = hot._build_window("f#0-1", "f", (0, 1), chunks, cap)
+        assert window.device is not None
+        assert window.device_nbytes == 99_999
+        assert window.nbytes == 16 + 99_999
+
+    def test_device_nbytes_fallback_is_rows_times_padded_columns(self):
+        """Without an nbytes attribute the accounting falls back to
+        B * (n_bytes + 16 tag columns), exactly."""
+
+        class NoNbytes:
+            def is_deleted(self):
+                return False
+
+        hot, _ = make_hot()
+        cap = type("C", (), {})()
+        chunks = [b"x" * 8, b"y" * 8, b"z" * 8]
+        cap.windows = [(NoNbytes(), (8, 8, 8), 8, 1)]
+        cap.opts = type("O", (), {"compression": False})()
+        window = hot._build_window("f#0-2", "f", (0, 1, 2), chunks, cap)
+        assert window.device is not None
+        assert window.device_nbytes == 3 * (8 + 16)
 
     def test_size_mismatch_drops_device_half(self):
         chunks, backend, default, manifest = encrypted_store()
