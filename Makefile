@@ -153,7 +153,7 @@ lint: analyze
 # /root/reference/build.gradle:24): flips operators in core pure-logic
 # modules and requires the owning suites to notice.
 mutation:
-	$(PYTHON) tools/mutation_test.py --budget 72
+	$(PYTHON) tools/mutation_test.py --budget 80
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -197,6 +197,127 @@ class TestSeededRegression:
         assert finding.qualname == "DeviceHotCache.get_chunks"
 
 
+class TestFusedTraceClosure:
+    """ISSUE 13 checker family: the TRACE-scope closure (the packed impls
+    under `_packed_jit`) statically forbids inter-stage materialization —
+    the seeded acceptance gate is an injected materialization in a COPY of
+    the real fused closure yielding exactly one finding, the real tree
+    yielding zero."""
+
+    def _real_copy(self, tmp_path):
+        for rel in (
+            "tieredstorage_tpu/transform/tpu.py",
+            "tieredstorage_tpu/ops/gcm.py",
+            "tieredstorage_tpu/fetch/cache/device_hot.py",
+        ):
+            dest = tmp_path / rel
+            dest.parent.mkdir(parents=True, exist_ok=True)
+            shutil.copy(REPO_ROOT / rel, dest)
+        return tmp_path
+
+    def test_trace_closure_spans_the_fused_program(self):
+        project = load_project(REPO_ROOT)
+        closure, _, _ = dispatch.build_closure(
+            project, dispatch.TRACE_CLOSURE_ROOTS,
+            stop_at=frozenset(dispatch.SANCTIONED_MATERIALIZERS),
+        )
+        for key in (
+            "tieredstorage_tpu/ops/gcm.py:_packed_fixed_impl",
+            "tieredstorage_tpu/ops/gcm.py:_packed_varlen_impl",
+            "tieredstorage_tpu/ops/gcm.py:_gcm_process_batch",
+            "tieredstorage_tpu/ops/gcm.py:_gcm_varlen_batch",
+            "tieredstorage_tpu/ops/gcm.py:_ghash_grouped",
+            "tieredstorage_tpu/ops/ghash_pallas.py:ghash_tree_pallas",
+            "tieredstorage_tpu/ops/ghash_pallas.py:ghash_level1_pallas",
+            "tieredstorage_tpu/ops/aes_bitsliced.py:ctr_keystream_batch",
+        ):
+            assert key in closure, key
+
+    def test_stop_at_prunes_sanctioned_gate_subtrees(self):
+        """The trace-time host gates (memoized preflight cross-checks)
+        stay in the closure but their host-side callees do not — a
+        key_expansion np.array on the context-build path must never be a
+        trace-scope finding."""
+        project = load_project(REPO_ROOT)
+        closure, _, _ = dispatch.build_closure(
+            project, dispatch.TRACE_CLOSURE_ROOTS,
+            stop_at=frozenset(dispatch.SANCTIONED_MATERIALIZERS),
+        )
+        assert "tieredstorage_tpu/ops/aes.py:key_expansion" not in closure
+
+    def test_sanctioned_staged_reducer_exists(self):
+        project = load_project(REPO_ROOT)
+        closure, _, _ = dispatch.build_closure(
+            project, dispatch.TRACE_CLOSURE_ROOTS,
+        )
+        for key in dispatch.SANCTIONED_STAGED_REDUCERS:
+            assert key in closure, f"stale sanctioned staged reducer {key}"
+
+    def test_real_fused_closure_is_clean(self):
+        report = run(load_project(REPO_ROOT))
+        assert report.findings == [], "\n".join(
+            f.render() for f in report.findings
+        )
+
+    def test_seeded_interstage_asarray_is_one_finding(self, tmp_path):
+        """THE acceptance gate: materializing the GHASH handoff between
+        stages of the real fused closure = exactly one finding."""
+        root = self._real_copy(tmp_path)
+        gcm = root / "tieredstorage_tpu/ops/gcm.py"
+        src = gcm.read_text()
+        anchor = "    t_c = _ghash_grouped(ct_padded, agg_mats, step_mat)\n"
+        assert anchor in src
+        src = src.replace(anchor, anchor + "    t_c = np.asarray(t_c)\n")
+        gcm.write_text(src)
+        report = run(load_project(root))
+        assert details(report) == ["interstage:materialize:asarray"]
+        (finding,) = report.findings
+        assert finding.qualname == "_ghash_of_ct"
+
+    def test_seeded_sync_in_trace_scope_is_caught(self, tmp_path):
+        root = self._real_copy(tmp_path)
+        gcm = root / "tieredstorage_tpu/ops/gcm.py"
+        src = gcm.read_text()
+        anchor = "    output = data ^ keystream\n"
+        assert anchor in src
+        src = src.replace(
+            anchor, anchor + "    jax.block_until_ready(keystream)\n", 1
+        )
+        gcm.write_text(src)
+        report = run(load_project(root))
+        assert "interstage:sync:block_until_ready" in details(report)
+
+    def test_seeded_unsanctioned_ladder_is_one_finding(self, tmp_path):
+        """A matmul reduction loop outside the sanctioned fallback — the
+        staged ladder creeping back into the fused program — is caught."""
+        root = self._real_copy(tmp_path)
+        gcm = root / "tieredstorage_tpu/ops/gcm.py"
+        src = gcm.read_text()
+        anchor = "    t_c = _ghash_grouped(ct_padded, agg_mats, step_mat)\n"
+        assert anchor in src
+        src = src.replace(
+            anchor,
+            anchor
+            + "    for _w in agg_mats[1:]:\n"
+            + "        t_c = jax.lax.dot_general(\n"
+            + "            t_c, _w, (((1,), (0,)), ((), ())))\n",
+        )
+        gcm.write_text(src)
+        report = run(load_project(root))
+        assert details(report) == ["interstage:staged-ladder"]
+        (finding,) = report.findings
+        assert finding.qualname == "_ghash_of_ct"
+
+    def test_static_params_stay_untainted(self, tmp_path):
+        """int() on a static trace parameter (aad_bit_len in
+        _device_len_blocks) is host arithmetic, not a materialization —
+        the real closure relies on this staying clean."""
+        report = run(load_project(REPO_ROOT))
+        assert not any(
+            f.detail.startswith("interstage") for f in report.findings
+        )
+
+
 class TestMaterialization:
     def test_skeleton_is_clean(self, tmp_path):
         assert run(make_project(tmp_path, SKELETON)).findings == []

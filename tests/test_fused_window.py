@@ -307,6 +307,71 @@ class TestOneDispatchPerWindow:
         assert retired.windows == 1 and retired.dispatches == 1
         assert tpu.dispatch_stats.windows == 0
         assert retired.as_dict()["dispatches_per_window"] == 1.0
+        assert "hbm_roundtrips_per_window" in retired.as_dict()
+
+
+# ---------------------------------------------------- HBM round trips (13)
+class TestHbmRoundtripAccounting:
+    """ISSUE 13: `planned_hbm_roundtrips` mirrors the GHASH strategy branch
+    and the backend gates windows on it. Fused tree = exactly 1 (the
+    keystream handoff); XLA ladder = 1 + one per level >= 2 (+1 for the
+    plane path); the counter must separate the paths."""
+
+    def _clear(self):
+        gcm._packed_jit.cache_clear()
+        gcm._gcm_process_batch.clear_cache()
+        gcm._gcm_varlen_batch.clear_cache()
+
+    def test_planned_counts_fixed(self, key_pair, monkeypatch):
+        # 32 KiB chunk: m=2048 -> plan [(128,2048),(16,16)] = two levels.
+        ctx = gcm.make_context(key_pair.data_key, key_pair.aad, 32 << 10)
+        assert len(ctx.agg_mats) == 2
+        monkeypatch.delenv("TIEREDSTORAGE_TPU_PALLAS_GHASH", raising=False)
+        monkeypatch.delenv("TIEREDSTORAGE_TPU_PALLAS_GHASH_TREE", raising=False)
+        # CPU default: XLA plane level 1 + one inter-level trip + handoff.
+        assert gcm.planned_hbm_roundtrips(ctx, 4) == 3
+        # Forced tree: the one keystream handoff only.
+        monkeypatch.setenv("TIEREDSTORAGE_TPU_PALLAS_GHASH_TREE", "1")
+        assert gcm.planned_hbm_roundtrips(ctx, 4) == 1
+
+    def test_planned_counts_single_level(self, key_pair, monkeypatch):
+        # 1024-byte chunk: m=64 -> one grouped level, no ladder trips; the
+        # tree is NOT eligible (nothing to aggregate) and not needed.
+        ctx = gcm.make_context(key_pair.data_key, key_pair.aad, 1024)
+        assert len(ctx.agg_mats) == 1
+        monkeypatch.setenv("TIEREDSTORAGE_TPU_PALLAS_GHASH_TREE", "1")
+        assert gcm.planned_hbm_roundtrips(ctx, 4) == 2  # handoff + planes
+        monkeypatch.setenv("TIEREDSTORAGE_TPU_PALLAS_GHASH", "1")
+        assert gcm.planned_hbm_roundtrips(ctx, 512) == 1  # L1 kernel
+
+    def test_window_accounting_tree_vs_ladder(self, key_pair, monkeypatch):
+        rng = random.Random(31)
+        windows = [
+            [bytes(rng.getrandbits(8) for _ in range(32 << 10)) for _ in range(2)]
+            for _ in range(2)
+        ]
+        flat_ivs = det_ivs(4)
+        opts = TransformOptions(encryption=key_pair, ivs=flat_ivs)
+
+        monkeypatch.delenv("TIEREDSTORAGE_TPU_PALLAS_GHASH_TREE", raising=False)
+        self._clear()
+        ladder = TpuTransformBackend()
+        ladder_out = list(ladder.transform_windows(iter(windows), opts))
+        assert ladder.dispatch_stats.hbm_roundtrips_per_window > 1.0
+
+        monkeypatch.setenv("TIEREDSTORAGE_TPU_PALLAS_GHASH_TREE", "1")
+        self._clear()
+        try:
+            tree = TpuTransformBackend()
+            tree_out = list(tree.transform_windows(iter(windows), opts))
+            stats = tree.dispatch_stats
+            assert stats.hbm_roundtrips_per_window == 1.0
+            assert stats.hbm_roundtrips == stats.windows == 2
+            assert stats.as_dict()["hbm_roundtrips_per_window"] == 1.0
+        finally:
+            self._clear()
+        # Same wire either way: only the reduction strategy moved on-chip.
+        assert tree_out == ladder_out
 
 
 @pytest.mark.skipif(

@@ -101,6 +101,53 @@ class TestShardedProductionWindows:
         with pytest.raises(AuthenticationError, match=r"\[2\]"):
             tpu.detransform(bad, DetransformOptions(encryption=key_pair))
 
+    def test_forced_tree_sharded_composite(self, key_pair, monkeypatch):
+        """ISSUE 13 satellite: the fused GHASH tree kernel under mesh
+        sharding — byte parity with the unsharded ladder, tamper reject,
+        one-roundtrip accounting, and donation steady state all at once
+        (fixed + varlen rows)."""
+        rng = random.Random(9)
+        # 32 KiB chunks: two grouped levels, so the tree genuinely
+        # aggregates; a short tail row exercises the sharded varlen path.
+        sizes = [32 << 10] * 5 + [(32 << 10) - 517]
+        chunks = [bytes(rng.getrandbits(8) for _ in range(s)) for s in sizes]
+        ivs = det_ivs(len(chunks))
+        opts = TransformOptions(encryption=key_pair, ivs=ivs)
+        plain = TpuTransformBackend().transform(chunks, opts)
+
+        monkeypatch.setenv("TIEREDSTORAGE_TPU_PALLAS_GHASH_TREE", "1")
+        gcm._packed_jit.cache_clear()
+        gcm._gcm_varlen_batch.clear_cache()
+        try:
+            tpu = sharded_backend()
+            sharded = tpu.transform(chunks, opts)
+            assert sharded == plain
+            stats = tpu.dispatch_stats
+            assert (stats.windows, stats.dispatches) == (1, 1)
+            assert stats.mesh_size == N_DEVICES
+            assert stats.hbm_roundtrips_per_window == 1.0
+            assert stats.donated_buffers == stats.windows
+
+            tpu.reset_dispatch_stats()
+            back = tpu.detransform(
+                sharded, DetransformOptions(encryption=key_pair)
+            )
+            assert back == chunks
+            dec = tpu.dispatch_stats
+            assert dec.hbm_roundtrips_per_window == 1.0
+            assert dec.donated_buffers == dec.windows
+
+            from tieredstorage_tpu.transform.api import AuthenticationError
+
+            bad = list(sharded)
+            bad[1] = bad[1][:-1] + bytes([bad[1][-1] ^ 1])
+            with pytest.raises(AuthenticationError, match=r"\[1\]"):
+                tpu.detransform(bad, DetransformOptions(encryption=key_pair))
+        finally:
+            monkeypatch.delenv("TIEREDSTORAGE_TPU_PALLAS_GHASH_TREE")
+            gcm._packed_jit.cache_clear()
+            gcm._gcm_varlen_batch.clear_cache()
+
     def test_steady_state_sharded_encrypt_donates_every_window(self, key_pair):
         """The PR-8 donation skip under sharding is gone: input and output
         carry the identical row sharding, so every staged window buffer is

@@ -1,5 +1,8 @@
-"""Pallas GHASH level-1 kernel: bit-exactness against the XLA plane path and
-a numpy mod-2 reference (interpret mode on CPU), plus the platform gate."""
+"""Pallas GHASH kernels: bit-exactness of the level-1 kernel against the
+XLA plane path and a numpy mod-2 reference (interpret mode on CPU), the
+fused TREE kernel (ISSUE 13: all reduction levels in one kernel) against
+numpy, the serial GF(2^128) reference, the XLA ladder, and the host
+`cryptography` oracle — plus the platform gates."""
 
 from __future__ import annotations
 
@@ -9,11 +12,14 @@ import pytest
 jax = pytest.importorskip("jax")
 import jax.numpy as jnp  # noqa: E402
 
-from tieredstorage_tpu.ops import gcm, ghash_pallas  # noqa: E402
+from tieredstorage_tpu.ops import gcm, gf128, ghash_pallas  # noqa: E402
 from tieredstorage_tpu.ops.ghash_pallas import (  # noqa: E402
     ROWS_PER_STEP,
+    TREE_ROWS_PER_STEP,
     ghash_level1_pallas,
+    ghash_tree_pallas,
     use_pallas_ghash,
+    use_pallas_ghash_tree,
 )
 
 
@@ -107,6 +113,236 @@ def test_preflight_failure_degrades_gracefully(monkeypatch):
     )
     assert ghash_pallas._preflight_ok() is False
     assert ghash_pallas._preflight_ok() is False  # memoized, no retry
+
+
+# --------------------------------------------------------- tree kernel (13)
+def _numpy_tree(data: np.ndarray, w1: np.ndarray, step: np.ndarray) -> np.ndarray:
+    """Exact group-sequential fold: T = (T @ M) ^ node_g, all in int64."""
+    k = w1.shape[1]
+    groups = data.shape[1] // k
+    acc = None
+    for g in range(groups):
+        node = _numpy_level1(data[:, g * k : (g + 1) * k], w1).astype(np.int64)
+        if acc is None:
+            acc = node
+        else:
+            acc = ((acc @ step.astype(np.int64)) & 1) ^ node
+    return acc.astype(np.int8)
+
+
+class TestTreeKernel:
+    def test_matches_numpy_fold_multi_group(self):
+        rng = np.random.default_rng(11)
+        k, groups = 256, 5
+        data = rng.integers(
+            0, 256, (TREE_ROWS_PER_STEP, groups * k), dtype=np.uint8
+        )
+        w1 = rng.integers(0, 2, (8, k, 128), dtype=np.int8)
+        step = rng.integers(0, 2, (128, 128), dtype=np.int8)
+        got = np.asarray(ghash_tree_pallas(
+            jnp.asarray(data), jnp.asarray(w1), jnp.asarray(step),
+            interpret=True,
+        ))
+        np.testing.assert_array_equal(got, _numpy_tree(data, w1, step))
+
+    def test_matches_serial_ghash_reference_with_real_operands(self):
+        """End-to-end math check: the REAL per-key operands
+        (ghash_agg_matrices level 1 + ghash_step_matrix) composed by the
+        kernel equal the serial Y_i = (Y_{i-1} ^ X_i) * H reference."""
+        rng = np.random.default_rng(12)
+        h = int(rng.integers(1, 1 << 62)) | 1
+        k_blocks, groups, rows = 16, 4, 6  # non-divisible row count too
+        m = k_blocks * groups
+        w1 = gf128.ghash_agg_matrices(h, m, max_k=k_blocks)[0]
+        step = gf128.ghash_step_matrix(h, k_blocks)
+        data = rng.integers(0, 256, (rows, m * 16), dtype=np.uint8)
+        got = np.asarray(ghash_tree_pallas(
+            jnp.asarray(data), jnp.asarray(w1), jnp.asarray(step),
+            interpret=True,
+        ))
+        for r in range(rows):
+            blocks = [
+                data[r, i * 16 : (i + 1) * 16].tobytes() for i in range(m)
+            ]
+            # ghash_reference folds one extra *H after the last block
+            # (Y_i = (Y_{i-1} ^ X_i) * H = sum X_i H^(m-i)); the grouped
+            # tree computes T(C) = sum C_i H^(m-1-i), so T * H must equal
+            # the serial reference.
+            tree_int = gf128.bitvec_to_int(got[r].astype(np.uint8))
+            assert gf128.gcm_mult(tree_int, h) == gf128.ghash_reference(
+                h, blocks
+            ), f"row {r}"
+
+    def test_pads_partial_row_tiles_internally(self):
+        rng = np.random.default_rng(13)
+        k = 128
+        rows = TREE_ROWS_PER_STEP + 3
+        data = rng.integers(0, 256, (rows, 4 * k), dtype=np.uint8)
+        w1 = rng.integers(0, 2, (8, k, 128), dtype=np.int8)
+        step = rng.integers(0, 2, (128, 128), dtype=np.int8)
+        got = np.asarray(ghash_tree_pallas(
+            jnp.asarray(data), jnp.asarray(w1), jnp.asarray(step),
+            interpret=True,
+        ))
+        assert got.shape == (rows, 128)
+        np.testing.assert_array_equal(got, _numpy_tree(data, w1, step))
+
+    def test_rejects_bad_shapes(self):
+        w1 = jnp.zeros((8, 256, 128), jnp.int8)
+        step = jnp.zeros((128, 128), jnp.int8)
+        with pytest.raises(ValueError, match="tile"):
+            ghash_tree_pallas(
+                jnp.zeros((4, 300), jnp.uint8), w1, step, interpret=True
+            )
+        with pytest.raises(ValueError, match="step"):
+            ghash_tree_pallas(
+                jnp.zeros((4, 512), jnp.uint8), w1,
+                jnp.zeros((128, 64), jnp.int8), interpret=True,
+            )
+
+    def test_tree_eligibility_is_pure_host_logic(self):
+        # Production window shapes: 16 rows, 2048 groups of 2048 bytes.
+        assert use_pallas_ghash_tree(16, 2048, 2048)
+        # The demo's small windows are eligible too (row padding is cheap).
+        assert use_pallas_ghash_tree(4, 16, 2048)
+        # Single-group shapes have nothing to aggregate.
+        assert not use_pallas_ghash_tree(16, 1, 2048)
+        # Un-tiled or over-VMEM group widths never reach the kernel.
+        assert not use_pallas_ghash_tree(16, 8, 2048 + 64)
+        assert not use_pallas_ghash_tree(16, 8, 4096)
+        assert not use_pallas_ghash_tree(0, 8, 2048)
+
+    def test_tree_availability_env_precedence(self, monkeypatch):
+        from tieredstorage_tpu.ops.ghash_pallas import (
+            pallas_ghash_tree_available,
+        )
+
+        monkeypatch.delenv("TIEREDSTORAGE_TPU_PALLAS_GHASH", raising=False)
+        monkeypatch.delenv("TIEREDSTORAGE_TPU_PALLAS_GHASH_TREE", raising=False)
+        assert jax.default_backend() == "cpu"
+        assert not pallas_ghash_tree_available()
+        # The shared GHASH knob arms the tree too...
+        monkeypatch.setenv("TIEREDSTORAGE_TPU_PALLAS_GHASH", "1")
+        assert pallas_ghash_tree_available()
+        # ...but the tree-specific knob wins (on-chip A/B vs the ladder).
+        monkeypatch.setenv("TIEREDSTORAGE_TPU_PALLAS_GHASH_TREE", "0")
+        assert not pallas_ghash_tree_available()
+        monkeypatch.setenv("TIEREDSTORAGE_TPU_PALLAS_GHASH", "0")
+        monkeypatch.setenv("TIEREDSTORAGE_TPU_PALLAS_GHASH_TREE", "1")
+        assert pallas_ghash_tree_available()
+
+    def test_tree_preflight_failure_degrades_gracefully(self, monkeypatch):
+        monkeypatch.setattr(ghash_pallas, "_TREE_PREFLIGHT", [])
+        monkeypatch.setattr(
+            ghash_pallas,
+            "ghash_tree_pallas",
+            lambda *a, **k: (_ for _ in ()).throw(RuntimeError("mosaic failed")),
+        )
+        assert ghash_pallas._tree_preflight_ok() is False
+        assert ghash_pallas._tree_preflight_ok() is False  # memoized
+
+
+class TestTreeComposite:
+    """Level-2+ Pallas parity through the PUBLIC ops: the forced tree
+    kernel vs the XLA grouped-power path vs the host `cryptography`
+    oracle, across tail/varlen/non-divisible shapes, encrypt AND
+    decrypt (ISSUE 13 satellite)."""
+
+    def _force_tree(self, monkeypatch, value: str):
+        monkeypatch.setenv("TIEREDSTORAGE_TPU_PALLAS_GHASH_TREE", value)
+        gcm._packed_jit.cache_clear()
+        gcm._gcm_process_batch.clear_cache()
+        gcm._gcm_varlen_batch.clear_cache()
+
+    @pytest.mark.parametrize(
+        "chunk_bytes,batch",
+        [
+            (8192, 5),       # two grouped levels, odd batch
+            (8192 - 24, 3),  # tail block not 16-aligned (ct padding path)
+            (2048 + 16, 9),  # just past one group: 2 groups at level 1
+        ],
+    )
+    def test_fixed_tree_vs_ladder_vs_oracle(self, chunk_bytes, batch, monkeypatch):
+        import secrets
+
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+        key = secrets.token_bytes(32)
+        aad = secrets.token_bytes(24)
+        ctx = gcm.make_context(key, aad, chunk_bytes)
+        rng = np.random.default_rng(21)
+        data = rng.integers(0, 256, (batch, chunk_bytes), dtype=np.uint8)
+        ivs = rng.integers(0, 256, (batch, 12), dtype=np.uint8)
+        ladder_ct, ladder_tags = (
+            np.asarray(a) for a in gcm.gcm_encrypt_chunks(ctx, ivs, data)
+        )
+        self._force_tree(monkeypatch, "1")
+        try:
+            gcm._gcm_process_batch.clear_cache()
+            tree_ct, tree_tags = (
+                np.asarray(a) for a in gcm.gcm_encrypt_chunks(ctx, ivs, data)
+            )
+            # Decrypt through the tree too: plaintext + expected tags.
+            back, expect_tags = (
+                np.asarray(a)
+                for a in gcm.gcm_decrypt_chunks(ctx, ivs, tree_ct)
+            )
+        finally:
+            self._force_tree(monkeypatch, "0")
+            gcm._gcm_process_batch.clear_cache()
+        np.testing.assert_array_equal(tree_ct, ladder_ct)
+        np.testing.assert_array_equal(tree_tags, ladder_tags)
+        np.testing.assert_array_equal(back, data)
+        np.testing.assert_array_equal(expect_tags, tree_tags)
+        oracle = AESGCM(key)
+        for i in (0, batch - 1):
+            expected = oracle.encrypt(ivs[i].tobytes(), data[i].tobytes(), aad)
+            assert tree_ct[i].tobytes() == expected[:-16]
+            assert tree_tags[i].tobytes() == expected[-16:]
+
+    def test_varlen_tree_vs_ladder_vs_oracle(self, monkeypatch):
+        import secrets
+
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+        key = secrets.token_bytes(32)
+        aad = secrets.token_bytes(16)
+        ctx = gcm.make_varlen_context(key, aad, 6000)
+        sizes = np.asarray([6000, 4097, 16, 1], np.int32)
+        rng = np.random.default_rng(22)
+        data = np.zeros((4, ctx.max_bytes), np.uint8)
+        for i, s in enumerate(sizes):
+            data[i, :s] = rng.integers(0, 256, int(s), dtype=np.uint8)
+        ivs = rng.integers(0, 256, (4, 12), dtype=np.uint8)
+        ladder_ct, ladder_tags = (
+            np.asarray(a)
+            for a in gcm.gcm_encrypt_varlen(ctx, ivs, data, sizes)
+        )
+        self._force_tree(monkeypatch, "1")
+        try:
+            gcm._gcm_varlen_batch.clear_cache()
+            tree_ct, tree_tags = (
+                np.asarray(a)
+                for a in gcm.gcm_encrypt_varlen(ctx, ivs, data, sizes)
+            )
+            back, expect_tags = (
+                np.asarray(a)
+                for a in gcm.gcm_decrypt_varlen(ctx, ivs, tree_ct, sizes)
+            )
+        finally:
+            self._force_tree(monkeypatch, "0")
+            gcm._gcm_varlen_batch.clear_cache()
+        np.testing.assert_array_equal(tree_ct, ladder_ct)
+        np.testing.assert_array_equal(tree_tags, ladder_tags)
+        np.testing.assert_array_equal(back, data)
+        np.testing.assert_array_equal(expect_tags, tree_tags)
+        oracle = AESGCM(key)
+        for i, s in enumerate(sizes):
+            expected = oracle.encrypt(
+                ivs[i].tobytes(), data[i, :s].tobytes(), aad
+            )
+            assert tree_ct[i, :s].tobytes() == expected[:-16]
+            assert tree_tags[i].tobytes() == expected[-16:]
 
 
 def test_forced_integrated_path_matches_xla(monkeypatch):

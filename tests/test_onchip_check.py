@@ -1,0 +1,110 @@
+"""The relay-window runbook's gate logic (tools/onchip_check.py, ISSUE 13
+satellite): `evaluate`/`merge_artifact` are pure functions regression-tested
+on canned bench artifacts, so the one command that has to work during a
+short relay window is exercised by CI without a TPU or a bench run."""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import pathlib
+import sys
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "onchip_check", REPO_ROOT / "tools" / "onchip_check.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("onchip_check", mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+ONCHIP = _load()
+
+GOOD_SINGLE = {
+    "metric": "device_segment_encrypt_throughput_per_chip",
+    "value": 6.2,
+    "unit": "GiB/s",
+    "pallas_aes_platform": True,
+    "pallas_ghash_platform": True,
+    "hbm_roundtrips_per_window": 1.0,
+    "compile_ms": 91000.0,
+}
+GOOD_MULTI = {
+    "mesh_size": 4,
+    "multichip_mesh_size": 4,
+    "multichip_aggregate_gibs": 21.0,
+    "multichip_per_chip_gibs": 5.25,
+    "multichip_parity": True,
+}
+
+
+class TestEvaluate:
+    def test_good_onchip_run_passes(self):
+        verdict = ONCHIP.evaluate(GOOD_SINGLE, GOOD_MULTI)
+        assert verdict["ok"], verdict
+        assert all(verdict["checks"].values())
+
+    def test_cpu_fallback_artifact_fails_platform_gate(self):
+        single = dict(GOOD_SINGLE)
+        single["error"] = "TPU unavailable, measured on cpu: relay down"
+        verdict = ONCHIP.evaluate(single, GOOD_MULTI)
+        assert not verdict["ok"]
+        assert not verdict["checks"]["platform_is_tpu"]
+
+    def test_preflight_degradation_fails_kernel_gates(self):
+        single = dict(GOOD_SINGLE)
+        single["pallas_ghash_platform"] = False
+        verdict = ONCHIP.evaluate(single, GOOD_MULTI)
+        assert not verdict["ok"]
+        assert not verdict["checks"]["pallas_ghash_platform"]
+
+    def test_below_north_star_fails(self):
+        single = dict(GOOD_SINGLE, value=4.9)
+        assert not ONCHIP.evaluate(single, GOOD_MULTI)["ok"]
+        assert ONCHIP.evaluate(single, GOOD_MULTI, min_gibs=4.5)["ok"]
+
+    def test_sharded_parity_failure_fails(self):
+        multi = dict(GOOD_MULTI, multichip_parity=False)
+        verdict = ONCHIP.evaluate(GOOD_SINGLE, multi)
+        assert not verdict["ok"]
+        assert not verdict["checks"]["multichip_parity"]
+
+    def test_skip_multichip_drops_sharded_checks(self):
+        verdict = ONCHIP.evaluate(GOOD_SINGLE, None)
+        assert verdict["ok"]
+        assert "multichip_parity" not in verdict["checks"]
+
+    def test_allow_cpu_is_a_smoke_run_not_a_proof(self):
+        single = dict(GOOD_SINGLE, value=0.01)
+        single["error"] = "TPU unavailable, measured on cpu: forced"
+        single["pallas_aes_platform"] = False
+        verdict = ONCHIP.evaluate(single, None, allow_cpu=True)
+        assert verdict["ok"]  # the flow runs; the gates are waived...
+        strict = ONCHIP.evaluate(single, None)
+        assert not strict["ok"]  # ...and a strict re-read still fails
+
+
+class TestMergeArtifact:
+    def test_merged_artifact_is_trajectory_shaped(self):
+        verdict = ONCHIP.evaluate(GOOD_SINGLE, GOOD_MULTI)
+        merged = ONCHIP.merge_artifact(GOOD_SINGLE, GOOD_MULTI, verdict)
+        # The driver's trajectory keys survive at the top level...
+        assert merged["metric"] == GOOD_SINGLE["metric"]
+        assert merged["value"] == 6.2
+        # ...the sharded keys are folded in...
+        assert merged["multichip_aggregate_gibs"] == 21.0
+        assert merged["multichip_parity"] is True
+        # ...and the runbook verdict rides along, JSON-serializable.
+        assert merged["onchip_check"]["ok"] is True
+        json.dumps(merged)
+
+    def test_merge_without_multichip(self):
+        verdict = ONCHIP.evaluate(GOOD_SINGLE, None)
+        merged = ONCHIP.merge_artifact(GOOD_SINGLE, None, verdict)
+        assert "multichip_aggregate_gibs" not in merged
+        assert merged["onchip_check"]["ok"] is True

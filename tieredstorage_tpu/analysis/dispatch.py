@@ -43,6 +43,19 @@ that gap at the AST level:
    same function is a finding — ``.is_deleted()`` excepted (it is the
    donation *probe*).
 
+5. **Inter-stage materialization inside the fused closure** (ISSUE 13).
+   A second closure is built from the TRACE-scope roots — the packed
+   window impls that run under ``_packed_jit`` — where every non-static
+   parameter is a tracer by construction. Inside it, any host
+   materializer or sync on a traced value (``interstage:...`` findings)
+   splits the one-program window into multiple programs, and any
+   staged matmul reduction loop outside the sanctioned ladder fallback
+   (``interstage:staged-ladder``) reintroduces the per-level HBM round
+   trips the fused GHASH tree kernel exists to remove. The runtime
+   counterpart is ``ops.gcm.planned_hbm_roundtrips`` /
+   ``DispatchStats.hbm_roundtrips_per_window``, CI-gated <= 1 by
+   ``make transform-demo``.
+
 Like the other whole-project checkers this is an over-approximation with
 explicit limits: taint does not flow through containers or across calls,
 and lexical line order stands in for execution order. The runtime
@@ -111,6 +124,34 @@ SANCTIONED_MATERIALIZERS = {
 #: bucketed contexts).
 SANCTIONED_JIT_WRAPPERS = {
     "tieredstorage_tpu/ops/gcm.py:_packed_jit",
+}
+
+#: Roots of the TRACE-scope closure (ISSUE 13): the packed window impls
+#: that run under `_packed_jit`. Everything they reach executes inside ONE
+#: traced program — the fused-window closure the tree kernel keeps to a
+#: single stage.
+TRACE_CLOSURE_ROOTS = (
+    "tieredstorage_tpu/ops/gcm.py:_packed_fixed_impl",
+    "tieredstorage_tpu/ops/gcm.py:_packed_varlen_impl",
+)
+
+#: Trace-scope parameters that carry static Python values (jit
+#: static_argnames and host ints threaded through) — every OTHER parameter
+#: of a trace-scope function is a tracer by construction.
+TRACE_STATIC_PARAMS = {
+    "self", "chunk_bytes", "n_blocks", "decrypt", "max_bytes", "m_max",
+    "m_a", "m_cap", "aad_bit_len", "first_counter", "interpret",
+}
+
+#: Trace-scope functions allowed to contain a staged matmul-reduction loop,
+#: with the reason. Burn down, never add without a sentence.
+SANCTIONED_STAGED_REDUCERS = {
+    "tieredstorage_tpu/ops/gcm.py:_ghash_grouped":
+        "the XLA grouped-power ladder is the TESTED FALLBACK when the "
+        "fused GHASH tree kernel cannot engage (no Mosaic on this "
+        "platform, single-level shapes); its per-level HBM round trips "
+        "are counted honestly by planned_hbm_roundtrips and gated by "
+        "make transform-demo",
 }
 
 #: Calls that produce (or carry) device values: assignment from one taints
@@ -202,9 +243,13 @@ def _resolve_call(func: ast.AST, fn: _Fn, modules: dict[str, str]) -> Optional[s
     return None
 
 
-def build_closure(project: Project):
+def build_closure(project: Project, roots=HOT_PATH_ROOTS, stop_at=()):
     """(closure functions by key, file models, module index) — exposed for
-    tests and the docs."""
+    tests and the docs. `roots` selects the entry set: the hot window path
+    (default) or TRACE_CLOSURE_ROOTS for the fused trace scope. Functions
+    in `stop_at` are kept in the closure but their callees are not
+    traversed (the sanctioned host-gate subtrees of the trace scope run
+    eagerly at trace time, not inside the program)."""
     file_models = {
         pf.rel_path: lockorder._build_file_model(pf)
         for pf in project.files
@@ -227,7 +272,7 @@ def build_closure(project: Project):
                 )
 
     closure: dict[str, _Fn] = {}
-    stack = [k for k in HOT_PATH_ROOTS if k in fns]
+    stack = [k for k in roots if k in fns]
     while stack:
         key = stack.pop()
         if key in closure:
@@ -236,6 +281,8 @@ def build_closure(project: Project):
         if fn is None:
             continue
         closure[key] = fn
+        if key in stop_at:
+            continue
         for node in ast.walk(fn.node):
             if isinstance(node, ast.Call):
                 callee = _resolve_call(node.func, fn, modules)
@@ -253,36 +300,12 @@ def _tainted_names(fn: _Fn) -> set[str]:
     """Names bound (directly or via tuple unpack) from device producers,
     plus conventionally named device parameters. Two passes so a name
     assigned from another tainted name late in the function still taints
-    earlier reported uses conservatively."""
+    earlier reported uses conservatively (propagation shared with the
+    trace-scope scan, `_propagate_taint`)."""
     tainted: set[str] = {
         a.arg for a in fn.node.args.args if a.arg in DEVICE_PARAM_NAMES
     }
-
-    def is_producer(call: ast.Call) -> bool:
-        name = _call_name(call.func)
-        if name is None:
-            return False
-        if name.split(".")[-1] in DEVICE_PRODUCER_NAMES:
-            return True
-        return name.startswith(DEVICE_PRODUCER_PREFIXES)
-
-    def expr_tainted(expr: ast.AST) -> bool:
-        for node in ast.walk(expr):
-            if isinstance(node, ast.Name) and node.id in tainted:
-                return True
-            if isinstance(node, ast.Call) and is_producer(node):
-                return True
-        return False
-
-    for _ in range(2):
-        for node in ast.walk(fn.node):
-            if isinstance(node, ast.Assign) and expr_tainted(node.value):
-                for target in node.targets:
-                    elts = target.elts if isinstance(target, ast.Tuple) else [target]
-                    for t in elts:
-                        if isinstance(t, ast.Name):
-                            tainted.add(t.id)
-    return tainted
+    return _propagate_taint(fn, tainted)
 
 
 def _scan_materialization(fn: _Fn, findings: list[Finding]) -> None:
@@ -460,6 +483,151 @@ def _scan_donation(fn: _Fn, findings: list[Finding]) -> None:
                     findings.append(f)
 
 
+# ----------------------------------------------- fused trace scope (rule 5)
+def _trace_tainted_names(fn: _Fn) -> set[str]:
+    """Traced-value names inside a trace-scope function: every parameter
+    that is not a known static is a tracer by construction (the function
+    runs under `_packed_jit`), then the same producer/assignment
+    propagation as `_tainted_names`."""
+    args = fn.node.args
+    params = list(getattr(args, "posonlyargs", [])) + list(args.args) + list(
+        args.kwonlyargs
+    )
+    tainted = {a.arg for a in params if a.arg not in TRACE_STATIC_PARAMS}
+    return _propagate_taint(fn, tainted)
+
+
+def _propagate_taint(fn: _Fn, tainted: set[str]) -> set[str]:
+    """Two-pass producer/assignment taint propagation shared by the hot
+    and trace closures (extracted from `_tainted_names`)."""
+
+    def is_producer(call: ast.Call) -> bool:
+        name = _call_name(call.func)
+        if name is None:
+            return False
+        if name.split(".")[-1] in DEVICE_PRODUCER_NAMES:
+            return True
+        return name.startswith(DEVICE_PRODUCER_PREFIXES)
+
+    def expr_tainted(expr: ast.AST) -> bool:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Name) and node.id in tainted:
+                return True
+            if isinstance(node, ast.Call) and is_producer(node):
+                return True
+        return False
+
+    for _ in range(2):
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Assign) and expr_tainted(node.value):
+                for target in node.targets:
+                    elts = target.elts if isinstance(target, ast.Tuple) else [target]
+                    for t in elts:
+                        if isinstance(t, ast.Name):
+                            tainted.add(t.id)
+    return tainted
+
+
+def _scan_interstage(fn: _Fn, findings: list[Finding]) -> None:
+    """Host materializers/syncs inside the TRACED fused closure. Every
+    value here is a tracer, so a materialization cannot be a cheap host
+    peek: it cuts the one-program window into multiple programs with an
+    HBM round trip (and a relay sync) at the cut. The sanctioned set is
+    the trace-time host gates (memoized preflight cross-checks under
+    ensure_compile_time_eval)."""
+    if fn.key in SANCTIONED_MATERIALIZERS:
+        return
+    tainted = _trace_tainted_names(fn)
+    for node in ast.walk(fn.node):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = _call_name(func)
+        is_sync = (
+            isinstance(func, ast.Attribute) and func.attr in SYNC_ATTRS
+        ) or name in SYNC_CALL_NAMES
+        if is_sync:
+            findings.append(Finding(
+                checker="device-dispatch",
+                path=fn.rel_path, line=node.lineno, qualname=fn.qualname,
+                detail=f"interstage:sync:{(name or func.attr).split('.')[-1]}",
+                message=(
+                    "device sync inside the TRACED fused-window closure: "
+                    "the window must stay one device program "
+                    "(hbm_roundtrips_per_window <= 1); move host work "
+                    "outside the packed impls"
+                ),
+            ))
+            continue
+        is_materializer = name in MATERIALIZE_CALL_NAMES or (
+            isinstance(func, ast.Attribute) and func.attr in MATERIALIZE_ATTRS
+        )
+        if not is_materializer:
+            continue
+        receiver_tainted = (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id in tainted
+        )
+        operand_tainted = any(
+            isinstance(sub, ast.Name) and sub.id in tainted
+            for a in list(node.args) + [kw.value for kw in node.keywords]
+            for sub in ast.walk(a)
+        )
+        if receiver_tainted or operand_tainted:
+            label = (name or func.attr).split(".")[-1]
+            findings.append(Finding(
+                checker="device-dispatch",
+                path=fn.rel_path, line=node.lineno, qualname=fn.qualname,
+                detail=f"interstage:materialize:{label}",
+                message=(
+                    f"{label}() materializes a traced value inside the "
+                    "fused-window closure: XLA must cut the one-program "
+                    "window here and round-trip the intermediate through "
+                    "HBM — exactly the inter-stage materialization the "
+                    "fused GHASH tree kernel removes (ISSUE 13)"
+                ),
+            ))
+
+
+#: Calls that stage a matmul reduction level (HBM materialization of the
+#: per-level node tensor between them when looped).
+_MATMUL_NAMES = {"dot_general", "dot", "matmul", "einsum", "tensordot"}
+
+
+def _scan_staged_reduction(fn: _Fn, findings: list[Finding]) -> None:
+    """A matmul inside a loop in trace scope is a STAGED reduction: each
+    iteration materializes its node tensor in HBM before the next
+    contracts it — the grouped-power ladder shape. Only the sanctioned
+    fallback (`_ghash_grouped`, counted by planned_hbm_roundtrips) may
+    carry one; anywhere else it silently reintroduces the per-level round
+    trips."""
+    if fn.key in SANCTIONED_STAGED_REDUCERS:
+        return
+    for loop in ast.walk(fn.node):
+        if not isinstance(loop, (ast.For, ast.While)):
+            continue
+        for sub in ast.walk(loop):
+            if not isinstance(sub, ast.Call):
+                continue
+            name = _call_name(sub.func) or ""
+            if name.split(".")[-1] in _MATMUL_NAMES:
+                findings.append(Finding(
+                    checker="device-dispatch",
+                    path=fn.rel_path, line=sub.lineno, qualname=fn.qualname,
+                    detail="interstage:staged-ladder",
+                    message=(
+                        "matmul-in-a-loop inside the traced fused closure "
+                        "is a staged reduction (one HBM round trip per "
+                        "level); the ladder lives only in the sanctioned "
+                        "fallback — route the reduction through the fused "
+                        "GHASH tree kernel instead"
+                    ),
+                ))
+                break  # one finding per loop
+    return
+
+
 def check_device_dispatch(project: Project) -> list[Finding]:
     closure, _file_models, _modules = build_closure(project)
     findings: list[Finding] = []
@@ -468,4 +636,12 @@ def check_device_dispatch(project: Project) -> list[Finding]:
         _scan_materialization(fn, findings)
         _scan_retrace(fn, findings)
         _scan_donation(fn, findings)
+    trace_closure, _tfm, _tmod = build_closure(
+        project, TRACE_CLOSURE_ROOTS,
+        stop_at=frozenset(SANCTIONED_MATERIALIZERS),
+    )
+    for key in sorted(trace_closure):
+        fn = trace_closure[key]
+        _scan_interstage(fn, findings)
+        _scan_staged_reduction(fn, findings)
     return findings

@@ -231,10 +231,20 @@ class HotWindow:
         return chunk_id in self._row
 
     def chunk(self, chunk_id: int) -> bytes:
-        """Ranged slice of the pinned host mirror — the hot serve."""
+        """Copying ranged slice of the pinned host mirror (tests and
+        callers that need owned bytes)."""
+        return self.chunk_view(chunk_id).tobytes()
+
+    def chunk_view(self, chunk_id: int) -> memoryview:
+        """ZERO-COPY ranged slice of the pinned host mirror — the hot
+        serve (ISSUE 13 satellite, ROADMAP item 3 remainder). The gateway
+        streams the view straight to the socket; no per-chunk ``tobytes``
+        copy. The view holds the mirror's buffer alive (numpy refcount),
+        so an eviction racing a serve can never tear the bytes — at the
+        cost of the mirror lingering while any served view is retained."""
         i = self._row[chunk_id]
         off = self.offsets[i]
-        return self.mirror[off : off + self.lens[i]].tobytes()
+        return memoryview(self.mirror)[off : off + self.lens[i]]
 
 
 def _file_of(objects_key) -> str:
@@ -282,6 +292,9 @@ class DeviceHotCache(ChunkManager):
         self.hits = 0
         self.misses = 0
         self.chunks_served = 0
+        #: Chunks served as zero-copy memoryview slices of a pinned mirror
+        #: (every hot hit; the `make hot-demo` zero-copy gate).
+        self.zero_copy_serves = 0
         self.admissions = 0
         self.rejections = 0
         self.evictions = 0
@@ -364,11 +377,12 @@ class DeviceHotCache(ChunkManager):
         self._maybe_admit(file, tuple(chunk_ids), chunks, captured)
         return chunks
 
-    def _serve_hot(self, file: str, chunk_ids: Sequence[int]) -> Optional[list[bytes]]:
-        """Serve the window from resident covers, or None on any gap. Window
-        objects are collected under the lock and sliced outside it — an
-        eviction racing the serve cannot tear bytes (the reference keeps the
-        buffers alive)."""
+    def _serve_hot(self, file: str, chunk_ids: Sequence[int]) -> Optional[list]:
+        """Serve the window from resident covers as ZERO-COPY memoryview
+        slices of the pinned mirrors, or None on any gap. Window objects
+        are collected under the lock and sliced outside it — an eviction
+        racing the serve cannot tear bytes (each view keeps its mirror's
+        buffer alive)."""
         covers: list[HotWindow] = []
         with self._lock:
             for cid in chunk_ids:
@@ -382,8 +396,9 @@ class DeviceHotCache(ChunkManager):
                 self._windows.move_to_end(wkey)
             self.hits += 1
             self.chunks_served += len(chunk_ids)
+            self.zero_copy_serves += len(chunk_ids)
             note_mutation("device_hot.DeviceHotCache.hits")
-        return [w.chunk(cid) for w, cid in zip(covers, chunk_ids)]
+        return [w.chunk_view(cid) for w, cid in zip(covers, chunk_ids)]
 
     def device_rows(self, objects_key, chunk_ids: Sequence[int]):
         """Device-side ranged slicing: the retained rows for `chunk_ids` as

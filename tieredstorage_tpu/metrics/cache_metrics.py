@@ -76,6 +76,9 @@ def register_hot_cache_metrics(registry: MetricsRegistry, hot_cache) -> None:
           "Window reads with at least one non-resident chunk (delegated)")
     gauge("hot-cache-hit-rate", lambda: float(hot_cache.hit_rate),
           "hits / (hits + misses) since start")
+    gauge("hot-cache-zero-copy-serves-total",
+          lambda: float(hot_cache.zero_copy_serves),
+          "Chunks served as zero-copy memoryview slices of a pinned mirror")
     gauge("hot-cache-chunks-served-total", lambda: float(hot_cache.chunks_served),
           "Chunks sliced out of resident windows")
     gauge("hot-cache-admissions-total", lambda: float(hot_cache.admissions),

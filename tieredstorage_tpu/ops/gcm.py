@@ -51,6 +51,9 @@ class GcmContext:
     const_bits: np.ndarray       # uint8[128] = bits(T(A)*H^(mC+2) ^ L*H)
     chunk_bytes: int
     n_blocks: int                # ceil(chunk_bytes/16)
+    #: int8[128,128] transposed mult-by-H^k1 between-group fold matrix of
+    #: the fused GHASH tree kernel (gf128.ghash_step_matrix).
+    step_mat: np.ndarray = None
 
 
 @functools.lru_cache(maxsize=16)
@@ -94,6 +97,7 @@ def _context_cached(key: bytes, aad: bytes, chunk_bytes: int) -> GcmContext:
         const_bits=gf128.int_to_bitvec(const),
         chunk_bytes=chunk_bytes,
         n_blocks=m_c,
+        step_mat=gf128.ghash_step_matrix(h, agg_mats[0].shape[1] // 16),
     )
 
 
@@ -118,17 +122,32 @@ def _bits_to_bytes(bits: jnp.ndarray) -> jnp.ndarray:
     return (b * weights).sum(axis=-1, dtype=jnp.uint32).astype(jnp.uint8)
 
 
-def _ghash_grouped(data_flat: jnp.ndarray, agg_mats: tuple) -> jnp.ndarray:
+def _ghash_grouped(
+    data_flat: jnp.ndarray, agg_mats: tuple, step_mat=None
+) -> jnp.ndarray:
     """data_flat uint8[B, m*16] -> T(C) = sum_i C_i H^(m-1-i), uint8[B, 128].
 
-    Level 1 contracts the 8 byte-bit planes of the raw bytes (minor dim stays
-    the full byte length — no tile-padded [.., 16, 8] bit tensor in HBM)
-    against the int8[8, k*16, 128] operand; levels >= 2 contract k 128-bit
-    node vectors at a time via [B*G, k*128] x [k*128, 128]. Each level
-    left-pads to a multiple of its group width (leading zero blocks are the
-    polynomial's identity). Same function the former pairwise tree computed,
-    in log128(m) MXU matmuls instead of log2(m) sequential levels
-    (gf128.ghash_agg_matrices)."""
+    Three strategies, best-available first:
+
+    - **Fused tree kernel** (`ghash_pallas.ghash_tree_pallas`, ISSUE 13):
+      with `step_mat` and more than one aggregation level, the WHOLE
+      reduction runs as one Pallas kernel — in-kernel plane extraction,
+      level-1 matmuls, and the level-2+ aggregation as a sequential
+      per-group fold of a VMEM accumulator (``T = (T @ M_{H^k1}) ^
+      node_g``). Zero inter-stage HBM materialization: payload in, [B,128]
+      node bits out.
+    - **Level-1 kernel + XLA ladder**: level 1 contracts the 8 byte-bit
+      planes in-kernel (bytes cross HBM once); levels >= 2 contract k
+      128-bit node vectors at a time via [B*G, k*128] x [k*128, 128] XLA
+      matmuls, one [B, G, 128] HBM round trip per level.
+    - **Pure XLA**: the plane stack materializes in HBM (8 B/B) before the
+      same ladder.
+
+    Each ladder level left-pads to a multiple of its group width (leading
+    zero blocks are the polynomial's identity). All three compute the same
+    function the former pairwise tree did (gf128.ghash_agg_matrices);
+    `planned_hbm_roundtrips` mirrors this branch for the per-window
+    accounting, so keep them in sync."""
     batch = data_flat.shape[0]
     w1 = agg_mats[0]
     k1 = w1.shape[1] // 16
@@ -141,6 +160,24 @@ def _ghash_grouped(data_flat: jnp.ndarray, agg_mats: tuple) -> jnp.ndarray:
         )
     from tieredstorage_tpu.ops import ghash_pallas
 
+    if (
+        step_mat is not None
+        and len(agg_mats) > 1
+        and ghash_pallas.use_pallas_ghash_tree(batch, g, k1 * 16)
+        and ghash_pallas.pallas_ghash_tree_available()
+    ):
+        import logging
+
+        from tieredstorage_tpu.ops._preflight import interpret_off_device
+
+        return ghash_pallas.ghash_tree_pallas(
+            data_flat,
+            w1,
+            step_mat,
+            interpret=interpret_off_device(
+                logging.getLogger(__name__), "Pallas GHASH tree"
+            ),
+        ).astype(jnp.uint8)
     if ghash_pallas.use_pallas_ghash(
         batch * g, k1 * 16
     ) and ghash_pallas.pallas_ghash_available():
@@ -196,9 +233,10 @@ def _ghash_grouped(data_flat: jnp.ndarray, agg_mats: tuple) -> jnp.ndarray:
 def _ghash_of_ct(
     ct_padded: jnp.ndarray,
     agg_mats: tuple, final_mat: jnp.ndarray, const_bits: jnp.ndarray,
+    step_mat=None,
 ) -> jnp.ndarray:
     """ct_padded uint8[B, m*16] (tail already zeroed) -> GHASH bits [B,128]."""
-    t_c = _ghash_grouped(ct_padded, agg_mats)
+    t_c = _ghash_grouped(ct_padded, agg_mats, step_mat)
     ghash = (
         jax.lax.dot_general(
             t_c.astype(jnp.int8), final_mat, (((1,), (0,)), ((), ())),
@@ -219,6 +257,7 @@ def _gcm_process_batch(
     agg_mats: tuple,
     final_mat: jnp.ndarray,
     const_bits: jnp.ndarray,
+    step_mat=None,
     *,
     chunk_bytes: int,
     n_blocks: int,
@@ -244,7 +283,7 @@ def _gcm_process_batch(
         ct_padded = jnp.zeros((batch, padded_len), jnp.uint8).at[:, :chunk_bytes].set(ct)
     else:
         ct_padded = ct
-    ghash = _ghash_of_ct(ct_padded, agg_mats, final_mat, const_bits)
+    ghash = _ghash_of_ct(ct_padded, agg_mats, final_mat, const_bits, step_mat)
     tags = _bits_to_bytes(ghash) ^ tag_mask
     return output, tags
 
@@ -280,6 +319,80 @@ def _count_dispatch() -> None:
     _DISPATCH_TLS.count = getattr(_DISPATCH_TLS, "count", 0) + 1
 
 
+#: Payload-scale HBM round trips between the stages of the GCM window
+#: program (ISSUE 13). Same process-wide + thread-local accounting shape as
+#: the launch counter above; the transform backend reads per-thread deltas
+#: around each window so `make transform-demo` can gate
+#: hbm_roundtrips_per_window <= 1 without a TPU. The count is STATIC (host
+#: logic mirroring the branch _ghash_grouped traces) — the runtime ground
+#: truth remains the measured GiB/s at relay windows.
+_ROUNDTRIPS = [0]
+_ROUNDTRIP_TLS = threading.local()
+
+
+def device_hbm_roundtrips() -> int:
+    """Total inter-stage HBM round trips dispatched so far in this process."""
+    return _ROUNDTRIPS[0]
+
+
+def thread_hbm_roundtrips() -> int:
+    """Inter-stage HBM round trips dispatched by the CALLING thread."""
+    return getattr(_ROUNDTRIP_TLS, "count", 0)
+
+
+def _count_roundtrips(n: int) -> None:
+    with _DISPATCH_MU:
+        _ROUNDTRIPS[0] += n
+    _ROUNDTRIP_TLS.count = getattr(_ROUNDTRIP_TLS, "count", 0) + n
+
+
+def planned_hbm_roundtrips(ctx, rows: int) -> int:
+    """Stage boundaries of the GCM program that materialize a payload-scale
+    intermediate in HBM, for a window of `rows` rows (PER-SHARD rows under
+    a mesh — each shard traces the same program). Mirrors the strategy
+    branch in `_ghash_grouped` — keep the two in sync (the fused-closure
+    checker in analysis/dispatch.py pins the trace side).
+
+    Counted:
+
+    - 1 always — the keystream handoff: the AES kernel (or XLA circuit)
+      writes its bit-plane output to HBM once; the unpack + XOR fuse into
+      its consumer. This is the ONE round trip the two-kernel pipeline is
+      allowed (the window's own input staging and output fetch are
+      transfers, counted separately as h2d/d2h).
+    - +1 per XLA grouped-power ladder level >= 2 — each level materializes
+      its [B, G, 128] node tensor between matmuls.
+    - +1 when GHASH level 1 runs as the XLA plane path — the 8-plane int8
+      expansion (8 B of HBM traffic per payload byte).
+    - +0 when the fused tree kernel engages: level 1 and every aggregation
+      level run inside one kernel, nodes never leave VMEM.
+
+    The varlen sequence assembly (mask, length-block scatter, rotation) is
+    elementwise/gather work XLA fuses into the level-1 operand read, not a
+    stage boundary."""
+    from tieredstorage_tpu.ops import ghash_pallas
+
+    agg_mats = ctx.agg_mats
+    m = ctx.n_blocks if isinstance(ctx, GcmContext) else ctx.m_cap
+    k1 = agg_mats[0].shape[1] // 16
+    g = _ceil_div(m, k1)
+    count = 1  # keystream planes: AES kernel -> unpack/XOR fusion
+    tree = (
+        getattr(ctx, "step_mat", None) is not None
+        and len(agg_mats) > 1
+        and ghash_pallas.use_pallas_ghash_tree(rows, g, k1 * 16)
+        and ghash_pallas.pallas_ghash_tree_available()
+    )
+    if not tree:
+        count += len(agg_mats) - 1
+        if not (
+            ghash_pallas.use_pallas_ghash(rows * g, k1 * 16)
+            and ghash_pallas.pallas_ghash_available()
+        ):
+            count += 1
+    return count
+
+
 # Device-resident copies of each context's constant arrays, uploaded once
 # per context instead of once per window call (the round keys, GHASH level
 # matrices, and folded constants are identical for every window of a
@@ -310,11 +423,30 @@ def _device_consts(ctx) -> tuple:
     return consts
 
 
+# Device-resident fold matrices of the tree kernel, cached separately so
+# `_device_consts`'s tuple arity (unpacked by the profiling tools) stays
+# stable. Same weak keying as above.
+_DEVICE_STEP_MATS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _device_step_mat(ctx):
+    """Device copy of the context's tree fold matrix (None when absent)."""
+    if getattr(ctx, "step_mat", None) is None:
+        return None
+    try:
+        return _DEVICE_STEP_MATS[ctx]
+    except KeyError:
+        mat = jnp.asarray(ctx.step_mat)
+        _DEVICE_STEP_MATS[ctx] = mat
+        return mat
+
+
 def gcm_encrypt_chunks(ctx: GcmContext, ivs: np.ndarray, plaintext: np.ndarray):
     """plaintext uint8[B, ctx.chunk_bytes], ivs uint8[B,12] ->
     (ciphertext uint8[B, chunk_bytes], tags uint8[B,16])."""
     round_keys, agg_mats, final_mat, const_bits = _device_consts(ctx)
     _count_dispatch()
+    _count_roundtrips(planned_hbm_roundtrips(ctx, len(plaintext)))
     ct, tags = _gcm_process_batch(
         round_keys,
         jnp.asarray(ivs, dtype=jnp.uint8),
@@ -322,6 +454,7 @@ def gcm_encrypt_chunks(ctx: GcmContext, ivs: np.ndarray, plaintext: np.ndarray):
         agg_mats,
         final_mat,
         const_bits,
+        _device_step_mat(ctx),
         chunk_bytes=ctx.chunk_bytes,
         n_blocks=ctx.n_blocks,
         decrypt=False,
@@ -349,6 +482,9 @@ class GcmVarlenContext:
     max_bytes: int
     m_max: int               # max data blocks
     m_cap: int               # sequence slots (AAD + data + length block)
+    #: int8[128,128] transposed mult-by-H^k1 between-group fold matrix of
+    #: the fused GHASH tree kernel (gf128.ghash_step_matrix).
+    step_mat: np.ndarray = None
 
 
 @functools.lru_cache(maxsize=64)
@@ -360,15 +496,17 @@ def _varlen_context_cached(key: bytes, aad: bytes, max_bytes: int) -> GcmVarlenC
     aad_padded = np.frombuffer(
         aad + b"\x00" * (m_a * 16 - len(aad)), dtype=np.uint8
     ).reshape(m_a, 16) if m_a else np.zeros((0, 16), np.uint8)
+    agg_mats = gf128.ghash_agg_matrices(h, seq_len)
     return GcmVarlenContext(
         round_keys=round_keys,
         aad_blocks=aad_padded,
-        agg_mats=gf128.ghash_agg_matrices(h, seq_len),
+        agg_mats=agg_mats,
         h_mat=np.ascontiguousarray(gf128.mult_matrix(h).T.astype(np.int8)),
         aad_bit_len=len(aad) * 8,
         max_bytes=max_bytes,
         m_max=m_max,
         m_cap=seq_len,
+        step_mat=gf128.ghash_step_matrix(h, agg_mats[0].shape[1] // 16),
     )
 
 
@@ -399,6 +537,7 @@ def make_varlen_context(key: bytes, aad: bytes, max_bytes: int) -> GcmVarlenCont
 )
 def _gcm_varlen_batch(
     round_keys, ivs, data, lengths, len_blocks, aad_blocks, agg_mats, h_mat,
+    step_mat=None,
     *, max_bytes: int, m_max: int, m_a: int, m_cap: int, decrypt: bool,
 ):
     """data uint8[B, max_bytes] left-aligned (zero tail), lengths int32[B],
@@ -438,7 +577,7 @@ def _gcm_varlen_batch(
     idx = (jnp.arange(m_cap, dtype=jnp.int32)[None, :] - shift[:, None]) % m_cap
     seq = jnp.take_along_axis(seq, idx[:, :, None], axis=1)
 
-    t = _ghash_grouped(seq.reshape(batch, -1), agg_mats)
+    t = _ghash_grouped(seq.reshape(batch, -1), agg_mats, step_mat)
     ghash = (
         jax.lax.dot_general(
             t.astype(jnp.int8), h_mat, (((1,), (0,)), ((), ())),
@@ -468,6 +607,7 @@ def _run_varlen(ctx: GcmVarlenContext, ivs, data, lengths, decrypt: bool):
     lengths = np.asarray(lengths, dtype=np.int32)
     round_keys, aad_blocks, agg_mats, h_mat = _device_consts(ctx)
     _count_dispatch()
+    _count_roundtrips(planned_hbm_roundtrips(ctx, len(lengths)))
     return _gcm_varlen_batch(
         round_keys,
         jnp.asarray(ivs, dtype=jnp.uint8),
@@ -477,6 +617,7 @@ def _run_varlen(ctx: GcmVarlenContext, ivs, data, lengths, decrypt: bool):
         aad_blocks,
         agg_mats,
         h_mat,
+        _device_step_mat(ctx),
         max_bytes=ctx.max_bytes,
         m_max=ctx.m_max,
         m_a=ctx.aad_blocks.shape[0],
@@ -503,6 +644,7 @@ def gcm_decrypt_chunks(ctx: GcmContext, ivs: np.ndarray, ciphertext: np.ndarray)
     mandatory — the TPU transform backend raises on mismatch)."""
     round_keys, agg_mats, final_mat, const_bits = _device_consts(ctx)
     _count_dispatch()
+    _count_roundtrips(planned_hbm_roundtrips(ctx, len(ciphertext)))
     return _gcm_process_batch(
         round_keys,
         jnp.asarray(ivs, dtype=jnp.uint8),
@@ -510,6 +652,7 @@ def gcm_decrypt_chunks(ctx: GcmContext, ivs: np.ndarray, ciphertext: np.ndarray)
         agg_mats,
         final_mat,
         const_bits,
+        _device_step_mat(ctx),
         chunk_bytes=ctx.chunk_bytes,
         n_blocks=ctx.n_blocks,
         decrypt=True,
@@ -539,13 +682,15 @@ def gcm_decrypt_chunks(ctx: GcmContext, ivs: np.ndarray, ciphertext: np.ndarray)
 
 def _packed_fixed_impl(
     round_keys, ivs, data_packed, agg_mats, final_mat, const_bits,
+    step_mat=None,
     *, chunk_bytes: int, n_blocks: int, decrypt: bool,
 ):
     if ivs is None:  # trace-time branch: IVs ride the packed tail
         ivs = data_packed[:, chunk_bytes : chunk_bytes + 12]
     out, tags = _gcm_process_batch(
         round_keys, ivs, data_packed[:, :chunk_bytes], agg_mats, final_mat,
-        const_bits, chunk_bytes=chunk_bytes, n_blocks=n_blocks, decrypt=decrypt,
+        const_bits, step_mat,
+        chunk_bytes=chunk_bytes, n_blocks=n_blocks, decrypt=decrypt,
     )
     return jnp.concatenate([out, tags], axis=1)
 
@@ -578,7 +723,8 @@ def _device_len_blocks(lengths: jnp.ndarray, aad_bit_len: int) -> jnp.ndarray:
 
 def _packed_varlen_impl(
     round_keys, ivs, data_packed, lengths, len_blocks, aad_blocks, agg_mats,
-    h_mat, *, aad_bit_len: int, max_bytes: int, m_max: int, m_a: int,
+    h_mat, step_mat=None,
+    *, aad_bit_len: int, max_bytes: int, m_max: int, m_a: int,
     m_cap: int, decrypt: bool,
 ):
     if ivs is None:
@@ -590,7 +736,8 @@ def _packed_varlen_impl(
         len_blocks = _device_len_blocks(lengths, aad_bit_len)
     out, tags = _gcm_varlen_batch(
         round_keys, ivs, data_packed[:, :max_bytes], lengths, len_blocks,
-        aad_blocks, agg_mats, h_mat, max_bytes=max_bytes, m_max=m_max,
+        aad_blocks, agg_mats, h_mat, step_mat,
+        max_bytes=max_bytes, m_max=m_max,
         m_a=m_a, m_cap=m_cap, decrypt=decrypt,
     )
     return jnp.concatenate([out, tags], axis=1)
@@ -618,20 +765,21 @@ def _packed_fixed_sharded(mesh):
 
     def run(
         round_keys, ivs, data_packed, agg_mats, final_mat, const_bits,
+        step_mat=None,
         *, chunk_bytes: int, n_blocks: int, decrypt: bool,
     ):
         _require_tail_metadata(ivs)
 
-        def body(rk, dp, am, fm, cb):
+        def body(rk, dp, am, fm, cb, sm):
             return _packed_fixed_impl(
-                rk, None, dp, am, fm, cb,
+                rk, None, dp, am, fm, cb, sm,
                 chunk_bytes=chunk_bytes, n_blocks=n_blocks, decrypt=decrypt,
             )
 
         return shard_map_compat(
-            body, mesh=mesh, in_specs=(rep, row, rep, rep, rep),
+            body, mesh=mesh, in_specs=(rep, row, rep, rep, rep, rep),
             out_specs=row, check_vma=False,
-        )(round_keys, data_packed, agg_mats, final_mat, const_bits)
+        )(round_keys, data_packed, agg_mats, final_mat, const_bits, step_mat)
 
     return run
 
@@ -647,23 +795,23 @@ def _packed_varlen_sharded(mesh):
 
     def run(
         round_keys, ivs, data_packed, lengths, len_blocks, aad_blocks,
-        agg_mats, h_mat,
+        agg_mats, h_mat, step_mat=None,
         *, aad_bit_len: int, max_bytes: int, m_max: int, m_a: int,
         m_cap: int, decrypt: bool,
     ):
         _require_tail_metadata(ivs, lengths, len_blocks)
 
-        def body(rk, dp, ab, am, hm):
+        def body(rk, dp, ab, am, hm, sm):
             return _packed_varlen_impl(
-                rk, None, dp, None, None, ab, am, hm,
+                rk, None, dp, None, None, ab, am, hm, sm,
                 aad_bit_len=aad_bit_len, max_bytes=max_bytes, m_max=m_max,
                 m_a=m_a, m_cap=m_cap, decrypt=decrypt,
             )
 
         return shard_map_compat(
-            body, mesh=mesh, in_specs=(rep, row, rep, rep, rep),
+            body, mesh=mesh, in_specs=(rep, row, rep, rep, rep, rep),
             out_specs=row, check_vma=False,
-        )(round_keys, data_packed, aad_blocks, agg_mats, h_mat)
+        )(round_keys, data_packed, aad_blocks, agg_mats, h_mat, step_mat)
 
     return run
 
@@ -714,6 +862,8 @@ def gcm_window_packed(
     sharded identically to the input's so donation still aliases."""
     round_keys, agg_mats, final_mat, const_bits = _device_consts(ctx)
     _count_dispatch()
+    rows = data_packed.shape[0] // (mesh.size if mesh is not None else 1)
+    _count_roundtrips(planned_hbm_roundtrips(ctx, rows))
     return _packed_jit(False, donate, mesh)(
         round_keys,
         None if ivs is None else jnp.asarray(ivs, dtype=jnp.uint8),
@@ -721,6 +871,7 @@ def gcm_window_packed(
         agg_mats,
         final_mat,
         const_bits,
+        _device_step_mat(ctx),
         chunk_bytes=ctx.chunk_bytes,
         n_blocks=ctx.n_blocks,
         decrypt=decrypt,
@@ -749,6 +900,8 @@ def gcm_varlen_window_packed(
         lengths = np.asarray(lengths, dtype=np.int32)
     round_keys, aad_blocks, agg_mats, h_mat = _device_consts(ctx)
     _count_dispatch()
+    rows = data_packed.shape[0] // (mesh.size if mesh is not None else 1)
+    _count_roundtrips(planned_hbm_roundtrips(ctx, rows))
     return _packed_jit(True, donate, mesh)(
         round_keys,
         None if ivs is None else jnp.asarray(ivs, dtype=jnp.uint8),
@@ -758,6 +911,7 @@ def gcm_varlen_window_packed(
         aad_blocks,
         agg_mats,
         h_mat,
+        _device_step_mat(ctx),
         aad_bit_len=ctx.aad_bit_len,
         max_bytes=ctx.max_bytes,
         m_max=ctx.m_max,

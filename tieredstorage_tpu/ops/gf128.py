@@ -144,6 +144,19 @@ def ghash_agg_matrices(h: int, m: int, max_k: int = 128) -> tuple[np.ndarray, ..
     return tuple(mats)
 
 
+def ghash_step_matrix(h: int, k: int) -> np.ndarray:
+    """int8[128,128] transposed multiply-by-H^k matrix: ``bits @ M`` (mod 2)
+    multiplies a row of node bits by H^k — the between-group fold of the
+    fused Pallas GHASH tree kernel (ops/ghash_pallas.ghash_tree_pallas).
+    Folding sequentially over G groups of k blocks,
+    ``T = (T * H^k) ^ node_g``, yields exactly
+    ``sum_g node_g * H^(k*(G-1-g))`` — the same T(C) the grouped-power
+    ladder computes level by level, with no per-level HBM materialization.
+    Same transposed row-vector convention as the ladder operands and
+    ``mult_matrix(...).T`` final fold in ops/gcm.py."""
+    return np.ascontiguousarray(mult_matrix(gcm_pow(h, k)).T.astype(np.int8))
+
+
 def ghash_reference(h: int, blocks: list[bytes]) -> int:
     """Straightforward serial GHASH for testing: Y_i = (Y_{i-1} ^ X_i) * H."""
     y = 0

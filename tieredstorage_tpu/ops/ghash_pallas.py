@@ -1,15 +1,34 @@
-"""Pallas TPU kernel for grouped-GHASH level 1.
+"""Pallas TPU kernels for the grouped-GHASH reduction.
 
-The XLA formulation (ops/gcm.py `_ghash_grouped`) materializes 8 int8
-bit-planes of the ciphertext in HBM — 8 bytes of traffic per payload byte —
-before contracting them against the level-1 operand on the MXU. This kernel
-reads the raw bytes once: a [R_T, K] uint8 tile lands in VMEM, the 8 planes
-are extracted as in-register shifts/masks, and 8 f32 MXU matmuls accumulate
-the 128 output bits (values bounded by K ≤ 2048 < 2^24, so f32 accumulation
-is exact; the mod-2 reduction happens once at the end). HBM traffic drops to
-read-bytes + write-nodes (~1.06 B/B).
+Two kernels, one per reduction strategy:
 
-Levels >= 2 stay in XLA: they touch 128x less data.
+- **Level-1 kernel** (`ghash_level1_pallas`): the XLA formulation
+  (ops/gcm.py `_ghash_grouped`) materializes 8 int8 bit-planes of the
+  ciphertext in HBM — 8 bytes of traffic per payload byte — before
+  contracting them against the level-1 operand on the MXU. This kernel
+  reads the raw bytes once: a [R_T, K] uint8 tile lands in VMEM, the 8
+  planes are extracted as in-register shifts/masks, and 8 f32 MXU matmuls
+  accumulate the 128 output bits (values bounded by K ≤ 2048 < 2^24, so
+  f32 accumulation is exact; the mod-2 reduction happens once at the end).
+  HBM traffic drops to read-bytes + write-nodes (~1.06 B/B). Levels >= 2
+  then run as the XLA grouped-power ladder — one HBM round trip of
+  [B, G, 128] node bits per level.
+
+- **Tree kernel** (`ghash_tree_pallas`, ISSUE 13): the ENTIRE reduction —
+  level 1 AND every aggregation level above it — in one kernel. The grid
+  walks each row tile's groups sequentially; a VMEM scratch accumulator
+  carries the running T across groups and is folded by a precomputed
+  multiply-by-H^k bit matrix between steps
+  (``T = (T @ M_{H^k}) ^ node_g``, gf128.ghash_step_matrix), so the node
+  bits of level 2+ NEVER materialize in HBM: the payload crosses HBM
+  exactly once on the way in and [B, 128] final node bits on the way out.
+  The trade: group g+1 of a row depends on group g, so only the row axis
+  is parallel — the level-1 matmuls run at B(+pad) sublanes instead of
+  the level-1 kernel's 256-row tiles. For the production window shapes
+  (B=16 rows of 4 MiB) that exchanges MXU occupancy for zero inter-stage
+  HBM traffic and a single-stage program; the next relay window decides
+  the default with real numbers (TIEREDSTORAGE_TPU_PALLAS_GHASH_TREE=0
+  keeps the level-1 kernel + XLA ladder for A/B).
 
 Replaces the per-chunk GHASH of the reference's JDK GCM cipher
 (core/.../transform/EncryptionChunkEnumeration.java:66-81) together with
@@ -24,6 +43,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 #: Rows of the flattened [B*G, K] level-1 matrix per grid step. 256 rows x
 #: 2048 cols keeps the widened int32 tile (2 MiB — x is upcast before the
@@ -158,4 +178,188 @@ def ghash_level1_pallas(
         out_shape=jax.ShapeDtypeStruct((padded, 128), jnp.int8),
         interpret=interpret,
     )(data, w1)
+    return out[:rows]
+
+
+# --------------------------------------------------------------- tree kernel
+
+#: Rows per grid step of the tree kernel. The row axis only carries the GCM
+#: batch (each row's groups are a sequential chain), so the tile is the f32
+#: sublane minimum: VMEM per step stays at the widened int32 data tile
+#: (8 x K x 4 B = 64 KiB at K=2048) + the int8 level-1 operand (2 MiB) +
+#: one f32 plane operand (1 MiB) + the fold matrix and [8, 128] accumulator.
+TREE_ROWS_PER_STEP = 8
+
+_TREE_PREFLIGHT: list[bool] = []  # memoized per-process platform verdict
+
+
+def use_pallas_ghash_tree(batch: int, groups: int, k_bytes: int) -> bool:
+    """Shape eligibility for the fused tree kernel — pure host logic, no
+    platform probe (same split-gate contract as `use_pallas_ghash`). The
+    group byte width must tile the 128-lane minor dimension and fit the
+    kernel's VMEM budget (the agg plan caps k at 128 blocks = 2048 bytes),
+    and at least two groups must exist: a single-group reduction is already
+    one level-1 pass with nothing to aggregate, so the tree buys nothing."""
+    return (
+        0 < k_bytes <= 2048
+        and k_bytes % 128 == 0
+        and groups >= 2
+        and batch >= 1
+    )
+
+
+def _tree_preflight_attempt() -> bool:
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    k, groups = 256, 3
+    data = rng.integers(0, 256, (TREE_ROWS_PER_STEP, groups * k), dtype=np.uint8)
+    w1 = rng.integers(0, 2, (8, k, 128), dtype=np.int8)
+    step = rng.integers(0, 2, (128, 128), dtype=np.int8)
+    acc = np.zeros((TREE_ROWS_PER_STEP, 128), dtype=np.int64)
+    for g in range(groups):
+        tile = data[:, g * k : (g + 1) * k]
+        planes = np.stack([(tile >> p) & 1 for p in range(8)]).astype(np.int64)
+        node = np.einsum("prk,pko->ro", planes, w1.astype(np.int64)) & 1
+        acc = ((acc @ step.astype(np.int64)) & 1) ^ node if g else node
+    expect = acc.astype(np.int8)
+    with jax.ensure_compile_time_eval():
+        got = jax.block_until_ready(
+            ghash_tree_pallas(
+                jnp.asarray(data), jnp.asarray(w1), jnp.asarray(step)
+            )
+        )
+        ok = bool(jnp.array_equal(got, expect))
+    if not ok:  # pragma: no cover - platform-specific
+        raise AssertionError(
+            "unsupported: tree kernel output diverges from numpy reference"
+        )
+    return ok
+
+
+def _tree_preflight_ok() -> bool:
+    """First-use compile-and-run of the tree kernel on a minimal shape,
+    cross-checked against an exact numpy fold (same retry/memoization
+    contract as `_preflight_ok`; a Mosaic failure degrades to the level-1
+    kernel + XLA ladder, never aborts the caller's trace)."""
+    import logging
+
+    from tieredstorage_tpu.ops._preflight import run_preflight
+
+    return run_preflight(
+        _TREE_PREFLIGHT,
+        _tree_preflight_attempt,
+        logging.getLogger(__name__),
+        "Pallas GHASH tree kernel unavailable on this platform, "
+        "falling back to the level-1 kernel + XLA ladder: %s",
+    )
+
+
+def pallas_ghash_tree_available() -> bool:
+    """Platform half of the tree gate. TIEREDSTORAGE_TPU_PALLAS_GHASH_TREE
+    overrides just the tree (on-chip A/B against the ladder); unset, it
+    follows TIEREDSTORAGE_TPU_PALLAS_GHASH, then real-TPU + preflight —
+    all read at trace time like the sibling gates."""
+    import os
+
+    forced = os.environ.get("TIEREDSTORAGE_TPU_PALLAS_GHASH_TREE")
+    if forced is None:
+        forced = os.environ.get("TIEREDSTORAGE_TPU_PALLAS_GHASH")
+    if forced is not None:
+        return forced not in ("0", "false", "off")
+    try:
+        if jax.default_backend() not in ("tpu", "axon"):
+            return False
+    except Exception:
+        return False
+    return _tree_preflight_ok()
+
+
+def _ghash_tree_kernel(x_ref, w_ref, step_ref, o_ref, acc_ref):
+    """x_ref: VMEM uint8[R, K] — group g's byte columns of the row tile;
+    w_ref: VMEM int8[8, K, 128] level-1 operand; step_ref: VMEM
+    int8[128, 128] transposed multiply-by-H^(K/16) fold matrix; o_ref:
+    VMEM int8[R, 128]; acc_ref: VMEM f32[R, 128] running T accumulator
+    (0/1 values), persistent across the sequential group axis."""
+    g = pl.program_id(1)
+    # Widen BEFORE the bit math: Mosaic on the v5e toolchain legalizes
+    # neither u8 vector shifts nor direct u8->f32 casts (round 5).
+    x = x_ref[:].astype(jnp.int32)
+    node = None
+    for p in range(8):
+        plane = ((x >> p) & 1).astype(jnp.float32)
+        w_p = w_ref[p].astype(jnp.int32).astype(jnp.float32)
+        part = jnp.dot(plane, w_p, preferred_element_type=jnp.float32)
+        node = part if node is None else node + part
+    # Exact: plane sums are bounded by K <= 2048 < 2^24.
+    node_bits = node.astype(jnp.int32) & 1
+
+    @pl.when(g == 0)
+    def _init():
+        acc_ref[:] = node_bits.astype(jnp.float32)
+
+    @pl.when(g != 0)
+    def _fold():
+        step = step_ref[:].astype(jnp.int32).astype(jnp.float32)
+        folded = jnp.dot(
+            acc_ref[:], step, preferred_element_type=jnp.float32
+        )
+        # Exact again: fold sums are bounded by 128.
+        acc_ref[:] = (
+            (folded.astype(jnp.int32) & 1) ^ node_bits
+        ).astype(jnp.float32)
+
+    @pl.when(g == pl.num_programs(1) - 1)
+    def _emit():
+        o_ref[:] = acc_ref[:].astype(jnp.int8)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def ghash_tree_pallas(
+    data: jnp.ndarray,
+    w1: jnp.ndarray,
+    step_mat: jnp.ndarray,
+    *,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """data uint8[B, G*K] (G groups of the level-1 byte width K, leading
+    zero-block padding already applied by the caller), w1 int8[8, K, 128],
+    step_mat int8[128, 128] (gf128.ghash_step_matrix of H^(K/16)) ->
+    T(C) node bits int8[B, 128].
+
+    Bit-exact drop-in for the WHOLE `gcm._ghash_grouped` reduction — level
+    1 and every grouped-power level above it — as ONE kernel: the grid
+    walks (row tile, group) with the group axis sequential, a VMEM scratch
+    accumulator folds ``T = (T @ M_{H^k}) ^ node_g`` between groups, and
+    only the final [B, 128] node bits leave the kernel. B is padded to the
+    TREE_ROWS_PER_STEP grid inside the op (zero rows reduce to zero bits)
+    and sliced back."""
+    rows, total = data.shape
+    k = w1.shape[1]
+    if rows <= 0:
+        raise ValueError("rows must be positive")
+    if w1.shape != (8, k, 128):
+        raise ValueError(f"weights {w1.shape} are not (8, K, 128)")
+    if k <= 0 or total % k:
+        raise ValueError(f"data width {total} does not tile into K={k} groups")
+    if step_mat.shape != (128, 128):
+        raise ValueError(f"step matrix {step_mat.shape} is not (128, 128)")
+    groups = total // k
+    padded = -(-rows // TREE_ROWS_PER_STEP) * TREE_ROWS_PER_STEP
+    if padded != rows:
+        data = jnp.pad(data, ((0, padded - rows), (0, 0)))
+    row_steps = padded // TREE_ROWS_PER_STEP
+    out = pl.pallas_call(
+        _ghash_tree_kernel,
+        grid=(row_steps, groups),
+        in_specs=[
+            pl.BlockSpec((TREE_ROWS_PER_STEP, k), lambda r, g: (r, g)),
+            pl.BlockSpec((8, k, 128), lambda r, g: (0, 0, 0)),
+            pl.BlockSpec((128, 128), lambda r, g: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((TREE_ROWS_PER_STEP, 128), lambda r, g: (r, 0)),
+        out_shape=jax.ShapeDtypeStruct((padded, 128), jnp.int8),
+        scratch_shapes=[pltpu.VMEM((TREE_ROWS_PER_STEP, 128), jnp.float32)],
+        interpret=interpret,
+    )(data, w1, step_mat)
     return out[:rows]

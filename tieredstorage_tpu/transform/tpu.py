@@ -112,6 +112,12 @@ class DispatchStats:
     h2d_transfers: int = 0
     d2h_fetches: int = 0
     bytes_in: int = 0
+    #: Payload-scale inter-stage HBM round trips inside the window program
+    #: (ops.gcm.planned_hbm_roundtrips): the keystream handoff is the one
+    #: allowed; the XLA GHASH ladder adds one per level >= 2 and one for
+    #: the plane materialization — the fused tree kernel (ISSUE 13) brings
+    #: the total to exactly 1, CI-gated <= 1 by `make transform-demo`.
+    hbm_roundtrips: int = 0
     #: Staged window buffers XLA consumed as the output allocation —
     #: steady-state encrypt must reuse ONE HBM allocation per in-flight
     #: window (donated_buffers == windows), sharded or not.
@@ -127,12 +133,17 @@ class DispatchStats:
         return round(self.dispatches / self.windows, 3) if self.windows else 0.0
 
     @property
+    def hbm_roundtrips_per_window(self) -> float:
+        return round(self.hbm_roundtrips / self.windows, 3) if self.windows else 0.0
+
+    @property
     def bytes_per_dispatch(self) -> int:
         return int(self.bytes_in / self.dispatches) if self.dispatches else 0
 
     def as_dict(self) -> dict:
         out = dataclasses.asdict(self)
         out["dispatches_per_window"] = self.dispatches_per_window
+        out["hbm_roundtrips_per_window"] = self.hbm_roundtrips_per_window
         out["bytes_per_dispatch"] = self.bytes_per_dispatch
         return out
 
@@ -374,6 +385,7 @@ class TpuTransformBackend(TransformBackend):
         result streams back while later windows compute."""
         mesh = self.mesh_plan().mesh
         before = gcm_ops.thread_dispatches()
+        rt_before = gcm_ops.thread_hbm_roundtrips()
         if varlen:
             out = gcm_varlen_window_packed(
                 ctx, None, staged, None, decrypt=decrypt, donate=True,
@@ -384,12 +396,14 @@ class TpuTransformBackend(TransformBackend):
                 ctx, None, staged, decrypt=decrypt, donate=True, mesh=mesh,
             )
         delta = gcm_ops.thread_dispatches() - before
+        rt_delta = gcm_ops.thread_hbm_roundtrips() - rt_before
         try:
             donated = staged.is_deleted()  # XLA consumed the staged allocation
         except AttributeError:
             donated = False  # non-jax arrays (mocked backends)
         with self._stats_lock:
             self.dispatch_stats.dispatches += delta
+            self.dispatch_stats.hbm_roundtrips += rt_delta
             if donated:
                 self.dispatch_stats.donated_buffers += 1
             note_mutation("tpu.TpuTransformBackend.dispatch_stats")

@@ -76,6 +76,11 @@ DEFAULT_TARGETS = [
     # eviction ordering are pure logic; a flipped comparison silently turns
     # the cache into a scan-thrashed or never-admitting tier.
     ("tieredstorage_tpu/fetch/cache/device_hot.py", ["tests/test_device_hot.py"]),
+    # ISSUE 13: the GHASH kernels' tiling arithmetic, eligibility floors,
+    # and the tree kernel's fold/init/emit predicates are pure logic; an
+    # operator flip either mis-tiles the grid (wrong tags) or silently
+    # routes production off the fused path.
+    ("tieredstorage_tpu/ops/ghash_pallas.py", ["tests/test_ghash_pallas.py"]),
 ]
 
 _CMP_SWAP = {

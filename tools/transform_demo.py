@@ -9,9 +9,17 @@ program shapes dispatch on-chip) and asserts the PR-8 tentpole contracts:
   fetch (`DispatchStats` vs the ops-level launch counter in
   `ops/gcm.py` — the ~62 ms per-launch floor of the measured harness is
   paid once per window, PROFILE.md).
+- **One HBM round trip per window** (ISSUE 13): with the fused GHASH tree
+  kernel engaged (forced into Mosaic interpret mode here — the REAL kernel
+  code runs, slowly, on the host) every window's program contains exactly
+  one payload-scale inter-stage materialization: the keystream handoff.
+  The XLA grouped-power ladder CONTROL on the same shapes must report > 1,
+  proving the counter distinguishes the paths.
 - **Parity**: the fused path's wire bytes equal the multi-dispatch
   reference ops' (`gcm_encrypt_chunks` / `gcm_encrypt_varlen`) byte for
-  byte, for fixed-size windows and a varlen tail window.
+  byte, for fixed-size windows and a varlen tail window — and the ladder
+  control's wire bytes equal the tree path's, so both reductions compute
+  the same GCM.
 - **Round trip**: the fused decrypt returns the original chunks, and
   a tampered tag is rejected.
 - **Shape eligibility is host logic**: `use_pallas_aes`/`use_pallas_ghash`
@@ -25,6 +33,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import pathlib
 import random
 import sys
@@ -32,6 +41,11 @@ import time
 
 REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(REPO_ROOT))
+
+# Engage the fused GHASH tree kernel (Mosaic interpret off-TPU): the gate
+# below asserts hbm_roundtrips_per_window <= 1 through the REAL kernel
+# code. Read at trace time, so it must be set before the first window.
+os.environ.setdefault("TIEREDSTORAGE_TPU_PALLAS_GHASH_TREE", "1")
 
 from tieredstorage_tpu.utils.platforms import pin_virtual_cpu  # noqa: E402
 
@@ -120,6 +134,12 @@ def run(out_path: pathlib.Path) -> int:
     checks["one_transfer_and_fetch_per_window"] = (
         stats.h2d_transfers == N_WINDOWS and stats.d2h_fetches == N_WINDOWS
     )
+    # ISSUE 13: the fused tree path is one payload-scale HBM round trip
+    # per window (the keystream handoff), fixed AND varlen windows.
+    checks["one_hbm_roundtrip_per_window"] = (
+        stats.hbm_roundtrips_per_window <= 1.0
+        and stats.hbm_roundtrips == N_WINDOWS
+    )
 
     # 2. Byte parity against the multi-dispatch reference program.
     flat = [c for w in out_windows for c in w]
@@ -148,6 +168,32 @@ def run(out_path: pathlib.Path) -> int:
     except AuthenticationError:
         checks["tamper_rejected"] = True
 
+    # 3b. Ladder CONTROL (ISSUE 13): the identical workload through the
+    # XLA grouped-power fallback must report > 1 round trips per window —
+    # the counter separates the reduction strategies — with wire bytes
+    # identical to the tree path's (the math does not change). Cache
+    # clears force retraces at the same shapes; the env is trace-time.
+    os.environ["TIEREDSTORAGE_TPU_PALLAS_GHASH_TREE"] = "0"
+    gcm._packed_jit.cache_clear()
+    gcm._gcm_process_batch.clear_cache()
+    gcm._gcm_varlen_batch.clear_cache()
+    try:
+        ladder = TpuTransformBackend()
+        ladder_out = list(ladder.transform_windows(iter(list(windows)), opts))
+        lstats = ladder.dispatch_stats
+        report["ladder_hbm_roundtrips_per_window"] = (
+            lstats.hbm_roundtrips_per_window
+        )
+        checks["ladder_control_exceeds_one_roundtrip"] = (
+            lstats.hbm_roundtrips_per_window > 1.0
+        )
+        checks["ladder_parity_with_tree_path"] = ladder_out == out_windows
+    finally:
+        os.environ["TIEREDSTORAGE_TPU_PALLAS_GHASH_TREE"] = "1"
+        gcm._packed_jit.cache_clear()
+        gcm._gcm_process_batch.clear_cache()
+        gcm._gcm_varlen_batch.clear_cache()
+
     # 4. Eligibility at the default bench shapes is pure host logic.
     from tieredstorage_tpu.ops.aes_pallas import use_pallas_aes
     from tieredstorage_tpu.ops.gf128 import ghash_agg_plan
@@ -173,6 +219,10 @@ def run(out_path: pathlib.Path) -> int:
         f"[transform-demo] {N_WINDOWS} windows x {WINDOW_CHUNKS} chunks: "
         f"dispatches_per_window="
         f"{loaded['dispatch_stats']['dispatches_per_window']} "
+        f"hbm_roundtrips_per_window="
+        f"{loaded['dispatch_stats']['hbm_roundtrips_per_window']} "
+        f"(ladder control "
+        f"{loaded['ladder_hbm_roundtrips_per_window']}) "
         f"bytes_per_dispatch={loaded['dispatch_stats']['bytes_per_dispatch']} "
         f"in {loaded['elapsed_ms']} ms -> {out_path}"
     )
