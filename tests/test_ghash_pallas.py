@@ -115,6 +115,50 @@ def test_preflight_failure_degrades_gracefully(monkeypatch):
     assert ghash_pallas._preflight_ok() is False  # memoized, no retry
 
 
+def test_level1_preflight_attempt_crosschecks_on_cpu(monkeypatch):
+    """The preflight's own numpy reference is the on-chip correctness
+    oracle, so the CPU suite must execute it for real: stand the kernel in
+    with `_numpy_level1` (itself kernel-validated above — interpret-mode
+    Pallas cannot run under the attempt's ensure_compile_time_eval) and
+    the attempt must agree. Any operator flip in the reference fails the
+    cross-check loudly instead of silently blinding the TPU gate."""
+    monkeypatch.setattr(
+        ghash_pallas,
+        "ghash_level1_pallas",
+        lambda data, w1, **kw: jnp.asarray(
+            _numpy_level1(np.asarray(data), np.asarray(w1))
+        ),
+    )
+    assert ghash_pallas._preflight_attempt() is True
+
+
+def test_tree_preflight_attempt_crosschecks_on_cpu(monkeypatch):
+    """Same contract for the tree preflight's numpy group-fold, with the
+    kernel stood in by `_numpy_tree` (kernel-validated above)."""
+    monkeypatch.setattr(
+        ghash_pallas,
+        "ghash_tree_pallas",
+        lambda data, w1, step, **kw: jnp.asarray(
+            _numpy_tree(np.asarray(data), np.asarray(w1), np.asarray(step))
+        ),
+    )
+    assert ghash_pallas._tree_preflight_attempt() is True
+
+
+def test_kernels_reject_empty_batch():
+    """rows == 0 must fail loud at trace time in BOTH kernels — a zero-row
+    grid would otherwise return an empty result that upstream code could
+    mistake for a tagged window."""
+    w1 = jnp.zeros((8, 256, 128), jnp.int8)
+    with pytest.raises(ValueError, match="rows"):
+        ghash_level1_pallas(jnp.zeros((0, 256), jnp.uint8), w1, interpret=True)
+    with pytest.raises(ValueError, match="rows"):
+        ghash_tree_pallas(
+            jnp.zeros((0, 512), jnp.uint8), w1,
+            jnp.zeros((128, 128), jnp.int8), interpret=True,
+        )
+
+
 # --------------------------------------------------------- tree kernel (13)
 def _numpy_tree(data: np.ndarray, w1: np.ndarray, step: np.ndarray) -> np.ndarray:
     """Exact group-sequential fold: T = (T @ M) ^ node_g, all in int64."""
