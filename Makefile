@@ -1,7 +1,7 @@
 # Developer entry points (counterpart of /root/reference/Makefile).
 PYTHON ?= python
 
-.PHONY: test test-e2e chaos bench demo trace-demo scrub-demo tail-demo failover-demo fleet-demo fleet-soak transform-demo multichip-demo hot-demo docs docker lint analyze mutation clean
+.PHONY: test test-e2e chaos bench demo trace-demo scrub-demo tail-demo failover-demo fleet-demo fleet-soak transform-demo multichip-demo hot-demo load-demo docs docker lint analyze mutation clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q --ignore=tests/e2e
@@ -126,6 +126,23 @@ multichip-demo:
 hot-demo:
 	$(PYTHON) tools/hot_demo.py --out artifacts/hot_report.json
 
+# Load + SLO chaos gate (ROADMAP item 4, ISSUE 14): a seeded closed-loop
+# Zipfian produce/fetch workload over a 3-instance fleet and a 2-replica
+# store, while a storage replica AND a fleet instance are killed mid-run.
+# Judged by the observability plane itself, not hardcoded thresholds: every
+# survivor's GET /slo must report all specs ok with real histogram samples
+# and both burn-rate windows engaged (fetch p99 within the deadline budget,
+# bounded shed rate, bounded error rate), the fleet-wide telemetry scrape
+# must prove the replica kill was absorbed (replica-failovers-total >= 1)
+# and the cache tier held, every fetched byte must match the source across
+# both kills, GET /debug/requests must hold flight records with tier
+# evidence, and — LockWitness armed — zero lock-order and zero guarded-by
+# violations. Writes artifacts/load_report.json + artifacts/BENCH_LOAD.json
+# (the committed BENCH_LOAD_r01.json trajectory point) and re-validates
+# both.
+load-demo:
+	TSTPU_LOCK_WITNESS=1 $(PYTHON) tools/load_demo.py --out artifacts/load_report.json --bench-out artifacts/BENCH_LOAD.json
+
 docs:
 	$(PYTHON) -m tieredstorage_tpu.docs.configs_docs > docs/configs.rst
 	$(PYTHON) -m tieredstorage_tpu.docs.metrics_docs > docs/metrics.rst
@@ -153,7 +170,7 @@ lint: analyze
 # /root/reference/build.gradle:24): flips operators in core pure-logic
 # modules and requires the owning suites to notice.
 mutation:
-	$(PYTHON) tools/mutation_test.py --budget 80
+	$(PYTHON) tools/mutation_test.py --budget 88
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} +

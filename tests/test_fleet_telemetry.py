@@ -1,0 +1,274 @@
+"""Fleet telemetry suite (ISSUE 14): sample export, per-stat merge
+semantics (sum / max / min / histogram-merge), the membership-view scrape
+with unreachable-member degradation, ping-counter folding, and RSM wiring.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tieredstorage_tpu.fleet.ring import FleetRouter
+from tieredstorage_tpu.fleet.telemetry import (
+    FleetTelemetry,
+    aggregation_of,
+    export_samples,
+    merge_samples,
+)
+from tieredstorage_tpu.metrics.core import (
+    Count,
+    Histogram,
+    MetricName,
+    MetricsRegistry,
+    Total,
+)
+
+
+def registry_with(stats) -> MetricsRegistry:
+    registry = MetricsRegistry()
+    for name, group, stat in stats:
+        registry.register(MetricName.of(name, group), stat)
+    return registry
+
+
+def total(value: float) -> Total:
+    stat = Total()
+    stat.record(value, 0.0)
+    return stat
+
+
+class TestAggregationRules:
+    def test_suffix_table(self):
+        assert aggregation_of("peer-hits-total") == "sum"
+        assert aggregation_of("fleet-forwards-rate") == "sum"
+        assert aggregation_of("segment-copy-time-max") == "max"
+        assert aggregation_of("breaker-state") == "max"
+        assert aggregation_of("replica-health-min") == "min"
+        assert aggregation_of("anything-else") == "sum"
+
+
+class TestExportSamples:
+    def test_values_and_histograms(self):
+        hist = Histogram(buckets=(10.0, 20.0))
+        hist.record(5.0, 0.0)
+        hist.record(15.0, 0.0)
+        registry = registry_with([
+            ("hits-total", "g", total(7.0)),
+            ("lat-ms", "g", hist),
+        ])
+        registry.add_gauge(MetricName.of("depth", "g"), lambda: 3.0)
+        samples = {s["name"]: s for s in export_samples([registry])}
+        assert samples["hits-total"] == {
+            "group": "g", "name": "hits-total", "tags": {},
+            "kind": "value", "value": 7.0,
+        }
+        assert samples["depth"]["value"] == 3.0
+        h = samples["lat-ms"]
+        assert h["kind"] == "histogram"
+        assert h["buckets"] == [["10", 1], ["20", 2], ["+Inf", 2]]
+        assert h["sum"] == 20.0 and h["count"] == 2
+
+    def test_failing_gauge_degrades_visibly(self):
+        registry = MetricsRegistry()
+        registry.add_gauge(
+            MetricName.of("broken", "g"), lambda: 1 / 0
+        )
+        registry.register(MetricName.of("ok-total", "g"), total(1.0))
+        samples = {s["name"]: s for s in export_samples([registry])}
+        assert "broken" not in samples and "ok-total" in samples
+        # The swallow is counted, not silent.
+        assert samples["telemetry-skipped-gauges-total"]["value"] == 1.0
+
+    def test_duplicate_series_across_registries_deduped(self):
+        a = registry_with([("x-total", "g", total(1.0))])
+        b = registry_with([("x-total", "g", total(99.0))])
+        samples = export_samples([a, b])
+        assert len(samples) == 1 and samples[0]["value"] == 1.0
+
+
+class TestMergeSamples:
+    def test_sum_max_min_semantics(self):
+        members = {
+            "g0": [
+                {"group": "g", "name": "hits-total", "tags": {},
+                 "kind": "value", "value": 5.0},
+                {"group": "g", "name": "breaker-state", "tags": {},
+                 "kind": "value", "value": 0.0},
+                {"group": "g", "name": "lat-min", "tags": {},
+                 "kind": "value", "value": 4.0},
+            ],
+            "g1": [
+                {"group": "g", "name": "hits-total", "tags": {},
+                 "kind": "value", "value": 7.0},
+                {"group": "g", "name": "breaker-state", "tags": {},
+                 "kind": "value", "value": 2.0},
+                {"group": "g", "name": "lat-min", "tags": {},
+                 "kind": "value", "value": 9.0},
+            ],
+        }
+        merged = merge_samples(members)
+        hits = merged["g:hits-total"]
+        assert hits["value"] == 12.0 and hits["aggregation"] == "sum"
+        assert hits["members"] == ["g0", "g1"]
+        assert merged["g:breaker-state"]["value"] == 2.0  # worst state wins
+        assert merged["g:breaker-state"]["aggregation"] == "max"
+        assert merged["g:lat-min"]["value"] == 4.0
+
+    def test_histogram_merge_sums_per_bound(self):
+        def hist_sample(buckets, total_sum, count):
+            return {"group": "g", "name": "lat-ms", "tags": {},
+                    "kind": "histogram", "buckets": buckets,
+                    "sum": total_sum, "count": count}
+
+        merged = merge_samples({
+            "g0": [hist_sample([["10", 1], ["+Inf", 2]], 30.0, 2)],
+            "g1": [hist_sample([["10", 4], ["+Inf", 4]], 8.0, 4)],
+        })
+        h = merged["g:lat-ms"]
+        assert h["aggregation"] == "histogram-merge"
+        assert h["buckets"] == {"10": 5, "+Inf": 6}
+        assert h["sum"] == 38.0 and h["count"] == 6
+
+    def test_tags_split_series(self):
+        sample = {"group": "g", "name": "score", "kind": "value", "value": 1.0}
+        merged = merge_samples({
+            "g0": [{**sample, "tags": {"replica": "a"}}],
+            "g1": [{**sample, "tags": {"replica": "b"}}],
+        })
+        assert set(merged) == {"g:score{replica=a}", "g:score{replica=b}"}
+
+
+class TestFleetScrape:
+    def _telemetry(self, *, peers, transport, registry=None):
+        router = FleetRouter("g0", vnodes=8)
+        router.set_membership(peers)
+        registry = registry or registry_with(
+            [("hits-total", "g", total(1.0))]
+        )
+        return FleetTelemetry(
+            [registry], instance_id="g0", router=router, transport=transport
+        )
+
+    def test_scrape_merges_local_and_peers(self):
+        peer_payload = {
+            "instance": "g1",
+            "samples": [{"group": "g", "name": "hits-total", "tags": {},
+                         "kind": "value", "value": 41.0}],
+        }
+        calls: list[str] = []
+
+        def transport(url):
+            calls.append(url)
+            return peer_payload
+
+        telemetry = self._telemetry(
+            peers={"g0": None, "g1": "http://127.0.0.1:1"},
+            transport=transport,
+        )
+        scrape = telemetry.scrape()
+        assert calls == ["http://127.0.0.1:1"]
+        assert scrape["members"]["g0"] == {
+            "reachable": True, "local": True, "samples": 1,
+        }
+        assert scrape["members"]["g1"]["reachable"] is True
+        assert scrape["fleet"]["g:hits-total"]["value"] == 42.0
+        assert scrape["scrapes"] == 1
+
+    def test_unreachable_member_degrades(self):
+        def transport(url):
+            raise ConnectionError("down")
+
+        telemetry = self._telemetry(
+            peers={"g0": None, "g1": "http://127.0.0.1:1"},
+            transport=transport,
+        )
+        scrape = telemetry.scrape()
+        assert scrape["members"]["g1"]["reachable"] is False
+        assert "ConnectionError" in scrape["members"]["g1"]["error"]
+        assert scrape["fleet"]["g:hits-total"]["value"] == 1.0  # local only
+        assert telemetry.peer_scrape_failures == 1
+
+    def test_malformed_peer_payload_degrades(self):
+        telemetry = self._telemetry(
+            peers={"g0": None, "g1": "http://127.0.0.1:1"},
+            transport=lambda url: {"not": "samples"},
+        )
+        scrape = telemetry.scrape()
+        # The transport seam returns the payload dict directly, so the
+        # degenerate shape surfaces as an empty sample list, not a crash.
+        assert scrape["members"]["g1"]["reachable"] is True
+        assert scrape["members"]["g1"]["samples"] == 0
+
+    def test_ping_counters_fold_into_fleet_ping_group(self):
+        ping = {
+            "instance": "g0",
+            "generation": 3,
+            "peer_cache": {"forwards": 10, "failover_hits": 2},
+            "ring_instances": ["g0", "g1"],  # non-numeric: dropped
+        }
+        router = FleetRouter("g0", vnodes=8)
+        telemetry = FleetTelemetry(
+            [MetricsRegistry()], instance_id="g0", router=router, ping=lambda: ping,
+        )
+        samples = {s["name"]: s for s in telemetry.local_payload()["samples"]}
+        assert samples["peer_cache-forwards-total"]["group"] == "fleet-ping"
+        assert samples["peer_cache-forwards-total"]["value"] == 10.0
+        assert samples["peer_cache-failover-hits-total"]["value"] == 2.0
+        assert samples["generation"]["value"] == 3.0
+        assert "ring_instances" not in samples
+
+    def test_no_router_scrapes_local_only(self):
+        telemetry = FleetTelemetry(
+            [registry_with([("hits-total", "g", total(5.0))])],
+            instance_id="solo",
+        )
+        scrape = telemetry.scrape()
+        assert list(scrape["members"]) == ["solo"]
+        assert scrape["fleet"]["g:hits-total"]["value"] == 5.0
+
+
+class TestRsmWiring:
+    @pytest.fixture()
+    def fleet_rsm(self, tmp_path):
+        from tests.test_rsm_lifecycle import make_rsm
+
+        rsm, _ = make_rsm(tmp_path, compression=False, encryption=False,
+                          extra_configs={
+                              "fleet.enabled": True,
+                              "fleet.instance.id": "g0",
+                          })
+        yield rsm
+        rsm.close()
+
+    def test_payload_and_aggregate(self, fleet_rsm):
+        assert fleet_rsm.fleet_telemetry is not None
+        payload = fleet_rsm.fleet_telemetry_payload()
+        assert payload["instance"] == "g0"
+        names = {s["name"] for s in payload["samples"]}
+        # RSM registries + the folded ping counters are both present.
+        assert "generation" in names  # fleet-ping pseudo-group
+        assert any(n.startswith("segment-") or n.endswith("-total")
+                   for n in names)
+        scrape = fleet_rsm.fleet_telemetry_payload(aggregate=True)
+        assert scrape["members"]["g0"]["local"] is True
+        assert scrape["fleet"]
+
+    def test_disabled_without_fleet(self, tmp_path):
+        from tests.test_rsm_lifecycle import make_rsm
+
+        rsm, _ = make_rsm(tmp_path, compression=False, encryption=False)
+        try:
+            assert rsm.fleet_telemetry is None
+            with pytest.raises(Exception, match="not enabled"):
+                rsm.fleet_telemetry_payload()
+        finally:
+            rsm.close()
+
+
+class TestCountStat:
+    def test_count_exports_as_value(self):
+        stat = Count()
+        stat.record(1.0, 0.0)
+        stat.record(1.0, 0.0)
+        registry = registry_with([("ops-total", "g", stat)])
+        [sample] = export_samples([registry])
+        assert sample["kind"] == "value" and sample["value"] == 2.0

@@ -1,0 +1,294 @@
+"""Flight recorder unit + integration suite (ISSUE 14).
+
+Covers the thread-local record lifecycle (install, enrich, archive), the
+slowest/failed retention rings (the pure heap/deque logic the mutation
+harness targets), zero-work disabled mode, reentrancy, cross-thread
+binding, and the end-to-end wiring: a gateway-served fetch must produce a
+record whose tier breakdown matches where the chunks actually came from,
+with the same trace id the latency histograms attached as an exemplar.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from tieredstorage_tpu.utils import flightrecorder as flight
+from tieredstorage_tpu.utils.deadline import Deadline, deadline_scope
+from tieredstorage_tpu.utils.flightrecorder import (
+    NOOP_RECORDER,
+    FlightRecorder,
+    RequestRecord,
+)
+
+
+class FakeClock:
+    def __init__(self, at: float = 100.0) -> None:
+        self.at = at
+
+    def __call__(self) -> float:
+        return self.at
+
+    def advance(self, s: float) -> None:
+        self.at += s
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_record():
+    assert flight.current_record() is None
+    yield
+    assert flight.current_record() is None
+
+
+class TestRecordLifecycle:
+    def test_request_installs_and_archives(self):
+        clock = FakeClock()
+        recorder = FlightRecorder(enabled=True, time_source=clock)
+        with recorder.request("op", trace_id="t1") as record:
+            assert flight.current_record() is record
+            assert record.trace_id == "t1"
+            flight.note("tier.backend", 3)
+            flight.note("tier.backend", 2)
+            flight.note("hedge.won")
+            clock.advance(0.25)
+        assert flight.current_record() is None
+        assert recorder.requests_seen == 1
+        assert recorder.requests_failed == 0
+        [archived] = recorder.slowest()
+        assert archived is record
+        assert archived.duration_ms == pytest.approx(250.0)
+        assert archived.counters == {"tier.backend": 5.0, "hedge.won": 1.0}
+        assert archived.tier_breakdown() == {"backend": 5.0}
+
+    def test_error_is_captured_and_propagated(self):
+        recorder = FlightRecorder(enabled=True)
+        with pytest.raises(ValueError, match="boom"):
+            with recorder.request("op"):
+                raise ValueError("boom")
+        assert recorder.requests_failed == 1
+        [failed] = recorder.failures()
+        assert failed.error == "ValueError: boom"
+        # Failed requests also compete for the slow ring.
+        assert recorder.find("") is None
+
+    def test_deadline_budget_recorded_at_entry_and_exit(self):
+        recorder = FlightRecorder(enabled=True)
+        with deadline_scope(Deadline.after(10.0)):
+            with recorder.request("op") as record:
+                flight.stage("mid")
+        assert 0 < record.deadline_entry_ms <= 10_000
+        assert 0 < record.deadline_exit_ms <= record.deadline_entry_ms
+        (name, at_ms, remaining_ms) = record.stages[0]
+        assert name == "mid" and at_ms >= 0 and 0 < remaining_ms <= 10_000
+
+    def test_no_deadline_means_none(self):
+        recorder = FlightRecorder(enabled=True)
+        with recorder.request("op") as record:
+            flight.stage("mid")
+        assert record.deadline_entry_ms is None
+        assert record.deadline_exit_ms is None
+        assert record.stages[0][2] is None
+
+    def test_reentrant_request_joins_the_outer_record(self):
+        recorder = FlightRecorder(enabled=True)
+        with recorder.request("outer", trace_id="t-out") as outer:
+            with recorder.request("inner", trace_id="t-in") as inner:
+                assert inner is outer
+                flight.note("tier.peer", 1)
+        assert recorder.requests_seen == 1  # ONE record end to end
+        assert outer.counters == {"tier.peer": 1.0}
+
+    def test_to_dict_derives_per_window_gcm_accounting(self):
+        record = RequestRecord(name="op", trace_id="t", start_s=0.0, end_s=0.1)
+        record.counters = {
+            "gcm.windows": 2.0, "gcm.dispatches": 2.0,
+            "gcm.hbm_roundtrips": 4.0,
+        }
+        out = record.to_dict()
+        assert out["gcm_dispatches_per_window"] == 1.0
+        assert out["gcm_hbm_roundtrips_per_window"] == 2.0
+        # No windows -> no derived keys (never a divide-by-phantom).
+        assert "gcm_dispatches_per_window" not in RequestRecord(
+            name="op", trace_id="t", start_s=0.0
+        ).to_dict()
+
+
+class TestDisabledIsZeroWork:
+    def test_disabled_request_installs_nothing(self):
+        recorder = FlightRecorder(enabled=False)
+        with recorder.request("op", trace_id="t") as record:
+            assert record is None
+            assert flight.current_record() is None
+            flight.note("tier.backend", 7)  # returns after one TLS read
+            flight.stage("anywhere")
+        assert recorder.requests_seen == 0
+        assert recorder.ring_occupancy == 0
+        assert recorder.failures() == []
+
+    def test_noop_recorder_is_disabled(self):
+        assert NOOP_RECORDER.enabled is False
+
+    def test_module_helpers_without_any_record(self):
+        assert flight.current_trace_id() is None
+        flight.note("x")
+        flight.stage("y")  # both plain no-ops
+
+
+class TestThreadLocality:
+    def test_record_is_invisible_to_other_threads(self):
+        recorder = FlightRecorder(enabled=True)
+        seen_on_worker: list = []
+        with recorder.request("op"):
+            t = threading.Thread(
+                target=lambda: seen_on_worker.append(flight.current_record())
+            )
+            t.start()
+            t.join()
+        assert seen_on_worker == [None]
+
+    def test_bound_reinstalls_across_a_pool_hop(self):
+        recorder = FlightRecorder(enabled=True)
+        with recorder.request("op") as record:
+            captured = flight.current_record()
+
+            def worker():
+                with flight.bound(captured):
+                    flight.note("tier.backend", 4)
+
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+        assert record.counters == {"tier.backend": 4.0}
+
+    def test_bound_none_is_a_noop(self):
+        with flight.bound(None):
+            assert flight.current_record() is None
+
+
+class TestRetentionRings:
+    def _run(self, recorder, clock, name, duration_s, *, fail=False):
+        try:
+            with recorder.request(name, trace_id=f"trace-{name}"):
+                clock.advance(duration_s)
+                if fail:
+                    raise RuntimeError(name)
+        except RuntimeError:
+            pass
+
+    def test_slow_ring_keeps_the_slowest(self):
+        clock = FakeClock()
+        recorder = FlightRecorder(enabled=True, ring_size=3, time_source=clock)
+        for i, duration in enumerate([0.010, 0.050, 0.020, 0.040, 0.030]):
+            self._run(recorder, clock, f"r{i}", duration)
+        names = [r.name for r in recorder.slowest()]
+        assert names == ["r1", "r3", "r4"]  # 50 ms, 40 ms, 30 ms
+        assert recorder.requests_seen == 5
+
+    def test_fast_request_never_evicts_a_slow_one(self):
+        clock = FakeClock()
+        recorder = FlightRecorder(enabled=True, ring_size=2, time_source=clock)
+        self._run(recorder, clock, "slow", 0.5)
+        self._run(recorder, clock, "slower", 0.6)
+        for i in range(10):
+            self._run(recorder, clock, f"fast{i}", 0.001)
+        assert sorted(r.name for r in recorder.slowest()) == ["slow", "slower"]
+        assert recorder.ring_occupancy == 2
+
+    def test_failure_ring_is_bounded_and_recent(self):
+        clock = FakeClock()
+        recorder = FlightRecorder(enabled=True, ring_size=2, time_source=clock)
+        for i in range(5):
+            self._run(recorder, clock, f"f{i}", 0.01, fail=True)
+        assert [r.name for r in recorder.failures()] == ["f3", "f4"]
+        assert recorder.requests_failed == 5
+
+    def test_find_by_trace_id(self):
+        clock = FakeClock()
+        recorder = FlightRecorder(enabled=True, ring_size=4, time_source=clock)
+        self._run(recorder, clock, "a", 0.02)
+        self._run(recorder, clock, "b", 0.03, fail=True)
+        assert recorder.find("trace-a").name == "a"
+        assert recorder.find("trace-b").name == "b"
+        assert recorder.find("trace-zzz") is None
+        assert recorder.find("") is None
+
+    def test_summary_and_dump_shape(self):
+        clock = FakeClock()
+        recorder = FlightRecorder(enabled=True, ring_size=8, time_source=clock)
+        with recorder.request("slowest", trace_id="t-slow"):
+            flight.note("tier.device_hot", 4)
+            clock.advance(0.9)
+        for i in range(4):
+            self._run(recorder, clock, f"r{i}", 0.01)
+        summary = recorder.summary()
+        assert summary["enabled"] is True
+        assert summary["requests_seen"] == 5
+        assert summary["ring_occupancy"] == 5
+        assert len(summary["top_slowest"]) == 3
+        top = summary["top_slowest"][0]
+        assert top["name"] == "slowest" and top["trace_id"] == "t-slow"
+        assert top["tiers"] == {"device_hot": 4.0}
+        dump = recorder.dump(limit=2)
+        assert len(dump["slowest"]) == 2
+        assert dump["slowest"][0]["name"] == "slowest"
+        assert dump["requests_seen"] == 5
+
+    def test_ring_size_validated(self):
+        with pytest.raises(ValueError):
+            FlightRecorder(enabled=True, ring_size=0)
+
+    def test_reset(self):
+        clock = FakeClock()
+        recorder = FlightRecorder(enabled=True, time_source=clock)
+        self._run(recorder, clock, "a", 0.01, fail=True)
+        recorder.reset()
+        assert recorder.requests_seen == 0
+        assert recorder.slowest() == [] and recorder.failures() == []
+
+
+class TestRsmIntegration:
+    def test_traced_fetch_records_backend_tier_and_exemplar(self, tmp_path):
+        """End to end on a real RSM: a cold fetch through the chunk path
+        must produce a record whose backend-tier count is non-zero, and the
+        chunk-fetch histogram must carry that record's trace id as a
+        bucket exemplar (the breach-evidence bridge)."""
+        from tests.test_rsm_lifecycle import (
+            SEGMENT_SIZE,
+            make_rsm,
+            make_segment_data,
+            make_segment_metadata,
+        )
+
+        rsm, _ = make_rsm(tmp_path, compression=False, encryption=False,
+                          extra_configs={
+                              "flight.enabled": True,
+                              "tracing.enabled": True,
+                              "deadline.default.ms": 30_000,
+                          })
+        try:
+            md = make_segment_metadata()
+            rsm.copy_log_segment_data(md, make_segment_data(tmp_path, with_txn=False))
+            recorder = rsm.flight_recorder
+            recorder.reset()
+            with rsm.fetch_log_segment(md, 0) as stream:
+                # Drain INSIDE a request scope like the gateway holds one
+                # over the streamed response; a direct call's _traced record
+                # closes before the lazy stream pulls chunks.
+                with recorder.request("drain", trace_id="drain-trace"):
+                    payload = stream.read()
+            assert len(payload) == SEGMENT_SIZE
+            records = recorder.slowest()
+            assert recorder.requests_seen >= 2  # fetch op + drain
+            drain = next(r for r in records if r.name == "drain")
+            assert drain.tier_breakdown().get("backend", 0) > 0
+            assert drain.counters.get("gcm.windows", 0) == 0  # CPU backend
+            # The exemplar bridge: chunk-fetch histogram buckets carry the
+            # drain record's trace id (recorded while it was ambient).
+            hist = rsm.metrics.histogram("chunk-fetch-time")
+            assert hist is not None and hist.count > 0
+            assert "drain-trace" in {tid for _, tid, _ in hist.exemplars()}
+            # /debug/requests payload resolves the same trace id.
+            assert recorder.find("drain-trace") is drain
+        finally:
+            rsm.close()

@@ -128,7 +128,9 @@ class TestStats:
         # Median sits at the le=10 boundary; p75 interpolates inside (10, 20].
         assert h.quantile(0.5) == 10.0
         assert 10.0 < h.quantile(0.75) <= 20.0
-        assert Histogram().quantile(0.5) == 0.0
+        # Degenerate contract (ISSUE 14): empty histogram -> None, never a
+        # phantom 0.0 the SLO engine could mistake for a real p99.
+        assert Histogram().quantile(0.5) is None
         with pytest.raises(ValueError):
             h.quantile(1.5)
 
@@ -252,3 +254,46 @@ class TestRsmIntegrationMetrics:
         assert v("write-bytes-total", cache="disk-chunk-cache") > 0
         assert v("parallelism", pool="chunk-cache-pool") > 0
         rsm.close()
+
+
+class TestHistogramExemplars:
+    """ISSUE 14: buckets carry the flight-recorder trace id of the latest
+    observation recorded while a request record was ambient."""
+
+    def test_exemplar_attached_per_bucket(self):
+        from tieredstorage_tpu.utils.flightrecorder import FlightRecorder
+
+        recorder = FlightRecorder(enabled=True)
+        h = Histogram(buckets=(10.0, 20.0))
+        with recorder.request("slow", trace_id="t-slow"):
+            h.record(15.0, 0.0)
+        with recorder.request("fast", trace_id="t-fast"):
+            h.record(5.0, 0.0)
+        exemplars = h.exemplars()
+        assert exemplars == [(10.0, "t-fast", 5.0), (20.0, "t-slow", 15.0)]
+
+    def test_latest_observation_wins_the_bucket(self):
+        from tieredstorage_tpu.utils.flightrecorder import FlightRecorder
+
+        recorder = FlightRecorder(enabled=True)
+        h = Histogram(buckets=(10.0,))
+        for trace in ("t1", "t2"):
+            with recorder.request("r", trace_id=trace):
+                h.record(3.0, 0.0)
+        assert h.exemplars() == [(10.0, "t2", 3.0)]
+
+    def test_overflow_bucket_exemplar_reports_inf(self):
+        from tieredstorage_tpu.utils.flightrecorder import FlightRecorder
+
+        recorder = FlightRecorder(enabled=True)
+        h = Histogram(buckets=(10.0,))
+        with recorder.request("r", trace_id="t-over"):
+            h.record(999.0, 0.0)
+        [(bound, trace, value)] = h.exemplars()
+        assert bound == float("inf") and trace == "t-over" and value == 999.0
+
+    def test_no_ambient_record_means_no_exemplar(self):
+        h = Histogram()
+        h.record(5.0, 0.0)
+        assert h.exemplars() == []
+        assert h.count == 1  # the observation itself still lands

@@ -225,3 +225,46 @@ def test_sidecar_serves_metrics_port(tmp_path):
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+
+
+def test_varz_flight_section():
+    """ISSUE 14: /varz grows a `flight` section next to the trace summary —
+    requests seen, slow-ring occupancy, top-3 slowest with tier breakdown."""
+    from tieredstorage_tpu.utils import flightrecorder as flight
+    from tieredstorage_tpu.utils.flightrecorder import FlightRecorder
+
+    tracer = Tracer(enabled=True)
+    recorder = FlightRecorder(enabled=True, ring_size=8)
+    with recorder.request("fetch", trace_id="abc123"):
+        flight.note("tier.backend", 2)
+    exporter = PrometheusExporter(
+        [MetricsRegistry(MetricConfig())], host="127.0.0.1", tracer=tracer,
+        flight_recorder=recorder,
+    ).start()
+    try:
+        base = f"http://127.0.0.1:{exporter.port}"
+        with urllib.request.urlopen(f"{base}/varz", timeout=10) as resp:
+            varz = json.loads(resp.read())
+        section = varz["flight"]
+        assert section["enabled"] is True
+        assert section["requests_seen"] == 1
+        assert section["ring_occupancy"] == 1
+        [top] = section["top_slowest"]
+        assert top["name"] == "fetch" and top["trace_id"] == "abc123"
+        assert top["tiers"] == {"backend": 2.0}
+    finally:
+        exporter.stop()
+
+
+def test_varz_without_flight_recorder_reports_disabled():
+    exporter = PrometheusExporter(
+        [MetricsRegistry(MetricConfig())], host="127.0.0.1"
+    ).start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{exporter.port}/varz", timeout=10
+        ) as resp:
+            varz = json.loads(resp.read())
+        assert varz["flight"] == {"enabled": False}
+    finally:
+        exporter.stop()

@@ -1,0 +1,404 @@
+"""SLO engine suite (ISSUE 14): exact burn-rate/budget math on seeded
+streams, the histogram-latency source against known quantiles, degenerate
+no-data contracts, window-base selection, gauge export, and the RSM wiring.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tieredstorage_tpu.metrics.core import Histogram, MetricsRegistry
+from tieredstorage_tpu.metrics.rsm_metrics import Metrics
+from tieredstorage_tpu.metrics.slo import (
+    SLO_METRIC_GROUP,
+    HistogramLatencySource,
+    RatioSource,
+    SloEngine,
+    SloSpec,
+)
+
+
+class FakeClock:
+    def __init__(self, at: float = 1000.0) -> None:
+        self.at = at
+
+    def __call__(self) -> float:
+        return self.at
+
+    def advance(self, s: float) -> None:
+        self.at += s
+
+
+class Counters:
+    """Mutable cumulative good/total pair driving a RatioSource."""
+
+    def __init__(self) -> None:
+        self.good = 0.0
+        self.total = 0.0
+
+    def source(self) -> RatioSource:
+        return RatioSource(good=lambda: self.good, total=lambda: self.total)
+
+    def add(self, good: float, bad: float) -> None:
+        self.good += good
+        self.total += good + bad
+
+
+def make_engine(counters: Counters, clock: FakeClock, *, objective=0.9,
+                short=60.0, long=600.0) -> SloEngine:
+    return SloEngine(
+        [SloSpec("s", "test spec", objective, counters.source())],
+        short_window_s=short, long_window_s=long, time_source=clock,
+    )
+
+
+class TestBurnRateMath:
+    def test_exact_burn_rate_over_both_windows(self):
+        """Seeded stream with known deltas -> exact burn rates.
+
+        Objective 0.9 => budget 0.1. Long window: 1000 events, 50 bad =>
+        bad rate 0.05 => burn 0.5. Short window: 100 events, 20 bad =>
+        burn 2.0."""
+        clock, counters = FakeClock(), Counters()
+        engine = make_engine(counters, clock)
+        engine.tick()  # t=1000: (0, 0)
+        clock.advance(540.0)
+        counters.add(good=870.0, bad=30.0)  # long-window prefix
+        engine.tick()  # t=1540: (870, 900) -- the short-window base
+        clock.advance(60.0)
+        counters.add(good=80.0, bad=20.0)
+        verdict = engine.evaluate()["specs"]["s"]
+        # Long window (>= 600 s): delta vs t=1000 -> 1000 events, 50 bad.
+        assert verdict["burn_rate_long"] == pytest.approx(0.5)
+        # Short window (>= 60 s): delta vs t=1540 -> 100 events, 20 bad.
+        assert verdict["burn_rate_short"] == pytest.approx(2.0)
+        assert verdict["samples"] == 1000.0
+        assert verdict["compliance"] == pytest.approx(0.95)
+        # Cumulative budget: bad fraction 0.05 of a 0.1 budget -> half left.
+        assert verdict["error_budget_remaining"] == pytest.approx(0.5)
+        assert verdict["ok"] is True
+        assert verdict["burning"] is False  # long burn 0.5 <= 1.0
+
+    def test_burning_requires_both_windows_over_one(self):
+        clock, counters = FakeClock(), Counters()
+        engine = make_engine(counters, clock, objective=0.9)
+        engine.tick()
+        clock.advance(600.0)
+        counters.add(good=60.0, bad=40.0)  # bad rate 0.4 -> burn 4.0 both
+        result = engine.evaluate()
+        verdict = result["specs"]["s"]
+        assert verdict["burn_rate_short"] == pytest.approx(4.0)
+        assert verdict["burn_rate_long"] == pytest.approx(4.0)
+        assert verdict["burning"] is True
+        assert result["burning"] is True
+
+    def test_budget_exhaustion_flips_ok(self):
+        clock, counters = FakeClock(), Counters()
+        engine = make_engine(counters, clock, objective=0.9)
+        counters.add(good=80.0, bad=20.0)  # bad fraction 0.2 > 0.1 budget
+        verdict = engine.evaluate()["specs"]["s"]
+        assert verdict["error_budget_remaining"] == pytest.approx(-1.0)
+        assert verdict["ok"] is False
+        assert engine.evaluate()["ok"] is False
+
+    def test_recovery_clears_short_burn_before_long(self):
+        """The multiwindow point: after the incident stops, the short
+        window clears while the long window still burns."""
+        clock, counters = FakeClock(), Counters()
+        engine = make_engine(counters, clock, short=60.0, long=600.0)
+        engine.tick()
+        clock.advance(500.0)
+        counters.add(good=0.0, bad=100.0)  # the incident
+        engine.tick()
+        clock.advance(100.0)  # quiet recovery: only good events now
+        counters.add(good=100.0, bad=0.0)
+        engine.tick()
+        clock.advance(60.0)
+        counters.add(good=60.0, bad=0.0)
+        verdict = engine.evaluate()["specs"]["s"]
+        assert verdict["burn_rate_short"] == pytest.approx(0.0)
+        assert verdict["burn_rate_long"] > 1.0
+        assert verdict["burning"] is False
+
+
+class TestDegenerateContract:
+    def test_zero_events_is_none_everywhere(self):
+        clock, counters = FakeClock(), Counters()
+        engine = make_engine(counters, clock)
+        verdict = engine.evaluate()["specs"]["s"]
+        assert verdict["samples"] == 0.0
+        assert verdict["compliance"] is None
+        assert verdict["error_budget_remaining"] is None
+        assert verdict["burn_rate_short"] is None
+        assert verdict["burn_rate_long"] is None
+        assert verdict["ok"] is True  # no data is not a breach
+        assert verdict["burning"] is False
+
+    def test_no_events_in_window_is_none_not_zero(self):
+        clock, counters = FakeClock(), Counters()
+        engine = make_engine(counters, clock)
+        counters.add(good=100.0, bad=0.0)
+        engine.tick()
+        clock.advance(700.0)  # silence: no events at all
+        verdict = engine.evaluate()["specs"]["s"]
+        assert verdict["burn_rate_short"] is None
+        assert verdict["burn_rate_long"] is None
+        assert verdict["compliance"] == pytest.approx(1.0)  # cumulative
+
+    def test_single_event_computes_without_phantom_division(self):
+        clock, counters = FakeClock(), Counters()
+        engine = make_engine(counters, clock)
+        engine.tick()
+        clock.advance(600.0)
+        counters.add(good=1.0, bad=0.0)
+        verdict = engine.evaluate()["specs"]["s"]
+        assert verdict["samples"] == 1.0
+        assert verdict["compliance"] == 1.0
+        assert verdict["burn_rate_long"] == 0.0
+        assert verdict["error_budget_remaining"] == 1.0
+
+
+class TestWindowBase:
+    def test_young_history_uses_oldest_past_half_window(self):
+        clock, counters = FakeClock(), Counters()
+        engine = make_engine(counters, clock, short=60.0, long=600.0)
+        engine.tick()
+        clock.advance(40.0)  # > short/2, < short
+        counters.add(good=9.0, bad=1.0)
+        verdict = engine.evaluate()["specs"]["s"]
+        assert verdict["burn_rate_short"] == pytest.approx(1.0)
+        # Long window: 40 s of history < 300 s half-window -> no base.
+        assert verdict["burn_rate_long"] is None
+
+    def test_too_young_history_has_no_burn_rate(self):
+        clock, counters = FakeClock(), Counters()
+        engine = make_engine(counters, clock, short=60.0)
+        engine.tick()
+        clock.advance(10.0)  # < short/2
+        counters.add(good=5.0, bad=5.0)
+        assert engine.evaluate()["specs"]["s"]["burn_rate_short"] is None
+
+    def test_newest_snapshot_at_or_before_cutoff_wins(self):
+        clock, counters = FakeClock(), Counters()
+        engine = make_engine(counters, clock, short=60.0, long=600.0)
+        engine.tick()                       # t=1000 (0, 0)
+        clock.advance(539.0)
+        counters.add(good=500.0, bad=0.0)
+        engine.tick()                       # t=1539 (500, 500)
+        clock.advance(1.0)
+        engine.tick()                       # t=1540 (500, 500) <- short base
+        clock.advance(60.0)
+        counters.add(good=0.0, bad=10.0)
+        verdict = engine.evaluate()["specs"]["s"]
+        # Short delta vs t=1540: 10 events, all bad -> burn 10.0.
+        assert verdict["burn_rate_short"] == pytest.approx(10.0)
+
+
+class TestHistogramLatencySource:
+    def _metrics_with(self, values_ms: list[float]) -> Metrics:
+        metrics = Metrics()
+        for value in values_ms:
+            metrics.record_chunk_fetch(value, 1)
+        return metrics
+
+    def test_threshold_on_bucket_bound_is_exact(self):
+        # Default ladder holds 8.0 and 16.0; 6 of 8 observations <= 8.0.
+        metrics = self._metrics_with([1.0] * 6 + [12.0] * 2)
+        source = HistogramLatencySource(metrics, "chunk-fetch-time", 8.0)
+        good, total = source.counts()
+        assert (good, total) == (6.0, 8.0)
+
+    def test_threshold_inside_bucket_interpolates(self):
+        metrics = self._metrics_with([10.0] * 4)  # bucket (8, 16]
+        source = HistogramLatencySource(metrics, "chunk-fetch-time", 12.0)
+        good, total = source.counts()
+        assert total == 4.0
+        assert good == pytest.approx(4 * (12.0 - 8.0) / (16.0 - 8.0))
+
+    def test_matches_known_quantiles(self):
+        """Seeded stream with a known p90: the source must agree with the
+        histogram's own quantile at the same resolution."""
+        metrics = self._metrics_with([1.0] * 90 + [100.0] * 10)
+        hist = metrics.histogram("chunk-fetch-time")
+        p90 = hist.quantile(0.90)
+        source = HistogramLatencySource(metrics, "chunk-fetch-time", p90)
+        good, total = source.counts()
+        assert good / total == pytest.approx(0.90)
+
+    def test_absent_histogram_is_zero_zero(self):
+        source = HistogramLatencySource(Metrics(), "chunk-fetch-time", 10.0)
+        assert source.counts() == (0.0, 0.0)
+
+    def test_overflow_observations_are_never_good(self):
+        # Threshold beyond the last finite bound: the +Inf bucket must not
+        # count as good (a 10-minute fetch is not "within budget").
+        metrics = Metrics()
+        registry = metrics.registry
+        from tieredstorage_tpu.metrics.core import MetricName
+
+        hist = Histogram(buckets=(10.0, 20.0))
+        registry.register(MetricName.of("x-ms", "g"), hist)
+        hist.record(5.0, 0.0)
+        hist.record(1e9, 0.0)  # overflow
+        source = HistogramLatencySource(metrics, "x", 50.0)
+        good, total = source.counts()
+        assert (good, total) == (1.0, 2.0)
+
+    def test_exemplar_evidence_over_threshold(self):
+        from tieredstorage_tpu.utils.flightrecorder import FlightRecorder
+
+        metrics = Metrics()
+        recorder = FlightRecorder(enabled=True)
+        with recorder.request("slow-one", trace_id="slow-trace"):
+            metrics.record_chunk_fetch(500.0, 1)
+        metrics.record_chunk_fetch(1.0, 1)
+        source = HistogramLatencySource(metrics, "chunk-fetch-time", 8.0)
+        evidence = source.evidence()
+        over = evidence["exemplars_over_threshold"]
+        assert [e["trace_id"] for e in over] == ["slow-trace"]
+        assert over[0]["value_ms"] == 500.0
+
+    def test_threshold_validated(self):
+        with pytest.raises(ValueError):
+            HistogramLatencySource(Metrics(), "chunk-fetch-time", 0.0)
+
+
+class TestSpecAndEngineValidation:
+    def test_objective_must_leave_a_budget(self):
+        source = Counters().source()
+        with pytest.raises(ValueError, match="objective"):
+            SloSpec("s", "d", 1.0, source)
+        with pytest.raises(ValueError, match="objective"):
+            SloSpec("s", "d", 0.0, source)
+
+    def test_duplicate_names_rejected(self):
+        source = Counters().source()
+        with pytest.raises(ValueError, match="duplicate"):
+            SloEngine([SloSpec("s", "d", 0.9, source),
+                       SloSpec("s", "d2", 0.9, source)])
+
+    def test_windows_validated(self):
+        spec = SloSpec("s", "d", 0.9, Counters().source())
+        with pytest.raises(ValueError, match="windows"):
+            SloEngine([spec], short_window_s=600.0, long_window_s=60.0)
+        with pytest.raises(ValueError, match="at least one"):
+            SloEngine([])
+
+
+class TestGauges:
+    def test_gauges_export_verdicts(self):
+        clock, counters = FakeClock(), Counters()
+        engine = make_engine(counters, clock, objective=0.9)
+        registry = MetricsRegistry()
+        engine.register_gauges(registry)
+        names = {
+            (mn.name, dict(mn.tags).get("slo")) for mn in registry.metric_names
+        }
+        assert ("slo-error-budget-remaining", "s") in names
+        assert ("slo-burn-rate-short", "s") in names
+        assert ("slo-burn-rate-long", "s") in names
+        assert ("slo-compliance", "s") in names
+        assert ("slo-ok", "s") in names
+        assert all(mn.group == SLO_METRIC_GROUP for mn in registry.metric_names)
+        # No data: None exports as the -1 sentinel, ok as 1.0.
+        by_name = {mn.name: mn for mn in registry.metric_names}
+        assert registry.value(by_name["slo-compliance"]) == -1.0
+        assert registry.value(by_name["slo-ok"]) == 1.0
+        counters.add(good=50.0, bad=50.0)  # budget blown
+        clock.advance(10.0)  # past the gauge cache age
+        assert registry.value(by_name["slo-ok"]) == 0.0
+        assert registry.value(by_name["slo-compliance"]) == pytest.approx(0.5)
+
+    def test_gauge_reads_share_one_evaluation(self):
+        clock, counters = FakeClock(), Counters()
+        engine = make_engine(counters, clock)
+        registry = MetricsRegistry()
+        engine.register_gauges(registry)
+        for mn in registry.metric_names:
+            registry.value(mn)  # five reads, same clock instant
+        assert engine.evaluations == 1
+
+
+class TestRsmWiring:
+    def test_slo_engine_wired_and_served(self, tmp_path):
+        from tests.test_rsm_lifecycle import (
+            make_rsm,
+            make_segment_data,
+            make_segment_metadata,
+        )
+
+        rsm, _ = make_rsm(tmp_path, compression=False, encryption=False,
+                          extra_configs={
+                              "slo.enabled": True,
+                              "deadline.default.ms": 30_000,
+                              "admission.enabled": True,
+                              "slo.cache.hit.floor.percent": 10,
+                              # The cache-hit spec needs a chunk cache tier.
+                              "fetch.chunk.cache.class":
+                                  "tieredstorage_tpu.fetch.cache.memory."
+                                  "MemoryChunkCache",
+                              "fetch.chunk.cache.size": -1,
+                          })
+        try:
+            engine = rsm.slo_engine
+            assert engine is not None
+            spec_names = {s.name for s in engine.specs}
+            assert spec_names == {
+                "fetch-latency", "fetch-errors", "shed-rate", "cache-hit",
+            }
+            md = make_segment_metadata()
+            rsm.copy_log_segment_data(
+                md, make_segment_data(tmp_path, with_txn=False)
+            )
+            with rsm.fetch_log_segment(md, 0) as stream:
+                stream.read()
+            status = rsm.slo_status()
+            assert status["enabled"] is True
+            latency = status["specs"]["fetch-latency"]
+            assert latency["samples"] > 0  # real histogram data, not config
+            assert latency["ok"] is True
+            # slo-metrics gauges landed in the RSM registry.
+            groups = {mn.group for mn in rsm.metrics.registry.metric_names}
+            assert SLO_METRIC_GROUP in groups
+        finally:
+            rsm.close()
+
+    def test_disabled_engine_raises_for_status(self, tmp_path):
+        from tests.test_rsm_lifecycle import make_rsm
+
+        rsm, _ = make_rsm(tmp_path, compression=False, encryption=False)
+        try:
+            assert rsm.slo_engine is None
+            with pytest.raises(Exception, match="not enabled"):
+                rsm.slo_status()
+        finally:
+            rsm.close()
+
+    def test_window_config_cross_validation(self, tmp_path):
+        from tieredstorage_tpu.config.configdef import ConfigException
+        from tests.test_rsm_lifecycle import make_rsm
+
+        with pytest.raises(ConfigException, match="slo.window"):
+            make_rsm(tmp_path, compression=False, encryption=False,
+                     extra_configs={
+                         "slo.window.short.ms": 600_000,
+                         "slo.window.long.ms": 60_000,
+                     })
+
+
+class TestLatencyQuantileContract:
+    """The ISSUE 14 degenerate-case fix, pinned: None vs 0.0."""
+
+    def test_empty_histogram_quantile_is_none(self):
+        assert Histogram().quantile(0.99) is None
+
+    def test_absent_and_empty_latency_quantile_is_none(self):
+        metrics = Metrics()
+        assert metrics.latency_quantile("chunk-fetch-time", 0.95) is None
+
+    def test_single_sample_quantile_is_usable(self):
+        metrics = Metrics()
+        metrics.record_chunk_fetch(10.0, 1)
+        p99 = metrics.latency_quantile("chunk-fetch-time", 0.99)
+        assert p99 is not None and 8.0 < p99 <= 16.0  # its bucket, not 0.0
+        assert metrics.histogram_count("chunk-fetch-time") == 1

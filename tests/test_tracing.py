@@ -211,3 +211,26 @@ class TestSummaryAndExport:
         assert trip["args"]["trace_id"] == fetch["args"]["trace_id"]
         assert trip["args"]["parent_id"] == fetch["args"]["span_id"]
         assert doc["otherData"]["dropped_spans"] == 0
+
+
+class TestSummaryDegenerateContract:
+    """ISSUE 14: the empty/single-sample contract, pinned."""
+
+    def test_empty_tracer_summary_is_empty_dict(self):
+        assert Tracer(enabled=True).summary() == {}
+        assert Tracer(enabled=False).summary() == {}
+
+    def test_single_span_is_every_percentile_of_itself(self):
+        tracer = Tracer(enabled=True)
+        s = tracer.event("solo")
+        s.end_s = s.start_s + 0.042
+        summary = tracer.summary()["solo"]
+        assert summary["count"] == 1
+        for key in ("avg_s", "max_s", "p50_s", "p95_s", "p99_s"):
+            assert summary[key] == pytest.approx(0.042)
+
+    def test_percentile_of_empty_set_is_a_programming_error(self):
+        from tieredstorage_tpu.utils.tracing import _percentile
+
+        with pytest.raises(ValueError, match="empty"):
+            _percentile([], 0.5)

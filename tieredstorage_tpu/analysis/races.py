@@ -82,6 +82,16 @@ SHARED_CLASSES = {
     "tieredstorage_tpu/fetch/cache/device_hot.py:FrequencySketch":
         "the hot tier's admission sketch, touched from every thread the "
         "tier itself is (count-min rows + decay op counter)",
+    "tieredstorage_tpu/utils/flightrecorder.py:FlightRecorder":
+        "one recorder per RSM, archiving records from every gateway "
+        "worker and RSM operation thread (retention rings + counters)",
+    "tieredstorage_tpu/metrics/slo.py:SloEngine":
+        "one engine per RSM, ticked by every metrics scrape (gauge reads "
+        "on exporter threads) and every GET /slo gateway worker",
+    "tieredstorage_tpu/fleet/telemetry.py:FleetTelemetry":
+        "one aggregator per fleet member, scraped concurrently by "
+        "gateway workers serving GET /fleet/telemetry (client cache + "
+        "scrape counters)",
 }
 
 #: Executor dispatch method names whose first argument runs on a pool thread.

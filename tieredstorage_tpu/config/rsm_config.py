@@ -509,6 +509,85 @@ def _base_def() -> ConfigDef:
             "upload window (ops/crc32c).",
     ))
     d.define(ConfigKey(
+        "flight.enabled", "bool", default=False, importance="medium",
+        doc="Arm the per-request flight recorder (utils/flightrecorder.py): "
+            "every RSM operation and gateway request records its cache-tier "
+            "outcomes (chunk cache / device hot tier / fleet peer / "
+            "backend), hedge and replica-failover activity, GCM window "
+            "accounting, and the deadline budget remaining at each stage; "
+            "the slowest and failed requests are retained in a bounded "
+            "ring served by GET /debug/requests and summarized on /varz, "
+            "and latency histograms attach the records' trace ids as "
+            "bucket exemplars. Disabled is zero-work.",
+    ))
+    d.define(ConfigKey(
+        "flight.ring.size", "int", default=64,
+        validator=in_range(1, 4096), importance="low",
+        doc="Requests retained by the flight recorder: the N slowest "
+            "completed requests (a fast request never evicts a slow one) "
+            "plus the N most recent failed ones.",
+    ))
+    d.define(ConfigKey(
+        "slo.enabled", "bool", default=False, importance="medium",
+        doc="Run the SLO engine (metrics/slo.py): declarative objectives "
+            "over the existing latency histograms and counters (fetch "
+            "latency vs the deadline budget, fetch error rate, admission "
+            "shed rate, chunk-cache hit floor) with SRE-workbook two-window "
+            "burn-rate computation, error-budget gauges in the slo-metrics "
+            "group, and verdicts on the gateway's GET /slo route.",
+    ))
+    d.define(ConfigKey(
+        "slo.window.short.ms", "long", default=60_000,
+        validator=in_range(1, None), importance="low",
+        doc="Short burn-rate window: the fast-to-clear half of the "
+            "multiwindow alert (an incident that stops burning stops "
+            "alerting within this window).",
+    ))
+    d.define(ConfigKey(
+        "slo.window.long.ms", "long", default=600_000,
+        validator=in_range(1, None), importance="low",
+        doc="Long burn-rate window: the significance half of the "
+            "multiwindow alert. Must be greater than slo.window.short.ms.",
+    ))
+    d.define(ConfigKey(
+        "slo.fetch.latency.threshold.ms", "long", default=None,
+        validator=null_or(in_range(1, None)), importance="medium",
+        doc="Latency an individual chunk fetch must beat to count as a "
+            "good event for the fetch-latency SLO. Null derives it from "
+            "deadline.default.ms (the budget the caller actually "
+            "experiences); if both are null the fetch-latency spec is "
+            "skipped.",
+    ))
+    d.define(ConfigKey(
+        "slo.fetch.latency.objective.percent", "int", default=99,
+        validator=in_range(1, 99), importance="medium",
+        doc="Fraction of chunk fetches (percent) that must beat the "
+            "latency threshold: 99 gates the p99 against the budget. "
+            "Capped at 99 because a 100% objective leaves a zero error "
+            "budget no finite burn rate can be computed against.",
+    ))
+    d.define(ConfigKey(
+        "slo.error.rate.objective.percent", "int", default=99,
+        validator=in_range(1, 99), importance="medium",
+        doc="Fraction of chunk fetches (percent) that must complete "
+            "without a request-visible failure (detransform corruption or "
+            "deadline expiry).",
+    ))
+    d.define(ConfigKey(
+        "slo.shed.rate.max.percent", "int", default=5,
+        validator=in_range(1, 99), importance="low",
+        doc="Admission sheds tolerated as a percentage of gated requests "
+            "(the shed-rate SLO objective is 100 minus this). Only wired "
+            "when admission.enabled is.",
+    ))
+    d.define(ConfigKey(
+        "slo.cache.hit.floor.percent", "int", default=0,
+        validator=in_range(0, 99), importance="low",
+        doc="Minimum chunk-cache hit rate (percent) the cache-tier SLO "
+            "enforces; 0 disables the spec (cold stores legitimately run "
+            "at 0% for a while).",
+    ))
+    d.define(ConfigKey(
         "metrics.num.samples", "int", default=2, validator=in_range(1, None), importance="low",
         doc="Number of samples for metrics computation.",
     ))
@@ -544,6 +623,11 @@ class RemoteStorageManagerConfig:
         if self._values["fleet.gossip.enabled"] and not self._values["fleet.enabled"]:
             raise ConfigException(
                 "fleet.enabled must be enabled if fleet.gossip.enabled is"
+            )
+        if self._values["slo.window.short.ms"] >= self._values["slo.window.long.ms"]:
+            raise ConfigException(
+                "slo.window.short.ms must be less than slo.window.long.ms "
+                "(the multiwindow burn-rate alert needs distinct windows)"
             )
         if self.encryption_enabled:
             if not self._values["encryption.key.pair.id"]:
@@ -831,6 +915,46 @@ class RemoteStorageManagerConfig:
     @property
     def scrub_checksums_enabled(self) -> bool:
         return self._values["scrub.checksums.enabled"]
+
+    @property
+    def flight_enabled(self) -> bool:
+        return self._values["flight.enabled"]
+
+    @property
+    def flight_ring_size(self) -> int:
+        return self._values["flight.ring.size"]
+
+    @property
+    def slo_enabled(self) -> bool:
+        return self._values["slo.enabled"]
+
+    @property
+    def slo_window_short_ms(self) -> int:
+        return self._values["slo.window.short.ms"]
+
+    @property
+    def slo_window_long_ms(self) -> int:
+        return self._values["slo.window.long.ms"]
+
+    @property
+    def slo_fetch_latency_threshold_ms(self) -> Optional[int]:
+        return self._values["slo.fetch.latency.threshold.ms"]
+
+    @property
+    def slo_fetch_latency_objective_percent(self) -> int:
+        return self._values["slo.fetch.latency.objective.percent"]
+
+    @property
+    def slo_error_rate_objective_percent(self) -> int:
+        return self._values["slo.error.rate.objective.percent"]
+
+    @property
+    def slo_shed_rate_max_percent(self) -> int:
+        return self._values["slo.shed.rate.max.percent"]
+
+    @property
+    def slo_cache_hit_floor_percent(self) -> int:
+        return self._values["slo.cache.hit.floor.percent"]
 
     @property
     def metrics_num_samples(self) -> int:

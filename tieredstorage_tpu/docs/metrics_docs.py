@@ -134,6 +134,19 @@ def _collect_scrub() -> dict[str, list[str]]:
     return _group_names(registry)
 
 
+def _collect_slo() -> dict[str, list[str]]:
+    from tieredstorage_tpu.metrics.core import MetricsRegistry
+    from tieredstorage_tpu.metrics.slo import RatioSource, SloEngine, SloSpec
+
+    registry = MetricsRegistry()
+    engine = SloEngine([SloSpec(
+        "docs", "docs throwaway", 0.99,
+        RatioSource(good=lambda: 0.0, total=lambda: 0.0),
+    )])
+    engine.register_gauges(registry)
+    return _group_names(registry)
+
+
 def _collect_caches() -> dict[str, list[str]]:
     from tieredstorage_tpu.metrics.cache_metrics import (
         DiskCacheMetrics,
@@ -234,6 +247,7 @@ def generate() -> str:
         ("Replication metrics", _collect_replication()),
         ("Fleet metrics", _collect_fleet()),
         ("Scrubber metrics", _collect_scrub()),
+        ("SLO metrics", _collect_slo()),
         ("Tracer metrics", _collect_tracer()),
         ("Storage backend client metrics", _collect_backends()),
     ]:

@@ -28,6 +28,7 @@ from tieredstorage_tpu.config.cache_config import ChunkCacheConfig
 from tieredstorage_tpu.fetch.chunk_manager import ChunkManager
 from tieredstorage_tpu.manifest.segment_manifest import SegmentManifestV1
 from tieredstorage_tpu.storage.core import ObjectKey
+from tieredstorage_tpu.utils import flightrecorder as flight
 from tieredstorage_tpu.utils.caching import LoadingCache, RemovalCause
 from tieredstorage_tpu.utils.deadline import check_deadline, remaining_s
 from tieredstorage_tpu.utils.locks import new_lock, new_unguarded
@@ -237,6 +238,7 @@ class ChunkCache(ChunkManager, Generic[T], abc.ABC):
             # the affected chunks straight from the delegate, without
             # re-caching — going through the cache again would just re-race
             # with its own evictions (or re-hit the broken disk).
+            flight.note("cache.fallback", len(fallback))
             refetched = self._delegate.get_chunks(objects_key, manifest, fallback)
             out.update(zip(fallback, refetched))
         return [out[cid] for cid in chunk_ids]
@@ -288,26 +290,38 @@ class ChunkCache(ChunkManager, Generic[T], abc.ABC):
                 self._cache.get_if_present(key)  # hit + recency
             else:
                 missing.append(cid)
+        if len(chunk_ids) > len(missing):
+            flight.note("tier.chunk_cache", len(chunk_ids) - len(missing))
         own: list[int] = []
         if missing:
             with self._inflight_lock:
                 for cid in missing:
                     key = ChunkKey.of(objects_key, cid)
-                    flight = self._inflight.get(key)
-                    if flight is not None:
-                        futures[cid] = ("bytes", flight)
+                    in_flight = self._inflight.get(key)
+                    if in_flight is not None:
+                        futures[cid] = ("bytes", in_flight)
                         self.inflight_joins += 1
                     else:
                         self._inflight[key] = concurrent.futures.Future()
                         own.append(cid)
+        joined = len(missing) - len(own)
+        if joined:
+            flight.note("tier.inflight_join", joined)
         if own:
             if deadline is None:
                 futures.update(
                     self._load_owned(objects_key, manifest, own)
                 )
             else:
+                # The pool worker loads on behalf of THIS request: re-bind
+                # its flight record across the hop (the request thread
+                # blocks right below) so the lower tiers' outcomes land on
+                # it. The prefetch branch (deadline=None, already on a pool
+                # worker) deliberately carries no record — it outlives the
+                # request that triggered it.
+                record = flight.current_record()
                 task = self._executor.submit(
-                    self._load_owned, objects_key, manifest, own
+                    self._load_owned_bound, record, objects_key, manifest, own
                 )
                 try:
                     futures.update(
@@ -318,6 +332,10 @@ class ChunkCache(ChunkManager, Generic[T], abc.ABC):
                         f"Fetching chunks {own} of {objects_key} timed out"
                     ) from None
         return futures
+
+    def _load_owned_bound(self, record, objects_key, manifest, own):
+        with flight.bound(record):
+            return self._load_owned(objects_key, manifest, own)
 
     def _load_owned(
         self, objects_key: ObjectKey, manifest: SegmentManifestV1, own: list[int]

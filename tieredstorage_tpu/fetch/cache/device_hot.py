@@ -54,6 +54,7 @@ from typing import Any, BinaryIO, Optional, Sequence
 import numpy as np
 
 from tieredstorage_tpu.fetch.chunk_manager import ChunkManager
+from tieredstorage_tpu.utils import flightrecorder as flightrec
 from tieredstorage_tpu.utils.locks import new_lock, note_mutation
 from tieredstorage_tpu.utils.tracing import NOOP_TRACER
 
@@ -371,6 +372,7 @@ class DeviceHotCache(ChunkManager):
             self.tracer.event(
                 "hot.hit", key=objects_key.value, chunks=len(chunk_ids)
             )
+            flightrec.note("tier.device_hot", len(chunk_ids))
             return served
         with capture_scope() as captured:
             chunks = self._delegate.get_chunks(objects_key, manifest, list(chunk_ids))

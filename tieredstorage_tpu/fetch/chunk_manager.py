@@ -23,6 +23,7 @@ from tieredstorage_tpu.storage.core import (
     ObjectKey,
     StorageBackendException,
 )
+from tieredstorage_tpu.utils import flightrecorder as flight
 from tieredstorage_tpu.utils.locks import new_lock
 from tieredstorage_tpu.transform.api import DetransformOptions, TransformBackend
 from tieredstorage_tpu.utils.deadline import check_deadline
@@ -159,9 +160,19 @@ class DefaultChunkManager(ChunkManager):
             stored_bytes = sum(len(b) for b in stored)
             if fetch_span is not None:
                 fetch_span.attributes["bytes"] = stored_bytes
+        # Flight-record the backend serve: this window's chunks came from
+        # remote storage (every tier above missed), with the deadline budget
+        # left after the ranged GET.
+        flight.note("tier.backend", len(chunk_ids))
+        flight.stage(f"backend.fetched:{objects_key.value.rsplit('/', 1)[-1]}")
         opts = DetransformOptions.from_manifest(manifest)
         if self.on_detransform is not None:
             self.on_detransform(opts)
+        # GCM window accounting for the record: the TPU backend exposes its
+        # per-thread dispatch/HBM-round-trip counters (CPU backends don't —
+        # duck-typed, zero coupling).
+        thread_counters = getattr(self._backend, "thread_dispatch_counters", None)
+        counters_before = thread_counters() if thread_counters is not None else None
         try:
             with self.tracer.span(
                 "chunk.detransform", chunks=len(stored), bytes_in=stored_bytes,
@@ -180,6 +191,14 @@ class DefaultChunkManager(ChunkManager):
             raise CorruptChunkException(
                 f"Detransform failed for chunks {list(chunk_ids)} of {objects_key}"
             ) from e
+        if counters_before is not None:
+            dispatches, roundtrips = (
+                a - b for a, b in zip(thread_counters(), counters_before)
+            )
+            flight.note("gcm.windows")
+            flight.note("gcm.dispatches", dispatches)
+            flight.note("gcm.hbm_roundtrips", roundtrips)
+        flight.stage("backend.detransformed")
         if self.on_fetch is not None:
             self.on_fetch(
                 (time.monotonic() - start) * 1000.0, sum(len(b) for b in out)

@@ -33,6 +33,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Optional, TypeVar
 
+from tieredstorage_tpu.utils import flightrecorder as flight
 from tieredstorage_tpu.utils.deadline import current_deadline, deadline_scope
 from tieredstorage_tpu.utils.tracing import NOOP_TRACER
 from tieredstorage_tpu.utils.locks import new_lock
@@ -153,9 +154,13 @@ class Hedger:
             with self._lock:
                 self.suppressed += 1
             self.tracer.event("fetch.hedge_suppressed", what=what)
+            flight.note("hedge.suppressed")
             return primary.result()
         with self._lock:
             self.launched += 1
+        # call() runs on the request's (record-bound) thread; only the
+        # attempts ride the pool, so the notes land on the right record.
+        flight.note("hedge.launched")
         distinct = hedge_fn is not None
         self.tracer.event("fetch.hedged", what=what, distinct_replica=distinct)
         hedge = self._pool.submit(run, hedge_fn) if distinct else self._pool.submit(run)
@@ -181,6 +186,7 @@ class Hedger:
                         self.wins += 1
                     elapsed_ms = (time.monotonic() - start) * 1000.0
                     self.tracer.event("fetch.hedge_won", what=what)
+                    flight.note("hedge.won")
                     if self.on_win is not None:
                         self.on_win(elapsed_ms)
                 return result

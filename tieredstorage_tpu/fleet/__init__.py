@@ -11,7 +11,10 @@ forwarded — to exactly one backend read. Each key has R replica owners
 instance death loses no cache tier, and ``fleet/gossip.py`` runs SWIM-style
 gossip membership (probe → suspect → dead, epoch-numbered views) so the
 fleet self-organizes through joins, failures, and rolling restarts.
-``fleet/metrics.py`` exports the ``fleet-metrics`` group. See docs/fleet.rst.
+``fleet/metrics.py`` exports the ``fleet-metrics`` group, and
+``fleet/telemetry.py`` aggregates every member's metric samples into one
+fleet-wide scrape (sum/max/histogram-merge semantics per stat) over the
+gateway's ``GET /fleet/telemetry`` route. See docs/fleet.rst.
 """
 
 from tieredstorage_tpu.fleet.gossip import GossipAgent
@@ -27,15 +30,23 @@ from tieredstorage_tpu.fleet.peer_cache import (
 )
 from tieredstorage_tpu.fleet.ring import FleetRouter, HashRing, parse_instances
 from tieredstorage_tpu.fleet.singleflight import SingleFlight
+from tieredstorage_tpu.fleet.telemetry import (
+    FleetTelemetry,
+    export_samples,
+    merge_samples,
+)
 
 __all__ = [
     "FLEET_METRIC_GROUP",
     "FleetMetrics",
     "FleetRouter",
+    "FleetTelemetry",
     "GossipAgent",
     "HashRing",
     "PeerChunkCache",
     "SingleFlight",
+    "export_samples",
+    "merge_samples",
     "decode_chunk_frames",
     "encode_chunk_frames",
     "parse_instances",

@@ -40,6 +40,7 @@ from tieredstorage_tpu.fleet.singleflight import SingleFlight
 from tieredstorage_tpu.manifest.segment_manifest import SegmentManifestV1
 from tieredstorage_tpu.storage.core import ObjectKey
 from tieredstorage_tpu.storage.httpclient import HttpClient, HttpError, NO_RETRY
+from tieredstorage_tpu.utils import flightrecorder as flight
 from tieredstorage_tpu.utils.deadline import DEADLINE_HEADER, current_deadline
 from tieredstorage_tpu.utils.tracing import TRACEPARENT_HEADER, NOOP_TRACER
 from tieredstorage_tpu.utils.locks import new_lock, note_mutation
@@ -307,6 +308,10 @@ class PeerChunkCache(ChunkManager):
                 if rank > 0:
                     self.failover_hits += 1
                     note_mutation("peer_cache.PeerChunkCache.failover_hits")
+            # Flight-record the peer serve (and how many owner hops it took).
+            flight.note("tier.peer", len(chunks))
+            if rank > 0:
+                flight.note("peer.failover_hops", rank)
             if self.on_forward is not None:
                 self.on_forward(elapsed_ms)
             self.tracer.event(

@@ -1,0 +1,308 @@
+"""Fleet-wide telemetry: one scrape over the whole gossip membership view.
+
+A 3-instance fleet (fleet/ring.py + fleet/gossip.py) exports three separate
+metric registries; operators (and the load harness) need the FLEET answer:
+total backend fetches, total peer hits, the worst breaker state anywhere.
+This module aggregates every member's metric samples — fetched over the
+shim-wire gateway's ``GET /fleet/telemetry`` route, membership taken from
+the live routing view — into one fleet-wide scrape with explicit per-stat
+merge semantics:
+
+- **histogram-merge**: per-bound cumulative bucket counts, ``sum`` and
+  ``count`` are summed across members (all histograms share the log-scale
+  ladder of metrics/core.py, so bounds line up by construction; a member
+  with a foreign ladder contributes its buckets under their own bounds);
+- **max**: names ending ``-state``/``-max`` (worst breaker state anywhere
+  IS the fleet's breaker state; the fleet max latency is the max of maxes);
+- **min**: names ending ``-min``;
+- **sum** (default): totals, rates, gauges of countable things — sharded
+  instances partition the work, so the fleet value is the sum of parts.
+
+The local member never scrapes itself over HTTP (its registries are read
+in-process), unreachable members are reported as such rather than failing
+the scrape (telemetry must degrade, not gate availability), and the
+gossip/ping counters every member already serves are folded in as the
+``fleet-ping`` pseudo-group so failover and forward totals appear in the
+same view.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Callable, Iterable, Mapping, Optional
+
+from tieredstorage_tpu.metrics.core import Histogram, MetricsRegistry
+from tieredstorage_tpu.utils.locks import new_lock, note_mutation
+
+#: Merge rules by metric-name suffix; first match wins, default is "sum".
+_SUFFIX_AGGREGATIONS: tuple[tuple[str, str], ...] = (
+    ("-state", "max"),
+    ("-max", "max"),
+    ("-min", "min"),
+)
+
+
+def aggregation_of(name: str) -> str:
+    """The merge semantic for a (non-histogram) stat name."""
+    for suffix, agg in _SUFFIX_AGGREGATIONS:
+        if name.endswith(suffix):
+            return agg
+    return "sum"
+
+
+def _le_repr(bound: float) -> str:
+    return "+Inf" if math.isinf(bound) else f"{bound:g}"
+
+
+def export_samples(registries: Iterable[MetricsRegistry]) -> list[dict]:
+    """One member's registries as JSON-safe samples (the
+    ``GET /fleet/telemetry`` payload body). A failing supplier gauge must
+    not fail the scrape; skipped gauges are counted VISIBLY as the
+    ``telemetry-skipped-gauges-total`` sample (swallowed-exception
+    checker: the failure has a metric, not silence)."""
+    samples: list[dict] = []
+    seen: set[str] = set()
+    skipped_gauges = 0
+    for registry in registries:
+        for metric_name in registry.metric_names:
+            try:
+                stat = registry.stat(metric_name)
+            except KeyError:
+                continue  # unregistered between listing and read
+            key = str(metric_name)
+            if key in seen:
+                continue  # identical series in another registry
+            seen.add(key)
+            base = {
+                "group": metric_name.group,
+                "name": metric_name.name,
+                "tags": dict(metric_name.tags),
+            }
+            if isinstance(stat, Histogram):
+                samples.append({
+                    **base,
+                    "kind": "histogram",
+                    "buckets": [
+                        [_le_repr(bound), count]
+                        for bound, count in stat.buckets()
+                    ],
+                    "sum": stat.sum,
+                    "count": stat.count,
+                })
+                continue
+            try:
+                value = float(registry.value(metric_name))
+            except Exception:
+                skipped_gauges += 1
+                continue
+            samples.append({**base, "kind": "value", "value": value})
+    if skipped_gauges:
+        samples.append({
+            "group": "fleet-telemetry", "name": "telemetry-skipped-gauges-total",
+            "tags": {}, "kind": "value", "value": float(skipped_gauges),
+        })
+    return samples
+
+
+def _series_key(sample: Mapping) -> str:
+    tags = ",".join(f"{k}={v}" for k, v in sorted(sample["tags"].items()))
+    return f"{sample['group']}:{sample['name']}" + (f"{{{tags}}}" if tags else "")
+
+
+def merge_samples(member_samples: Mapping[str, list[dict]]) -> dict[str, dict]:
+    """Merge ``{member: [samples]}`` into ``{series key: merged stat}``.
+
+    Each merged entry records its ``aggregation`` and the ``members`` that
+    contributed, so a dashboard (or a test) can audit which semantic
+    produced every number."""
+    merged: dict[str, dict] = {}
+    for member in sorted(member_samples):
+        for sample in member_samples[member]:
+            key = _series_key(sample)
+            if sample["kind"] == "histogram":
+                entry = merged.setdefault(key, {
+                    "kind": "histogram",
+                    "aggregation": "histogram-merge",
+                    "buckets": {},
+                    "sum": 0.0,
+                    "count": 0,
+                    "members": [],
+                })
+                if entry["kind"] != "histogram":
+                    continue  # kind clash: first kind wins, audit via members
+                buckets = entry["buckets"]
+                for le, count in sample["buckets"]:
+                    buckets[le] = buckets.get(le, 0) + count
+                entry["sum"] += sample["sum"]
+                entry["count"] += sample["count"]
+                entry["members"].append(member)
+                continue
+            agg = aggregation_of(sample["name"])
+            entry = merged.setdefault(key, {
+                "kind": "value",
+                "aggregation": agg,
+                "value": None,
+                "members": [],
+            })
+            if entry["kind"] != "value":
+                continue
+            value = sample["value"]
+            if entry["value"] is None:
+                entry["value"] = value
+            elif agg == "max":
+                entry["value"] = max(entry["value"], value)
+            elif agg == "min":
+                entry["value"] = min(entry["value"], value)
+            else:
+                entry["value"] += value
+            entry["members"].append(member)
+    return merged
+
+
+class FleetTelemetry:
+    """Aggregates the membership view's telemetry into one fleet scrape.
+
+    ``router`` supplies the live membership (name -> gateway base URL;
+    None = this instance / address unknown). ``transport(url)`` fetches a
+    peer's ``GET /fleet/telemetry`` payload and exists as a seam for tests;
+    the default uses the bounded-pool HTTP client with a single attempt —
+    telemetry is an observer, a struggling peer must not absorb retries."""
+
+    def __init__(
+        self,
+        registries: Iterable[MetricsRegistry],
+        *,
+        instance_id: str = "local",
+        router=None,
+        ping: Optional[Callable[[], dict]] = None,
+        transport: Optional[Callable[[str], dict]] = None,
+        timeout_s: float = 2.0,
+        time_source: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self._registries = list(registries)
+        self.instance_id = instance_id
+        self._router = router
+        self._ping = ping
+        self._transport = transport
+        self.timeout_s = timeout_s
+        self._now = time_source
+        self._lock = new_lock("telemetry.FleetTelemetry._lock")
+        self._clients: dict[str, object] = {}
+        #: Fleet scrapes served (exported in the scrape payload itself).
+        self.scrapes = 0
+        self.peer_scrape_failures = 0
+
+    def close(self) -> None:
+        with self._lock:
+            clients = list(self._clients.values())
+            self._clients.clear()
+        for client in clients:
+            client.close()
+
+    # ---------------------------------------------------------------- local
+    def local_payload(self) -> dict:
+        """This member's contribution (served on GET /fleet/telemetry)."""
+        samples = export_samples(self._registries)
+        if self._ping is not None:
+            try:
+                ping = self._ping()
+            except Exception:
+                ping = {}
+            samples.extend(self._ping_samples(ping))
+        return {"instance": self.instance_id, "samples": samples}
+
+    @staticmethod
+    def _ping_samples(ping: Mapping) -> list[dict]:
+        """Flatten the numeric /fleet/ping counters (peer-cache forwards,
+        failover hits, gossip periods) into the ``fleet-ping`` pseudo-group
+        so they merge like any other stat."""
+        out: list[dict] = []
+
+        def emit(name: str, value) -> None:
+            if isinstance(value, bool) or not isinstance(value, (int, float)):
+                return
+            out.append({
+                "group": "fleet-ping", "name": name, "tags": {},
+                "kind": "value", "value": float(value),
+            })
+
+        for name, value in ping.items():
+            if isinstance(value, Mapping):
+                if name in ("peer_cache",):
+                    for sub, sub_value in value.items():
+                        emit(f"{name}-{sub.replace('_', '-')}-total", sub_value)
+            else:
+                emit(name.replace("_", "-"), value)
+        return out
+
+    # ---------------------------------------------------------------- fleet
+    def _members(self) -> dict[str, Optional[str]]:
+        if self._router is None:
+            return {self.instance_id: None}
+        return dict(self._router.peers)
+
+    def _fetch_peer(self, url: str) -> dict:
+        if self._transport is not None:
+            return self._transport(url)
+        import json
+
+        from tieredstorage_tpu.storage.httpclient import NO_RETRY, HttpClient
+
+        with self._lock:
+            client = self._clients.get(url)
+            if client is None:
+                client = HttpClient(url, timeout=self.timeout_s, retry=NO_RETRY)
+                self._clients[url] = client
+        resp = client.request("GET", "/fleet/telemetry")
+        if resp.status != 200:
+            raise RuntimeError(f"peer telemetry returned {resp.status}")
+        payload = json.loads(resp.body)
+        if not isinstance(payload, dict) or "samples" not in payload:
+            raise RuntimeError("peer telemetry payload malformed")
+        return payload
+
+    def scrape(self) -> dict:
+        """One fleet-wide scrape: local registries in-process, every other
+        member over its gateway, merged with the per-stat semantics above.
+        Unreachable members degrade to ``reachable: false`` entries."""
+        members = self._members()
+        per_member: dict[str, list[dict]] = {}
+        status: dict[str, dict] = {}
+        for name, url in sorted(members.items()):
+            if name == self.instance_id or url is None:
+                payload = self.local_payload()
+                per_member[name] = payload["samples"]
+                status[name] = {
+                    "reachable": True, "local": True,
+                    "samples": len(payload["samples"]),
+                }
+                continue
+            try:
+                payload = self._fetch_peer(url)
+            except Exception as e:  # noqa: BLE001 — degrade, never gate
+                with self._lock:
+                    self.peer_scrape_failures += 1
+                    note_mutation(
+                        "telemetry.FleetTelemetry.peer_scrape_failures"
+                    )
+                status[name] = {
+                    "reachable": False, "local": False,
+                    "error": f"{type(e).__name__}: {e}",
+                }
+                continue
+            per_member[name] = payload.get("samples", [])
+            status[name] = {
+                "reachable": True, "local": False,
+                "samples": len(per_member[name]),
+            }
+        with self._lock:
+            self.scrapes += 1
+            note_mutation("telemetry.FleetTelemetry.scrapes")
+            scrapes = self.scrapes
+        return {
+            "instance": self.instance_id,
+            "scrapes": scrapes,
+            "members": status,
+            "fleet": merge_samples(per_member),
+        }

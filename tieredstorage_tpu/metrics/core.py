@@ -17,6 +17,7 @@ import bisect
 import time
 from dataclasses import dataclass
 from typing import Callable, Mapping, Optional, Sequence
+from tieredstorage_tpu.utils import flightrecorder
 from tieredstorage_tpu.utils.locks import new_lock
 
 
@@ -100,14 +101,24 @@ class Histogram(Stat):
         self._counts = [0] * (len(self._bounds) + 1)
         self._sum = 0.0
         self._count = 0
+        #: bucket index -> (trace_id, value): the LATEST observation per
+        #: bucket that was recorded while a flight-recorder request was
+        #: ambient (utils/flightrecorder.py). An exemplar ties a bucket to
+        #: one concrete request whose full per-tier evidence the recorder
+        #: retained — the bridge from "the p99 bucket is filling" to "THIS
+        #: request filled it".
+        self._exemplars: dict[int, tuple[str, float]] = {}
         self._lock = new_lock("core.Histogram._lock")
 
     def record(self, value: float, now: float) -> None:
         idx = bisect.bisect_left(self._bounds, value)
+        trace_id = flightrecorder.current_trace_id()
         with self._lock:
             self._counts[idx] += 1
             self._sum += value
             self._count += 1
+            if trace_id is not None:
+                self._exemplars[idx] = (trace_id, value)
 
     def measure(self, config: MetricConfig, now: float) -> float:
         """Snapshot value: total observation count (the `_count` series)."""
@@ -135,16 +146,33 @@ class Histogram(Stat):
         out.append((float("inf"), running + counts[-1]))
         return out
 
-    def quantile(self, q: float) -> float:
-        """Bucket-interpolated quantile estimate (0 when empty). The answer is
-        exact only up to bucket resolution — the same contract as a
-        `histogram_quantile` over the exported series."""
+    def exemplars(self) -> list[tuple[float, str, float]]:
+        """(bucket upper bound, trace_id, observed value) triples for every
+        bucket holding an exemplar, ascending by bound. The trace ids key
+        into the flight recorder's retained records, so a hot bucket
+        resolves to a concrete request's tier breakdown."""
+        bounds = (*self._bounds, float("inf"))
+        with self._lock:
+            items = sorted(self._exemplars.items())
+        return [(bounds[idx], tid, value) for idx, (tid, value) in items]
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Bucket-interpolated quantile estimate, exact only up to bucket
+        resolution — the same contract as a `histogram_quantile` over the
+        exported series.
+
+        Degenerate-case contract (ISSUE 14): an EMPTY histogram returns
+        ``None``, never 0.0 — "no observations yet" must stay
+        distinguishable from "the p99 is genuinely zero milliseconds" so
+        the SLO engine never treats a phantom sample count as evidence.
+        A single-observation histogram returns that observation's bucket
+        position for every q (one sample IS every quantile of itself)."""
         if not 0.0 <= q <= 1.0:
             raise ValueError(f"quantile must be in [0, 1], got {q}")
         cumulative = self.buckets()
         total = cumulative[-1][1]
         if total == 0:
-            return 0.0
+            return None
         rank = q * total
         prev_bound, prev_count = 0.0, 0
         for bound, count in cumulative:

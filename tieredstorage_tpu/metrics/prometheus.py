@@ -133,10 +133,12 @@ def render(registries: Iterable[MetricsRegistry]) -> str:
 
 class PrometheusExporter:
     """Serves /metrics, /healthz, and /varz for one or more registries on
-    127.0.0.1:<port>; pass `tracer` to surface its latency summary on /varz."""
+    127.0.0.1:<port>; pass `tracer` to surface its latency summary on /varz
+    and `flight_recorder` for the flight section (requests seen, slow-ring
+    occupancy, top-3 slowest with tier breakdown) next to it."""
 
     def __init__(self, registries: Iterable[MetricsRegistry], *, port: int = 0,
-                 host: str = "127.0.0.1", tracer=None):
+                 host: str = "127.0.0.1", tracer=None, flight_recorder=None):
         regs = list(registries)
         outer = self
 
@@ -171,6 +173,7 @@ class PrometheusExporter:
 
         self.registries = regs
         self.tracer = tracer
+        self.flight_recorder = flight_recorder
         self._server = ThreadingHTTPServer((host, port), Handler)
         self.port = self._server.server_address[1]
         self._thread = threading.Thread(
@@ -179,16 +182,25 @@ class PrometheusExporter:
 
     def varz(self) -> dict:
         """Trace summary payload: per-span-name latency percentiles plus the
-        recorder's ring-buffer state (empty when no tracer is wired)."""
+        recorder's ring-buffer state (empty when no tracer is wired), and —
+        when a flight recorder is wired — its `flight` section: requests
+        seen/failed, slow-ring occupancy, and the top-3 slowest requests
+        with their cache-tier breakdowns (utils/flightrecorder.py)."""
         tracer = self.tracer
         if tracer is None:
-            return {"tracing": False}
-        return {
-            "tracing": bool(tracer.enabled),
-            "recorded_spans": tracer.recorded_spans,
-            "dropped_spans": tracer.dropped_spans,
-            "spans": tracer.summary(),
-        }
+            out: dict = {"tracing": False}
+        else:
+            out = {
+                "tracing": bool(tracer.enabled),
+                "recorded_spans": tracer.recorded_spans,
+                "dropped_spans": tracer.dropped_spans,
+                "spans": tracer.summary(),
+            }
+        recorder = self.flight_recorder
+        out["flight"] = (
+            recorder.summary() if recorder is not None else {"enabled": False}
+        )
+        return out
 
     def start(self) -> "PrometheusExporter":
         self._thread.start()

@@ -176,22 +176,36 @@ class Metrics:
         self._histogram("replica-failover-time", ms)
 
     def latency_quantile(self, base: str, q: float) -> Optional[float]:
-        """Bucket-interpolated quantile (ms) of a `<base>-ms` histogram, or
-        None before any observation — the hedge delay's data source
-        (observed chunk-fetch p95 with a static config fallback)."""
+        """Bucket-interpolated quantile (ms) of a `<base>-ms` histogram.
+
+        Degenerate-case CONTRACT (ISSUE 14, shared with
+        ``Histogram.quantile`` and ``Tracer.summary``): the answer is
+        ``None`` — never 0.0 — when the histogram is absent OR holds zero
+        observations, so consumers (hedge delay, SLO engine) can
+        distinguish "no data yet" from "genuinely zero latency" without
+        dividing by a phantom sample count. With exactly one observation
+        the answer is that observation's bucket position for every q; use
+        ``histogram_count`` when a minimum sample floor matters (the hedge
+        delay waits for ``hedge.delay.min.samples``)."""
+        stat = self.histogram(base)
+        if stat is not None and stat.count > 0:
+            return stat.quantile(q)
+        return None
+
+    def histogram(self, base: str) -> Optional[Histogram]:
+        """The `<base>-ms` Histogram stat, or None before the first
+        recording materializes it (the SLO engine reads bucket counts and
+        exemplars through this)."""
         for metric_name in self.registry.find(f"{base}-ms"):
             stat = self.registry.stat(metric_name)
-            if isinstance(stat, Histogram) and stat.count > 0:
-                return stat.quantile(q)
+            if isinstance(stat, Histogram):
+                return stat
         return None
 
     def histogram_count(self, base: str) -> int:
         """Observation count of a `<base>-ms` histogram (0 when absent)."""
-        for metric_name in self.registry.find(f"{base}-ms"):
-            stat = self.registry.stat(metric_name)
-            if isinstance(stat, Histogram):
-                return stat.count
-        return 0
+        stat = self.histogram(base)
+        return stat.count if stat is not None else 0
 
     def record_object_upload(
         self, topic: str, partition: int, object_type: str, n_bytes: int

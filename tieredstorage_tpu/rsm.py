@@ -89,6 +89,8 @@ from tieredstorage_tpu.utils.deadline import (
     check_deadline,
     ensure_deadline,
 )
+from tieredstorage_tpu.utils import flightrecorder as flight
+from tieredstorage_tpu.utils.flightrecorder import NOOP_RECORDER, FlightRecorder
 from tieredstorage_tpu.utils.ratelimit import RateLimitedStream, TokenBucket
 from tieredstorage_tpu.utils.tracing import NOOP_TRACER, Tracer
 from tieredstorage_tpu.utils.streams import ClosableStreamHolder
@@ -104,14 +106,24 @@ def _traced(name: str):
     Also the deadline entry point: the operation adopts the ambient
     end-to-end Deadline (installed by the sidecar boundary from the caller's
     x-deadline-ms) or starts one from `deadline.default.ms`, and an
-    already-expired budget fails fast here — before any storage work."""
+    already-expired budget fails fast here — before any storage work.
+
+    The flight recorder (ISSUE 14) opens its per-request record here too,
+    keyed by the span's trace id — reentrant, so when the HTTP gateway
+    already opened one for the whole request (covering the streamed drain)
+    this entry joins it instead of splitting the evidence."""
 
     def deco(fn):
         @functools.wraps(fn)
         def wrapper(self, metadata, *args, **kwargs):
             tp = metadata.remote_log_segment_id.topic_id_partition.topic_partition
             with ensure_deadline(self.default_deadline_s), \
-                    self.tracer.span(name, topic=tp.topic, partition=tp.partition):
+                    self.tracer.span(
+                        name, topic=tp.topic, partition=tp.partition
+                    ) as span, \
+                    self.flight_recorder.request(
+                        name, trace_id=span.trace_id if span else None
+                    ):
                 check_deadline(name)
                 return fn(self, metadata, *args, **kwargs)
 
@@ -147,6 +159,13 @@ class RemoteStorageManager:
         self._antientropy = None
         self._antientropy_scheduler = None
         self.tracer = NOOP_TRACER
+        #: Per-request flight recorder (`flight.enabled`); gateway + RSM
+        #: entries open records, the fetch tiers enrich them.
+        self.flight_recorder: FlightRecorder = NOOP_RECORDER
+        #: SLO engine (`slo.enabled`): burn rates + verdicts on GET /slo.
+        self._slo = None
+        #: Fleet-wide telemetry aggregator (fleet mode).
+        self._fleet_telemetry = None
         #: Entry-gate admission controller (`admission.enabled`); the sidecar
         #: boundaries (HTTP gateway + gRPC server) shed through this.
         self.admission: Optional[AdmissionController] = None
@@ -171,6 +190,11 @@ class RemoteStorageManager:
             enabled=config.tracing_enabled,
             use_jax_profiler=config.tracing_jax_profiler_enabled,
             max_spans=config.tracing_max_spans,
+        )
+
+        self.flight_recorder = FlightRecorder(
+            enabled=config.flight_enabled,
+            ring_size=config.flight_ring_size,
         )
 
         storage = config.storage_backend_class()
@@ -207,6 +231,8 @@ class RemoteStorageManager:
         register_tracer_metrics(self._metrics.registry, self.tracer)
         self._wire_replication(config)
         self._wire_scrubber(config)
+        self._wire_slo(config)
+        self._wire_fleet_telemetry(config)
 
     def _wire_replication(self, config: RemoteStorageManagerConfig) -> None:
         """When the configured storage backend is (or wraps) a
@@ -219,7 +245,15 @@ class RemoteStorageManager:
         from tieredstorage_tpu.metrics.rsm_metrics import register_replication_metrics
 
         self._replicated.tracer = self.tracer
-        self._replicated.on_failover = self._metrics.record_replica_failover
+        record_failover = self._metrics.record_replica_failover
+
+        def on_failover(ms: float) -> None:
+            # Histogram + the ambient flight record (one failover hop of
+            # THIS request) — the recorder helper is a no-op without one.
+            record_failover(ms)
+            flight.note("replica.failover_hops")
+
+        self._replicated.on_failover = on_failover
         if config.replication_antientropy_enabled:
             from tieredstorage_tpu.scrub.antientropy import (
                 AntiEntropyRepairer,
@@ -486,6 +520,155 @@ class RemoteStorageManager:
             config.scrub_interval_ms, config.scrub_rate_bytes,
             config.scrub_repair_enabled,
         )
+
+    def _wire_slo(self, config: RemoteStorageManagerConfig) -> None:
+        """SLO engine (`slo.*`, ISSUE 14): declarative objectives over the
+        histograms and counters the earlier wiring just built — fetch
+        latency vs the deadline budget, request-visible error rate, the
+        admission shed rate, and (opt-in) a chunk-cache hit floor. Gauges
+        land in the slo-metrics group; GET /slo serves the verdicts."""
+        if not config.slo_enabled:
+            return
+        from tieredstorage_tpu.metrics.slo import (
+            HistogramLatencySource,
+            RatioSource,
+            SloEngine,
+            SloSpec,
+        )
+
+        metrics = self._metrics
+        specs: list = []
+        threshold_ms = config.slo_fetch_latency_threshold_ms
+        if threshold_ms is None:
+            threshold_ms = config.deadline_default_ms
+        if threshold_ms is not None:
+            objective = config.slo_fetch_latency_objective_percent / 100.0
+            specs.append(SloSpec(
+                name="fetch-latency",
+                description=(
+                    f"p{config.slo_fetch_latency_objective_percent} chunk "
+                    f"fetch within {threshold_ms} ms (the deadline budget)"
+                ),
+                objective=objective,
+                source=HistogramLatencySource(
+                    metrics, "chunk-fetch-time", float(threshold_ms)
+                ),
+            ))
+        inner = self._innermost_chunk_manager(self._chunk_manager)
+
+        def fetch_errors() -> float:
+            bad = float(deadline_util.exceeded_total())
+            if inner is not None:
+                bad += float(inner.corruptions)
+            return bad
+
+        def fetch_events() -> float:
+            return float(
+                metrics.histogram_count("chunk-fetch-time")
+            ) + fetch_errors()
+
+        specs.append(SloSpec(
+            name="fetch-errors",
+            description=(
+                "chunk fetches without a request-visible failure "
+                "(detransform corruption, deadline expiry)"
+            ),
+            objective=config.slo_error_rate_objective_percent / 100.0,
+            source=RatioSource(
+                good=lambda: fetch_events() - fetch_errors(),
+                total=fetch_events,
+            ),
+        ))
+        if self.admission is not None:
+            admission = self.admission
+            specs.append(SloSpec(
+                name="shed-rate",
+                description=(
+                    f"requests admitted past the entry gate (sheds bounded "
+                    f"at {config.slo_shed_rate_max_percent}%)"
+                ),
+                objective=1.0 - config.slo_shed_rate_max_percent / 100.0,
+                source=RatioSource(
+                    good=lambda: float(admission.admitted_total),
+                    total=lambda: float(
+                        admission.admitted_total + admission.shed_total
+                    ),
+                ),
+            ))
+        floor = config.slo_cache_hit_floor_percent
+        chunk_cache = (
+            self._chunk_manager
+            if isinstance(self._chunk_manager, ChunkCache) else None
+        )
+        if floor > 0 and chunk_cache is not None:
+            stats = chunk_cache.stats
+            specs.append(SloSpec(
+                name="cache-hit",
+                description=f"chunk-cache hit rate floor ({floor}%)",
+                objective=floor / 100.0,
+                source=RatioSource(
+                    good=lambda: float(stats.hits),
+                    total=lambda: float(stats.hits + stats.misses),
+                ),
+            ))
+        self._slo = SloEngine(
+            specs,
+            short_window_s=config.slo_window_short_ms / 1000.0,
+            long_window_s=config.slo_window_long_ms / 1000.0,
+        )
+        self._slo.register_gauges(self._metrics.registry)
+        log.info(
+            "SLO engine enabled: specs=%s windows=%d/%dms",
+            [s.name for s in specs], config.slo_window_short_ms,
+            config.slo_window_long_ms,
+        )
+
+    @property
+    def slo_engine(self):
+        return self._slo
+
+    def slo_status(self) -> dict:
+        """Verdict payload for the gateway's GET /slo (evaluates: every
+        read is also a burn-rate window tick, the Prometheus model)."""
+        if self._slo is None:
+            raise RemoteStorageException("SLO engine is not enabled")
+        return {"enabled": True, **self._slo.evaluate()}
+
+    def flight_status(self, *, limit: Optional[int] = None) -> dict:
+        """Payload for the gateway's GET /debug/requests: slowest-first
+        retained flight records plus the failure ring."""
+        if not self.flight_recorder.enabled:
+            raise RemoteStorageException("flight recorder is not enabled")
+        return self.flight_recorder.dump(limit=limit)
+
+    def _wire_fleet_telemetry(self, config: RemoteStorageManagerConfig) -> None:
+        """Fleet-wide telemetry (fleet/telemetry.py): this member serves
+        its metric samples on GET /fleet/telemetry and can aggregate the
+        whole membership view into one scrape (?aggregate=1)."""
+        if self.fleet_router is None:
+            return
+        from tieredstorage_tpu.fleet.telemetry import FleetTelemetry
+
+        self._fleet_telemetry = FleetTelemetry(
+            [self._metrics.registry],
+            instance_id=config.fleet_instance_id,
+            router=self.fleet_router,
+            ping=self.fleet_ping,
+            timeout_s=config.fleet_forward_timeout_ms / 1000.0,
+        )
+
+    @property
+    def fleet_telemetry(self):
+        return self._fleet_telemetry
+
+    def fleet_telemetry_payload(self, *, aggregate: bool = False) -> dict:
+        """The gateway's GET /fleet/telemetry body: this member's samples,
+        or the merged fleet-wide scrape when ``aggregate`` is set."""
+        if self._fleet_telemetry is None:
+            raise RemoteStorageException("fleet mode is not enabled")
+        if aggregate:
+            return self._fleet_telemetry.scrape()
+        return self._fleet_telemetry.local_payload()
 
     @property
     def scrubber(self):
@@ -1128,6 +1311,8 @@ class RemoteStorageManager:
             ) from failures[0][1]
 
     def close(self) -> None:
+        if self._fleet_telemetry is not None:
+            self._fleet_telemetry.close()
         if self._gossip is not None:
             self._gossip.stop()
         if self._antientropy_scheduler is not None:

@@ -43,6 +43,21 @@ Gossip membership (ISSUE 11) adds the SWIM exchange pair:
 - ``GET /fleet/ping[?witness=1]`` — liveness + status: ring generation and
   epoch, the gossip view, peer-tier counters; ``witness=1`` adds the
   runtime lock/race witness verdicts (the multi-process soak's gate).
+
+The observability plane (ISSUE 14) adds three read-only routes:
+
+- ``GET /slo`` — the SLO engine's verdicts (``slo.enabled``): per-spec
+  compliance, error-budget remaining, and two-window burn rates computed
+  from the live latency histograms; 404 while the engine is disabled.
+- ``GET /debug/requests[?n=K]`` — the flight recorder's retained evidence
+  (``flight.enabled``): the K slowest and the failed requests with
+  per-tier chunk counts, hedge/failover activity, GCM window accounting,
+  and deadline budget at each stage; 404 while disabled, 400 on a bad
+  ``n``. Every POST request and peer-chunk serve records through the
+  recorder, covering the streamed response drain.
+- ``GET /fleet/telemetry[?aggregate=1]`` — this member's metric samples
+  (fleet mode), or with ``aggregate=1`` the whole membership view merged
+  into one fleet-wide scrape (sum/max/histogram-merge per stat).
 """
 
 from __future__ import annotations
@@ -68,6 +83,7 @@ from tieredstorage_tpu.utils.deadline import (
     ensure_deadline,
     parse_deadline_ms,
 )
+from tieredstorage_tpu.utils.flightrecorder import NOOP_RECORDER
 from tieredstorage_tpu.utils.tracing import NOOP_TRACER
 
 _STREAM_BLOCK = 1 << 20
@@ -232,6 +248,12 @@ class _Handler(BaseHTTPRequestHandler):
             self._peer_chunk(parts.query)
         elif parts.path in ("/fleet/ping", "/v1/fleet/ping"):
             self._fleet_ping(parts.query)
+        elif parts.path in ("/fleet/telemetry", "/v1/fleet/telemetry"):
+            self._fleet_telemetry(parts.query)
+        elif parts.path in ("/slo", "/v1/slo"):
+            self._slo()
+        elif parts.path in ("/debug/requests", "/v1/debug/requests"):
+            self._debug_requests(parts.query)
         elif self.path in ("/scrub", "/v1/scrub"):
             # Integrity-scrubber status: scheduler state, cumulative
             # counters, and the last pass summary ({"enabled": false} when
@@ -267,12 +289,19 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(400, b"expected ?key=<object key>&chunks=<lo>-<hi>")
             return
         wire_deadline = parse_deadline_ms(self.headers.get(shimwire.DEADLINE_HEADER))
+        recorder = getattr(self.rsm, "flight_recorder", NOOP_RECORDER)
         try:
             with deadline_scope(wire_deadline), \
                     ensure_deadline(getattr(self.rsm, "default_deadline_s", None)), \
                     tracer.continue_trace(
                         self.headers.get(shimwire.TRACEPARENT_HEADER)), \
-                    tracer.span("gateway.chunk", key=key, chunks=last - first + 1):
+                    tracer.span(
+                        "gateway.chunk", key=key, chunks=last - first + 1
+                    ) as span, \
+                    recorder.request(
+                        "gateway.chunk",
+                        trace_id=span.trace_id if span else None,
+                    ):
                 chunks = serve(key, first, last)
         except Exception as exc:  # noqa: BLE001 — boundary translation
             self._fail(exc)
@@ -299,6 +328,66 @@ class _Handler(BaseHTTPRequestHandler):
             self._fail(exc)
             return
         self._reply(200, json.dumps(status, indent=1).encode("utf-8"))
+
+    def _slo(self) -> None:
+        """SLO verdicts (metrics/slo.py): compliance, error budget, and
+        two-window burn rates per declared objective. 404 while
+        ``slo.enabled`` is off — an absent engine must read as "not
+        configured", never as "everything within budget"."""
+        import json
+
+        if getattr(self.rsm, "slo_engine", None) is None:
+            self._reply(404, b"slo engine disabled")
+            return
+        try:
+            status = self.rsm.slo_status()
+        except Exception as exc:  # noqa: BLE001 — boundary translation
+            self._fail(exc)
+            return
+        self._reply(200, json.dumps(status, indent=1).encode("utf-8"))
+
+    def _debug_requests(self, query: str) -> None:
+        """Flight-recorder evidence dump (utils/flightrecorder.py): the
+        slowest and the failed requests with tier/hedge/failover/GCM
+        accounting. ``?n=K`` bounds both lists; 400 on a malformed K, 404
+        while ``flight.enabled`` is off."""
+        import json
+
+        recorder = getattr(self.rsm, "flight_recorder", None)
+        if recorder is None or not recorder.enabled:
+            self._reply(404, b"flight recorder disabled")
+            return
+        # keep_blank_values: an explicit empty ?n= is a malformed request
+        # (400), not an absent parameter.
+        params = parse_qs(query, keep_blank_values=True, strict_parsing=False)
+        limit = None
+        if "n" in params:
+            raw = params["n"][0]
+            # Strict ASCII-digit grammar (the Content-Length precedent).
+            if not raw or not all(c in "0123456789" for c in raw) or int(raw) < 1:
+                self._reply(400, b"expected ?n=<positive integer>")
+                return
+            limit = int(raw)
+        status = self.rsm.flight_status(limit=limit)
+        self._reply(200, json.dumps(status, indent=1).encode("utf-8"))
+
+    def _fleet_telemetry(self, query: str) -> None:
+        """Fleet telemetry (fleet/telemetry.py): this member's metric
+        samples, or — with ``?aggregate=1`` — the whole membership view
+        merged into one fleet-wide scrape."""
+        import json
+
+        if getattr(self.rsm, "fleet_telemetry", None) is None:
+            self._reply(404, b"fleet mode disabled")
+            return
+        params = parse_qs(query, keep_blank_values=False, strict_parsing=False)
+        aggregate = params.get("aggregate", ["0"])[0] in ("1", "true")
+        try:
+            payload = self.rsm.fleet_telemetry_payload(aggregate=aggregate)
+        except Exception as exc:  # noqa: BLE001 — boundary translation
+            self._fail(exc)
+            return
+        self._reply(200, json.dumps(payload, indent=1).encode("utf-8"))
 
     def _fleet_gossip(self) -> None:
         """One SWIM membership exchange: merge the sender's JSON view,
@@ -384,7 +473,11 @@ class _Handler(BaseHTTPRequestHandler):
         # one, the RSM's configured default applies. The scope covers the
         # streamed drain, so chunk fetches during the response also honor it.
         wire_deadline = parse_deadline_ms(self.headers.get(shimwire.DEADLINE_HEADER))
+        recorder = getattr(self.rsm, "flight_recorder", NOOP_RECORDER)
         try:
+            # The flight record spans the streamed drain too (like the span
+            # and the deadline scope), so chunk-tier outcomes during the
+            # response land on THIS request's record.
             with contextlib.closing(body), \
                     deadline_scope(wire_deadline), \
                     ensure_deadline(getattr(self.rsm, "default_deadline_s", None)) as deadline, \
@@ -396,6 +489,10 @@ class _Handler(BaseHTTPRequestHandler):
                             {"deadline_ms": round(deadline.remaining_s() * 1000.0, 1)}
                             if deadline is not None else {}
                         ),
+                    ) as span, \
+                    recorder.request(
+                        "gateway" + self.path.replace("/v1/", "."),
+                        trace_id=span.trace_id if span else None,
                     ):
                 handler(body)
         except _StreamAborted:

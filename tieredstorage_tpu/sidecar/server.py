@@ -34,6 +34,7 @@ from tieredstorage_tpu.utils.deadline import (
     ensure_deadline,
     parse_deadline_ms,
 )
+from tieredstorage_tpu.utils.flightrecorder import NOOP_RECORDER
 from tieredstorage_tpu.utils.tracing import NOOP_TRACER
 
 
@@ -137,7 +138,10 @@ class SidecarServer:
         if streaming:
             def wrapped(request, context):
                 release = admit(context)
+                recorder = getattr(rsm, "flight_recorder", NOOP_RECORDER)
                 try:
+                    # The flight record spans the streamed drain (the
+                    # generator body), like the span and deadline scopes.
                     with deadline_scope(
                             parse_deadline_ms(
                                 metadata_value(context, rpc.DEADLINE_KEY))), \
@@ -145,7 +149,11 @@ class SidecarServer:
                                 getattr(rsm, "default_deadline_s", None)), \
                             tracer.continue_trace(
                                 metadata_value(context, rpc.TRACEPARENT_KEY)), \
-                            tracer.span(f"sidecar.{name}"):
+                            tracer.span(f"sidecar.{name}") as span, \
+                            recorder.request(
+                                f"sidecar.{name}",
+                                trace_id=span.trace_id if span else None,
+                            ):
                         try:
                             yield from fn(request, context)
                         except Exception as exc:  # noqa: BLE001 — boundary translation
@@ -156,6 +164,7 @@ class SidecarServer:
         else:
             def wrapped(request, context):
                 release = admit(context)
+                recorder = getattr(rsm, "flight_recorder", NOOP_RECORDER)
                 try:
                     with deadline_scope(
                             parse_deadline_ms(
@@ -164,7 +173,11 @@ class SidecarServer:
                                 getattr(rsm, "default_deadline_s", None)), \
                             tracer.continue_trace(
                                 metadata_value(context, rpc.TRACEPARENT_KEY)), \
-                            tracer.span(f"sidecar.{name}"):
+                            tracer.span(f"sidecar.{name}") as span, \
+                            recorder.request(
+                                f"sidecar.{name}",
+                                trace_id=span.trace_id if span else None,
+                            ):
                         try:
                             return fn(request, context)
                         except Exception as exc:  # noqa: BLE001 — boundary translation
@@ -290,10 +303,11 @@ def main(argv: Optional[list[str]] = None) -> None:
         # Bind the exporter to the same interface as the gRPC side: a
         # loopback-only sidecar must not expose metrics network-wide.
         # The RSM's tracer rides along so /varz serves the span summary
-        # (p50/p95/p99 per name) next to /metrics and /healthz.
+        # (p50/p95/p99 per name) next to /metrics and /healthz; the flight
+        # recorder adds the per-request `flight` section (ISSUE 14).
         exporter = PrometheusExporter(
             [rsm.metrics.registry], port=args.metrics_port, host=args.host,
-            tracer=rsm.tracer,
+            tracer=rsm.tracer, flight_recorder=rsm.flight_recorder,
         ).start()
     gateway = None
     if args.http_port is not None:

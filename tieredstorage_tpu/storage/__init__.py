@@ -14,11 +14,26 @@ from tieredstorage_tpu.storage.core import (
     StorageBackend,
     StorageBackendException,
 )
-from tieredstorage_tpu.storage.replicated import (
-    AllReplicasFailedException,
-    QuorumWriteException,
-    ReplicatedStorageBackend,
+# The replicated backend re-exports are LAZY (PEP 562): replicated.py
+# imports utils/deadline.py, which imports storage.core — an eager import
+# here made `tieredstorage_tpu.utils.deadline` (and everything that loads
+# it first, e.g. utils/flightrecorder.py) unimportable as the process's
+# first project import. Deferring breaks the cycle without changing the
+# public surface.
+_REPLICATED_EXPORTS = (
+    "AllReplicasFailedException",
+    "QuorumWriteException",
+    "ReplicatedStorageBackend",
 )
+
+
+def __getattr__(name: str):
+    if name in _REPLICATED_EXPORTS:
+        from tieredstorage_tpu.storage import replicated
+
+        return getattr(replicated, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
 
 __all__ = [
     "AllReplicasFailedException",

@@ -189,6 +189,16 @@ class TpuTransformBackend(TransformBackend):
             self.dispatch_stats = DispatchStats()
         return retired
 
+    @staticmethod
+    def thread_dispatch_counters() -> tuple[int, int]:
+        """This THREAD's cumulative (GCM dispatches, planned HBM round
+        trips) — the flight recorder's per-request window accounting seam
+        (fetch/chunk_manager.py differences it around one detransform).
+        Thread-local by construction (`ops.gcm` keeps per-thread counters),
+        so a sibling window's launches never inflate another request's
+        record. Duck-typed: CPU backends simply lack the method."""
+        return gcm_ops.thread_dispatches(), gcm_ops.thread_hbm_roundtrips()
+
     def configure(self, configs: dict) -> None:
         if "batch.chunks" in configs:
             self.preferred_batch_chunks = int(configs["batch.chunks"])

@@ -97,9 +97,15 @@ class Span:
 
 
 def _percentile(sorted_durations: list[float], q: float) -> float:
-    """Nearest-rank percentile over an ascending-sorted list."""
+    """Nearest-rank percentile over an ascending-sorted NON-EMPTY list.
+
+    Part of the degenerate-case contract (ISSUE 14): an empty sample set
+    has NO percentile — callers must not see a fabricated 0.0 — so the
+    empty list is a programming error here (``summary()`` never builds an
+    entry without at least one span). A single sample is every percentile
+    of itself (nearest rank: rank 1 of 1)."""
     if not sorted_durations:
-        return 0.0
+        raise ValueError("percentile of an empty sample set is undefined")
     rank = max(1, math.ceil(q * len(sorted_durations)))
     return sorted_durations[min(rank, len(sorted_durations)) - 1]
 
@@ -262,7 +268,15 @@ class Tracer:
             self.dropped_spans = 0
 
     def summary(self) -> dict[str, dict[str, float]]:
-        """Per-name count/total/avg/max plus p50/p95/p99 durations (seconds)."""
+        """Per-name count/total/avg/max plus p50/p95/p99 durations (seconds).
+
+        Degenerate-case contract (ISSUE 14): no recorded spans means an
+        EMPTY dict — a name never appears with fabricated zero percentiles,
+        so consumers (``/varz``, the SLO engine's evidence path) can treat
+        "absent" as "no data" without a sentinel check. A name with exactly
+        one span reports that span's duration as count=1, avg, max, and
+        every percentile (nearest-rank: one sample is every quantile of
+        itself)."""
         agg: dict[str, list[float]] = {}
         for s in self.spans():
             agg.setdefault(s.name, []).append(s.duration_s)

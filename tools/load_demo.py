@@ -1,0 +1,667 @@
+"""Load harness + SLO chaos gate: everything at once, judged by the SLO engine.
+
+ROADMAP item 4, closed by ISSUE 14: a seeded closed-loop Zipfian
+produce/fetch workload drives a 3-instance fleet (consistent-hash routing,
+peer cache, gossip-less static membership like fleet_demo) over a
+2-replica filesystem store — while the chaos schedule kills BOTH a storage
+replica (its data directory vanishes mid-run, every pre-kill object on it
+turns into failover traffic) and a fleet instance (gateway stopped,
+survivors re-ring). The run is judged by the observability plane this PR
+built, not by hardcoded thresholds:
+
+1. **SLO verdicts** — each survivor's ``GET /slo`` must report every spec
+   ``ok`` with real samples: fetch p99 within the deadline budget
+   (``fetch-latency`` over the live chunk-fetch histogram), bounded shed
+   rate, bounded error rate. Breaches fail the gate WITH evidence: the
+   histogram's exemplar trace ids resolve to flight-recorder records.
+2. **Zero byte diffs** — every fetched range compares against the source
+   bytes, across both kills.
+3. **Failover proof** — the fleet-wide telemetry scrape
+   (``GET /fleet/telemetry?aggregate=1``) must show
+   ``replica-failovers-total`` >= 1 (the replica kill was actually
+   absorbed) and merged cache counters.
+4. **Zero witness violations** — TSTPU_LOCK_WITNESS=1 (the make target
+   arms it): the lock-order DAG holds and every sampled shared-attribute
+   mutation held its statically inferred guard.
+5. **Flight evidence** — each survivor's ``GET /debug/requests`` must hold
+   records with tier breakdowns; the slowest are attached to the report.
+
+Writes ``artifacts/load_report.json`` (re-read + re-validated) and the
+bench-trajectory point ``BENCH_LOAD_r01.json`` (throughput, p50/p99,
+shed %, failover count, cache-tier hit %) so capacity regressions become
+PR-over-PR visible the same way transform throughput is. This is the
+``make load-demo`` CI gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import pathlib
+import random
+import sys
+import tempfile
+import threading
+import time
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO_ROOT))
+
+from collections import Counter  # noqa: E402
+
+from tieredstorage_tpu.metadata import (  # noqa: E402
+    KafkaUuid,
+    LogSegmentData,
+    RemoteLogSegmentId,
+    RemoteLogSegmentMetadata,
+    TopicIdPartition,
+    TopicPartition,
+)
+from tieredstorage_tpu.rsm import RemoteStorageManager  # noqa: E402
+from tieredstorage_tpu.sidecar import shimwire  # noqa: E402
+from tieredstorage_tpu.sidecar.http_gateway import SidecarHttpGateway  # noqa: E402
+
+CHUNK = 4096
+CHUNKS_PER_SEGMENT = 8
+BASE_SEGMENTS = 4
+PRODUCED_SEGMENTS = 3
+INSTANCES = ("g0", "g1", "g2")
+VNODES = 64
+KEY_PREFIX = "load/"
+WORKERS = 6
+REQUESTS_PER_WORKER = 100
+TOTAL_REQUESTS = WORKERS * REQUESTS_PER_WORKER
+#: Closed-loop pacing per worker iteration: long enough that the run spans
+#: the SLO engine's LONG burn-rate window (so the two-window math is
+#: exercised on real data), short enough to stay a sub-minute CI gate.
+PACING_S = 0.008
+#: Global request counts at which the chaos events fire (any worker
+#: crossing the threshold performs the kill under the coordinator lock).
+KILL_REPLICA_AT = TOTAL_REQUESTS // 3
+KILL_INSTANCE_AT = (2 * TOTAL_REQUESTS) // 3
+VICTIM_INSTANCE = "g2"
+DEADLINE_MS = 15_000
+SHED_MAX_PERCENT = 5
+SEED = 20260805
+ZIPF_EXPONENT = 1.2
+
+
+def segment_payload(i: int) -> bytes:
+    blob = b"".join(
+        b"seg=%02d off=%012d load-demo-record-body|" % (i, j)
+        for j in range(CHUNK * CHUNKS_PER_SEGMENT // 40 + 1)
+    )
+    return blob[: CHUNK * CHUNKS_PER_SEGMENT]
+
+
+def make_segment(i: int, tmp: pathlib.Path):
+    payload = segment_payload(i)
+    seg = tmp / f"{i:020d}.log"
+    seg.write_bytes(payload)
+    (tmp / f"{i}.index").write_bytes(b"\x00" * 64)
+    (tmp / f"{i}.timeindex").write_bytes(b"\x00" * 32)
+    (tmp / f"{i}.snapshot").write_bytes(b"\x00" * 16)
+    tip = TopicIdPartition(KafkaUuid(b"\x1d" * 16), TopicPartition("loaddemo", 0))
+    metadata = RemoteLogSegmentMetadata(
+        remote_log_segment_id=RemoteLogSegmentId(tip, KafkaUuid(bytes([i + 1]) * 16)),
+        start_offset=i * 1000,
+        end_offset=i * 1000 + 999,
+        segment_size_in_bytes=len(payload),
+    )
+    data = LogSegmentData(
+        log_segment=seg,
+        offset_index=tmp / f"{i}.index",
+        time_index=tmp / f"{i}.timeindex",
+        producer_snapshot_index=tmp / f"{i}.snapshot",
+        transaction_index=None,
+        leader_epoch_index=b"epoch-checkpoint",
+    )
+    return metadata, data, payload
+
+
+def storage_configs(tmp: pathlib.Path) -> dict:
+    """The shared 2-replica store: both replicas are plain filesystem
+    roots, shared by every instance, so 'replica a dies' is one directory
+    rename visible fleet-wide."""
+    return {
+        "storage.backend.class":
+            "tieredstorage_tpu.storage.replicated.ReplicatedStorageBackend",
+        "storage.replication.replicas": "a,b",
+        "storage.replication.replica.a.backend.class":
+            "tieredstorage_tpu.storage.filesystem.FileSystemStorage",
+        "storage.replication.replica.a.root": str(tmp / "replica-a"),
+        "storage.replication.replica.a.overwrite.enabled": True,
+        "storage.replication.replica.b.backend.class":
+            "tieredstorage_tpu.storage.filesystem.FileSystemStorage",
+        "storage.replication.replica.b.root": str(tmp / "replica-b"),
+        "storage.replication.replica.b.overwrite.enabled": True,
+        # Quorum 1: produce keeps succeeding through the replica outage
+        # (the surviving replica takes the copy).
+        "storage.replication.write.quorum": 1,
+        # Health from live traffic only: deterministic call sequences.
+        "storage.replication.probe.interval.ms": None,
+    }
+
+
+def make_rsm(name: str, tmp: pathlib.Path) -> RemoteStorageManager:
+    rsm = RemoteStorageManager()
+    rsm.configure({
+        **storage_configs(tmp),
+        "chunk.size": CHUNK,
+        "key.prefix": KEY_PREFIX,
+        "fetch.chunk.cache.class":
+            "tieredstorage_tpu.fetch.cache.memory.MemoryChunkCache",
+        "fetch.chunk.cache.size": -1,
+        "fetch.chunk.cache.thread.pool.size": 16,
+        "fleet.enabled": True,
+        "fleet.instance.id": name,
+        "fleet.vnodes": VNODES,
+        "deadline.default.ms": DEADLINE_MS,
+        "admission.enabled": True,
+        "admission.max.concurrent": 16,
+        "admission.max.queue": 32,
+        "admission.queue.timeout.ms": 5_000,
+        "hedge.enabled": True,
+        "hedge.delay.ms": 200,
+        "tracing.enabled": True,
+        # The observability plane under test:
+        "flight.enabled": True,
+        "flight.ring.size": 32,
+        "slo.enabled": True,
+        "slo.window.short.ms": 800,
+        "slo.window.long.ms": 2_400,
+        "slo.fetch.latency.objective.percent": 99,
+        "slo.error.rate.objective.percent": 99,
+        "slo.shed.rate.max.percent": SHED_MAX_PERCENT,
+    })
+    return rsm
+
+
+def http_fetch(port: int, metadata, start: int, end):
+    body = shimwire.encode_metadata(metadata) + shimwire.encode_fetch_tail(start, end)
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        conn.request("POST", "/v1/fetch", body=body)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def http_copy(port: int, metadata, data: LogSegmentData):
+    body = shimwire.encode_metadata(metadata) + shimwire.encode_sections({
+        "log_segment": pathlib.Path(data.log_segment).read_bytes(),
+        "offset_index": pathlib.Path(data.offset_index).read_bytes(),
+        "time_index": pathlib.Path(data.time_index).read_bytes(),
+        "producer_snapshot": pathlib.Path(data.producer_snapshot_index).read_bytes(),
+        "transaction_index": None,
+        "leader_epoch_index": data.leader_epoch_index,
+    })
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    try:
+        conn.request("POST", "/v1/copy", body=body)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def http_json(port: int, path: str):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        body = resp.read()
+        return resp.status, (json.loads(body) if resp.status == 200 else body)
+    finally:
+        conn.close()
+
+
+class Coordinator:
+    """Shared workload state: the request counter, the chaos triggers, the
+    alive-gateway view, and the client-observed evidence."""
+
+    def __init__(self, gateways, rsms, tmp: pathlib.Path):
+        self.lock = threading.Lock()
+        self.gateways = gateways
+        self.rsms = rsms
+        self.tmp = tmp
+        self.alive = list(INSTANCES)
+        self.requests = 0
+        self.replica_killed_at = None
+        self.instance_killed_at = None
+        self.byte_diffs = 0
+        self.retries = 0
+        self.client_errors = 0
+        self.statuses: Counter = Counter()
+        self.latencies_ms: list[float] = []
+
+    def next_request(self) -> int:
+        """Bump the global counter; fire a due chaos event exactly once."""
+        with self.lock:
+            self.requests += 1
+            n = self.requests
+            if n == KILL_REPLICA_AT and self.replica_killed_at is None:
+                self.replica_killed_at = n
+                # Replica a's data vanishes fleet-wide: every pre-kill
+                # object on it becomes a failover to replica b.
+                (self.tmp / "replica-a").rename(self.tmp / "replica-a.dead")
+            if n == KILL_INSTANCE_AT and self.instance_killed_at is None:
+                self.instance_killed_at = n
+                self.alive = [x for x in self.alive if x != VICTIM_INSTANCE]
+                survivors = {
+                    x: f"http://127.0.0.1:{self.gateways[x].port}"
+                    for x in self.alive
+                }
+                self.gateways[VICTIM_INSTANCE].stop()
+                for x in self.alive:
+                    self.rsms[x].set_fleet_peers(survivors)
+            return n
+
+    def alive_port(self, rng: random.Random) -> int:
+        with self.lock:
+            name = rng.choice(self.alive)
+            return self.gateways[name].port
+
+    def record(self, status: int, ok_bytes: bool, elapsed_ms: float,
+               retried: bool) -> None:
+        with self.lock:
+            self.statuses[status] += 1
+            self.latencies_ms.append(elapsed_ms)
+            if status == 200 and not ok_bytes:
+                self.byte_diffs += 1
+            if retried:
+                self.retries += 1
+
+
+def percentile(sorted_values: list[float], q: float) -> float:
+    if not sorted_values:
+        raise ValueError("percentile of an empty sample set is undefined")
+    rank = max(1, int(round(q * len(sorted_values) + 0.5)))
+    return sorted_values[min(rank, len(sorted_values)) - 1]
+
+
+def run(out_path: pathlib.Path, bench_path: pathlib.Path) -> int:
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix="load-demo-"))
+    (tmp / "replica-a").mkdir()
+    (tmp / "replica-b").mkdir()
+
+    all_segments = [
+        make_segment(i, tmp) for i in range(BASE_SEGMENTS + PRODUCED_SEGMENTS)
+    ]
+    base_segments = all_segments[:BASE_SEGMENTS]
+    to_produce = all_segments[BASE_SEGMENTS:]
+
+    # Seed the store through a plain loader (no fleet/SLO counters burned).
+    loader = RemoteStorageManager()
+    loader.configure({
+        **storage_configs(tmp), "chunk.size": CHUNK, "key.prefix": KEY_PREFIX,
+    })
+    for md, data, _ in base_segments:
+        loader.copy_log_segment_data(md, data)
+    loader.close()
+
+    rsms = {name: make_rsm(name, tmp) for name in INSTANCES}
+    gateways = {n: SidecarHttpGateway(r).start() for n, r in rsms.items()}
+    peers = {n: f"http://127.0.0.1:{g.port}" for n, g in gateways.items()}
+    for r in rsms.values():
+        r.set_fleet_peers(peers)
+
+    coord = Coordinator(gateways, rsms, tmp)
+    # The fetchable population grows as the producer lands new segments.
+    population_lock = threading.Lock()
+    population: list[tuple[RemoteLogSegmentMetadata, bytes]] = [
+        (md, payload) for md, _, payload in base_segments
+    ]
+
+    def producer() -> None:
+        """The produce stream: upload new segments through the gateways
+        while the fetch load runs (closed-loop: next upload starts when
+        the previous finished)."""
+        rng = random.Random(SEED ^ 0xBEEF)
+        for md, data, payload in to_produce:
+            # Pace produces across the run (one per ~sixth of the load).
+            while coord.requests < TOTAL_REQUESTS // (PRODUCED_SEGMENTS + 1):
+                time.sleep(0.05)
+            for attempt in range(4):
+                port = coord.alive_port(rng)
+                try:
+                    status, _ = http_copy(port, md, data)
+                except OSError:
+                    status = -1
+                if status in (200, 204):
+                    break
+            else:
+                raise AssertionError(f"produce failed after retries: {status}")
+            with population_lock:
+                population.append((md, payload))
+
+    def worker(wid: int) -> None:
+        rng = random.Random(SEED + wid)
+        for _ in range(REQUESTS_PER_WORKER):
+            time.sleep(PACING_S)
+            coord.next_request()
+            with population_lock:
+                pop = list(population)
+            weights = [
+                1.0 / (rank + 1) ** ZIPF_EXPONENT
+                for rank in range(len(pop) * CHUNKS_PER_SEGMENT)
+            ]
+            flat = rng.choices(
+                range(len(pop) * CHUNKS_PER_SEGMENT), weights=weights
+            )[0]
+            md, payload = pop[flat // CHUNKS_PER_SEGMENT]
+            chunk = flat % CHUNKS_PER_SEGMENT
+            start = chunk * CHUNK
+            end = min(start + CHUNK - 1, len(payload) - 1)
+            expected = payload[start:end + 1]
+            t0 = time.monotonic()
+            retried = False
+            for attempt in (1, 2):
+                port = coord.alive_port(rng)
+                try:
+                    status, got = http_fetch(port, md, start, end)
+                except OSError:
+                    # The dying gateway dropped us mid-kill: retry once on
+                    # a survivor (the client-side failover contract).
+                    status, got = -1, b""
+                if status == 200:
+                    break
+                retried = True
+                with coord.lock:
+                    coord.client_errors += 1
+            coord.record(
+                status, got == expected,
+                (time.monotonic() - t0) * 1000.0, retried,
+            )
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(WORKERS)]
+    threads.append(threading.Thread(target=producer))
+    run_started = time.monotonic()
+    for t in threads:
+        t.start()
+    # The scrape loop: the SLO engines tick on every /slo read (the
+    # Prometheus model — scrapes drive the burn-rate windows).
+    scrape_count = 0
+    while any(t.is_alive() for t in threads):
+        time.sleep(0.25)
+        with coord.lock:
+            alive = list(coord.alive)
+        for name in alive:
+            try:
+                http_json(gateways[name].port, "/slo")
+                scrape_count += 1
+            except OSError:
+                pass
+    for t in threads:
+        t.join(timeout=120)
+    run_elapsed_s = time.monotonic() - run_started
+
+    report: dict = {
+        "workload": {
+            "workers": WORKERS,
+            "requests": TOTAL_REQUESTS,
+            "produced_segments": PRODUCED_SEGMENTS,
+            "zipf_exponent": ZIPF_EXPONENT,
+            "seed": SEED,
+            "deadline_ms": DEADLINE_MS,
+        },
+        "chaos": {
+            "replica_killed_at_request": coord.replica_killed_at,
+            "instance_killed": VICTIM_INSTANCE,
+            "instance_killed_at_request": coord.instance_killed_at,
+        },
+        "slo_scrapes": scrape_count,
+    }
+    try:
+        # ------------------------------------------------- client evidence
+        assert coord.statuses.get(200, 0) == TOTAL_REQUESTS, dict(coord.statuses)
+        assert coord.byte_diffs == 0, f"{coord.byte_diffs} byte diffs"
+        assert len(population) == BASE_SEGMENTS + PRODUCED_SEGMENTS
+        latencies = sorted(coord.latencies_ms)
+        p50 = percentile(latencies, 0.50)
+        p99 = percentile(latencies, 0.99)
+        report["client"] = {
+            "statuses": dict(coord.statuses),
+            "byte_diffs": coord.byte_diffs,
+            "retries": coord.retries,
+            "client_errors": coord.client_errors,
+            "p50_ms": round(p50, 2),
+            "p99_ms": round(p99, 2),
+        }
+        assert p99 <= DEADLINE_MS, f"client p99 {p99:.0f}ms over budget"
+
+        survivors = [n for n in INSTANCES if n != VICTIM_INSTANCE]
+
+        # ---------------------------------------------------- SLO verdicts
+        breaches: list[dict] = []
+        slo_section: dict = {}
+        for name in survivors:
+            status, verdicts = http_json(gateways[name].port, "/slo")
+            assert status == 200, (name, verdicts)
+            specs = verdicts["specs"]
+            # The p99 gate is the ENGINE's own verdict over the real
+            # histogram — samples prove it wasn't computed from thin air.
+            latency = specs["fetch-latency"]
+            assert latency["samples"] > 0, f"{name}: no latency samples"
+            # The burn-rate math engaged on real data: the run is paced to
+            # span the long window, which covers the cold-fetch phase. The
+            # SHORT window may legitimately be None at the end of the run —
+            # a warm cache means zero chunk-fetch events in the last 800 ms,
+            # and the degenerate contract says "no events" is None, never a
+            # fabricated 0.0.
+            assert latency["burn_rate_long"] is not None, latency
+            shed = specs["shed-rate"]
+            slo_section[name] = {
+                "ok": verdicts["ok"],
+                "burning": verdicts["burning"],
+                "fetch_latency": {
+                    "samples": latency["samples"],
+                    "compliance": latency["compliance"],
+                    "error_budget_remaining": latency["error_budget_remaining"],
+                    "burn_rate_short": latency["burn_rate_short"],
+                    "burn_rate_long": latency["burn_rate_long"],
+                },
+                "shed_rate_compliance": shed["compliance"],
+            }
+            for spec_name, verdict in specs.items():
+                if not verdict["ok"]:
+                    # Breach: attach the engine's evidence AND resolve its
+                    # exemplar trace ids against the flight recorder.
+                    _, flightdump = http_json(
+                        gateways[name].port, "/debug/requests?n=10"
+                    )
+                    exemplars = verdict.get("evidence", {}).get(
+                        "exemplars_over_threshold", []
+                    )
+                    traces = {e["trace_id"] for e in exemplars}
+                    matching = [
+                        r for r in (
+                            flightdump.get("slowest", [])
+                            + flightdump.get("failed", [])
+                        )
+                        if r["trace_id"] in traces
+                    ] if isinstance(flightdump, dict) else []
+                    breaches.append({
+                        "instance": name,
+                        "spec": spec_name,
+                        "verdict": verdict,
+                        "flight_records": matching,
+                    })
+        report["slo"] = slo_section
+        report["breaches"] = breaches
+        assert not breaches, json.dumps(breaches, indent=1)
+
+        # ------------------------------------------------- fleet telemetry
+        status, scrape = http_json(
+            gateways[survivors[0]].port, "/fleet/telemetry?aggregate=1"
+        )
+        assert status == 200, scrape
+        fleet = scrape["fleet"]
+        failovers = fleet.get(
+            "replication-metrics:replica-failovers-total", {}
+        ).get("value", 0.0)
+        assert failovers >= 1, "replica kill produced no failovers"
+        hits = fleet.get(
+            "cache-metrics:cache-hits-total{cache=chunk-cache}", {}
+        ).get("value", 0.0)
+        misses = fleet.get(
+            "cache-metrics:cache-misses-total{cache=chunk-cache}", {}
+        ).get("value", 0.0)
+        cache_tier_rate = hits / (hits + misses) if hits + misses else 0.0
+        sheds = fleet.get(
+            "resilience-metrics:admission-shed-total", {}
+        ).get("value", 0.0)
+        admitted = fleet.get(
+            "resilience-metrics:admission-admitted-total", {}
+        ).get("value", 0.0)
+        shed_rate = sheds / (sheds + admitted) if sheds + admitted else 0.0
+        report["fleet_telemetry"] = {
+            "members": scrape["members"],
+            "replica_failovers_total": failovers,
+            "chunk_cache_hits": hits,
+            "chunk_cache_misses": misses,
+            "cache_tier_rate": round(cache_tier_rate, 4),
+            "admission_shed_total": sheds,
+            "shed_rate": round(shed_rate, 4),
+            "aggregated_stats": len(fleet),
+        }
+        assert cache_tier_rate >= 0.5, f"cache tier {cache_tier_rate:.0%}"
+        assert shed_rate <= SHED_MAX_PERCENT / 100.0, f"shed rate {shed_rate:.1%}"
+        # The dead member either left the membership view (re-ring) or
+        # shows as unreachable — never as a healthy contributor.
+        victim_status = scrape["members"].get(VICTIM_INSTANCE)
+        assert victim_status is None or victim_status["reachable"] is False, (
+            victim_status
+        )
+
+        # -------------------------------------------------- flight records
+        flight_section = {}
+        for name in survivors:
+            status, dump = http_json(
+                gateways[name].port, "/debug/requests?n=3"
+            )
+            assert status == 200, (name, dump)
+            assert dump["requests_seen"] > 0
+            slowest = dump["slowest"]
+            assert slowest and any(r["tiers"] for r in slowest), (
+                f"{name}: no tier evidence in flight records"
+            )
+            flight_section[name] = {
+                "requests_seen": dump["requests_seen"],
+                "requests_failed": dump["requests_failed"],
+                "top_slowest": [
+                    {
+                        "name": r["name"],
+                        "duration_ms": r["duration_ms"],
+                        "tiers": r["tiers"],
+                        "deadline_entry_ms": r["deadline_entry_ms"],
+                    }
+                    for r in slowest
+                ],
+            }
+        report["flight"] = flight_section
+
+        # ------------------------------------------------- witness verdict
+        from tieredstorage_tpu.analysis import races
+        from tieredstorage_tpu.utils.locks import witness, witness_enabled
+
+        crosscheck = races.runtime_crosscheck()
+        report["witness"] = {
+            "enabled": witness_enabled(),
+            "lock_edges": len(witness().edges()),
+            "lock_violations": list(witness().violations),
+            "race_sites_validated": len(crosscheck["validated"]),
+            "race_violations": crosscheck["violations"],
+        }
+        assert not witness().violations, witness().violations
+        assert not crosscheck["violations"], crosscheck["violations"]
+
+        report["run_elapsed_s"] = round(run_elapsed_s, 2)
+        report["throughput_rps"] = round(
+            TOTAL_REQUESTS / max(run_elapsed_s, 1e-9), 1
+        )
+    finally:
+        for g in gateways.values():
+            try:
+                g.stop()  # idempotent: the victim's is already down
+            except Exception:
+                pass
+        for r in rsms.values():
+            r.close()
+
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    out_path.write_text(json.dumps(report, indent=1))
+
+    bench = {
+        "metric": "load_fetch_p99",
+        "value": report["client"]["p99_ms"],
+        "unit": "ms",
+        "platform": "cpu",
+        "requests": TOTAL_REQUESTS,
+        "throughput_rps": report["throughput_rps"],
+        "p50_ms": report["client"]["p50_ms"],
+        "p99_ms": report["client"]["p99_ms"],
+        "shed_rate": report["fleet_telemetry"]["shed_rate"],
+        "failover_count": report["fleet_telemetry"]["replica_failovers_total"],
+        "cache_tier_rate": report["fleet_telemetry"]["cache_tier_rate"],
+        "byte_diffs": 0,
+        "workload": (
+            f"{WORKERS} closed-loop workers x {REQUESTS_PER_WORKER} zipf({ZIPF_EXPONENT}) "
+            f"fetches + {PRODUCED_SEGMENTS} produces over a 3-instance fleet / "
+            f"2-replica store; replica AND instance killed mid-run"
+        ),
+        "note": (
+            "CPU-fallback trajectory point (BENCH_LOAD r01): gates are the "
+            "SLO engine's own verdicts over live histograms, with "
+            "flight-recorder evidence attached to any breach"
+        ),
+    }
+    bench_path.write_text(json.dumps(bench, indent=1))
+
+    # ------------------------------------------------ artifact re-validation
+    parsed = json.loads(out_path.read_text())
+    assert parsed["client"]["byte_diffs"] == 0
+    assert parsed["breaches"] == []
+    assert all(v["ok"] for v in parsed["slo"].values())
+    assert all(
+        v["fetch_latency"]["samples"] > 0 for v in parsed["slo"].values()
+    )
+    assert parsed["fleet_telemetry"]["replica_failovers_total"] >= 1
+    assert parsed["fleet_telemetry"]["shed_rate"] <= SHED_MAX_PERCENT / 100.0
+    assert parsed["witness"]["lock_violations"] == []
+    assert parsed["witness"]["race_violations"] == []
+    assert all(f["requests_seen"] > 0 for f in parsed["flight"].values())
+    assert parsed["chaos"]["replica_killed_at_request"] == KILL_REPLICA_AT
+    assert parsed["chaos"]["instance_killed_at_request"] == KILL_INSTANCE_AT
+    parsed_bench = json.loads(bench_path.read_text())
+    assert parsed_bench["value"] == parsed["client"]["p99_ms"]
+    print(
+        f"LOAD_DEMO_OK requests={TOTAL_REQUESTS} "
+        f"p50={parsed['client']['p50_ms']}ms p99={parsed['client']['p99_ms']}ms "
+        f"failovers={parsed['fleet_telemetry']['replica_failovers_total']} "
+        f"cache_tier={parsed['fleet_telemetry']['cache_tier_rate']} "
+        f"shed_rate={parsed['fleet_telemetry']['shed_rate']} "
+        f"slo_ok={all(v['ok'] for v in parsed['slo'].values())} "
+        f"byte_diffs=0 out={out_path}"
+    )
+    return 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--out", default=str(REPO_ROOT / "artifacts" / "load_report.json"),
+        help="load report JSON output path",
+    )
+    parser.add_argument(
+        "--bench-out", default=str(REPO_ROOT / "artifacts" / "BENCH_LOAD.json"),
+        help="bench trajectory JSON output path",
+    )
+    args = parser.parse_args()
+    return run(pathlib.Path(args.out), pathlib.Path(args.bench_out))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

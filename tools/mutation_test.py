@@ -81,6 +81,13 @@ DEFAULT_TARGETS = [
     # operator flip either mis-tiles the grid (wrong tags) or silently
     # routes production off the fused path.
     ("tieredstorage_tpu/ops/ghash_pallas.py", ["tests/test_ghash_pallas.py"]),
+    # ISSUE 14: the observability plane's pure logic — burn-rate/budget
+    # arithmetic and window-base selection (slo.py), the slowest/failed
+    # retention heap and counter accounting (flightrecorder.py). An
+    # operator flip here silently mis-judges SLO breaches or retains the
+    # wrong requests as evidence.
+    ("tieredstorage_tpu/utils/flightrecorder.py", ["tests/test_flight_recorder.py"]),
+    ("tieredstorage_tpu/metrics/slo.py", ["tests/test_slo.py"]),
 ]
 
 _CMP_SWAP = {
