@@ -402,3 +402,67 @@ class TestLatencyQuantileContract:
         p99 = metrics.latency_quantile("chunk-fetch-time", 0.99)
         assert p99 is not None and 8.0 < p99 <= 16.0  # its bucket, not 0.0
         assert metrics.histogram_count("chunk-fetch-time") == 1
+
+
+class TestMutationHardening:
+    """Pin the exact arithmetic the mutation harness flips."""
+
+    def test_interpolation_with_nonzero_prefix_count(self):
+        # 3 obs below the bucket + 4 inside it; threshold mid-bucket.
+        # good = prev_count + (count - prev_count) * frac = 3 + 4*0.5 — a
+        # flipped +/- on either the span or the prefix term shifts this.
+        metrics = Metrics()
+        for v in [1.0] * 3 + [10.0] * 4:
+            metrics.record_chunk_fetch(v, 1)
+        source = HistogramLatencySource(metrics, "chunk-fetch-time", 12.0)
+        good, total = source.counts()
+        assert total == 7.0
+        assert good == pytest.approx(3.0 + 4.0 * (12.0 - 8.0) / (16.0 - 8.0))
+
+    def test_exemplar_exactly_at_threshold_is_not_evidence(self):
+        # Strictly OVER threshold only: a value equal to the budget is
+        # within it.
+        from tieredstorage_tpu.utils.flightrecorder import FlightRecorder
+
+        metrics = Metrics()
+        recorder = FlightRecorder(enabled=True)
+        with recorder.request("edge", trace_id="t-edge"):
+            metrics.record_chunk_fetch(8.0, 1)
+        source = HistogramLatencySource(metrics, "chunk-fetch-time", 8.0)
+        assert source.evidence() == {}
+
+    def test_burning_without_budget_exhaustion_attaches_evidence(self):
+        # ok True (cumulative budget fine) but burning True: evidence must
+        # still be attached — the alert fires while the budget holds.
+        class EvidentSource(RatioSource):
+            def evidence(self):
+                return {"marker": True}
+
+        counters = Counters()
+        clock = FakeClock()
+        spec = SloSpec(
+            "s", "d", 0.9,
+            EvidentSource(good=lambda: counters.good,
+                          total=lambda: counters.total),
+        )
+        engine = SloEngine([spec], short_window_s=60.0, long_window_s=600.0,
+                           time_source=clock)
+        counters.add(good=10_000.0, bad=0.0)  # deep budget reserve
+        engine.tick()
+        clock.advance(600.0)
+        counters.add(good=80.0, bad=20.0)  # burn 2.0 on both windows
+        verdict = engine.evaluate()["specs"]["s"]
+        assert verdict["ok"] is True and verdict["burning"] is True
+        assert verdict["evidence"] == {"marker": True}
+
+    def test_evaluate_cached_reuses_at_exact_max_age(self):
+        clock, counters = FakeClock(), Counters()
+        engine = make_engine(counters, clock)
+        engine.evaluate()
+        assert engine.evaluations == 1
+        clock.advance(1.0)
+        engine.evaluate_cached(max_age_s=1.0)  # exactly at the age bound
+        assert engine.evaluations == 1  # cache hit, no re-tick
+        clock.advance(1.001)
+        engine.evaluate_cached(max_age_s=1.0)
+        assert engine.evaluations == 2  # past the bound: fresh evaluation
