@@ -292,3 +292,29 @@ class TestRsmIntegration:
             assert recorder.find("drain-trace") is drain
         finally:
             rsm.close()
+
+
+class TestMutationHardening:
+    """Pin the arithmetic the mutation harness flips."""
+
+    def test_stage_elapsed_is_real_milliseconds(self):
+        import time as _time
+
+        recorder = FlightRecorder(enabled=True)
+        with recorder.request("op") as record:
+            _time.sleep(0.02)
+            flight.stage("late")
+        (_, at_ms, _) = record.stages[0]
+        # ~20 ms elapsed: a flipped +/- explodes past the process uptime,
+        # a // instead of * collapses to 0.0.
+        assert 10.0 <= at_ms < 10_000.0
+
+    def test_ring_size_one_is_valid_and_keeps_first_on_tie(self):
+        clock = FakeClock()
+        recorder = FlightRecorder(enabled=True, ring_size=1, time_source=clock)
+        for name in ("first", "second"):
+            with recorder.request(name):
+                clock.advance(0.05)  # identical durations
+        # Strictly-greater eviction: an equal-duration newcomer does NOT
+        # displace the already-retained record.
+        assert [r.name for r in recorder.slowest()] == ["first"]
