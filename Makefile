@@ -96,8 +96,13 @@ fleet-soak:
 # fetch) per window — cross-checked against the ops-level launch counter —
 # with wire bytes identical to the multi-dispatch reference ops, a byte-clean
 # round trip, tamper rejection, and the default bench window shapes eligible
-# for the Pallas kernels by pure host logic. Writes and re-validates
-# artifacts/transform_report.json.
+# for the Pallas kernels by pure host logic. A batched-mode cross-check
+# (ISSUE 15) re-runs the decrypt workload through the cross-request
+# WindowBatcher from concurrent threads: dispatches_per_window and
+# hbm_roundtrips_per_window must stay <= 1 THROUGH the merge (they drop
+# below 1), every merged launch must still donate its staged buffer, and
+# the demultiplexed bytes must equal the unbatched path's. Writes and
+# re-validates artifacts/transform_report.json.
 transform-demo:
 	$(PYTHON) tools/transform_demo.py --out artifacts/transform_report.json
 
@@ -137,9 +142,17 @@ hot-demo:
 # and the cache tier held, every fetched byte must match the source across
 # both kills, GET /debug/requests must hold flight records with tier
 # evidence, and — LockWitness armed — zero lock-order and zero guarded-by
-# violations. Writes artifacts/load_report.json + artifacts/BENCH_LOAD.json
-# (the committed BENCH_LOAD_r01.json trajectory point) and re-validates
-# both.
+# violations. ISSUE 15 added the ROADMAP-item-4 remainders: an OVERLOAD
+# burst that saturates one survivor's admission window (the shed-rate SLO
+# must bite — >0 sheds, the engine reports the burn — then ordinary
+# traffic refills the budget back to all-ok), and a SCALED CAPACITY PROBE:
+# 1024 concurrent consumer-replay streams through the full decrypt chain
+# with cross-request GCM batching on vs off (byte parity, mean batch
+# occupancy > 1, launches-per-window strictly below the unbatched control,
+# p99 within SLO by the PR-14 engine, flight records carrying the shared-
+# launch evidence). Writes artifacts/load_report.json +
+# artifacts/BENCH_LOAD.json (the committed BENCH_LOAD_r01.json trajectory
+# point) and re-validates both.
 load-demo:
 	TSTPU_LOCK_WITNESS=1 $(PYTHON) tools/load_demo.py --out artifacts/load_report.json --bench-out artifacts/BENCH_LOAD.json
 
@@ -170,7 +183,7 @@ lint: analyze
 # /root/reference/build.gradle:24): flips operators in core pure-logic
 # modules and requires the owning suites to notice.
 mutation:
-	$(PYTHON) tools/mutation_test.py --budget 88
+	$(PYTHON) tools/mutation_test.py --budget 96
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import subprocess
 import sys
 import time
@@ -411,6 +412,113 @@ def measure_compile_cost(dk, chunk_bytes: int, window: int) -> dict:
     }
 
 
+def bench_batched_fetch(
+    dk, *, chunk_bytes: int = 8 << 10, window: int = 4,
+    stream_counts: tuple = (1, 8, 64, 512),
+) -> dict:
+    """Cross-request GCM batching (ISSUE 15): the same decrypt workload
+    fanned across 1/8/64/512 concurrent streams through one shared
+    backend, batching ON (`WindowBatcher` coalescing concurrent windows
+    into merged launches) vs the batching-OFF control. Reported per stream
+    count: aggregate plaintext GiB/s, the measured launch count, and the
+    batcher's mean occupancy — the contract under concurrency is
+    `launches < windows` (dispatches_per_window < 1), with the
+    single-stream row showing the fast path costs nothing. Small fixed
+    windows by design: the per-launch floor this amortizes is
+    size-independent (PROFILE.md), and host-platform GiB/s are to be read
+    for the launch-count ratio, not absolute throughput."""
+    import threading as _threading
+
+    from tieredstorage_tpu.ops import gcm as gcm_ops
+    from tieredstorage_tpu.transform.api import (
+        DetransformOptions,
+        TransformOptions,
+    )
+    from tieredstorage_tpu.transform.tpu import TpuTransformBackend
+
+    n_windows_max = max(max(stream_counts), 64)
+    rng = random.Random(15)
+    plain = [
+        [
+            bytes(rng.getrandbits(8) for _ in range(chunk_bytes))
+            for _ in range(window)
+        ]
+        for _ in range(n_windows_max)
+    ]
+    enc_backend = TpuTransformBackend()
+    opts = TransformOptions(encryption=dk)
+    wire = [enc_backend.transform(list(w), opts) for w in plain]
+    enc_backend.close()
+    d_opts = DetransformOptions(encryption=dk)
+    out: dict = {}
+
+    for streams in stream_counts:
+        n_windows = max(64, streams)
+        for batched in (True, False):
+            backend = TpuTransformBackend()
+            if batched:
+                backend.enable_batching(wait_ms=2, max_windows=16)
+            # Warm every jit shape this run can launch (fixed direct
+            # windows + the merged varlen row ladder), then reset stats so
+            # the measured launch counts are the steady state's.
+            fixed_ctx = gcm_ops.make_context(dk.data_key, dk.aad, chunk_bytes)
+            warm = np.zeros((window, chunk_bytes + 16), np.uint8)
+            np.asarray(backend._launch_packed(
+                fixed_ctx, backend._stage_packed(warm, False), False,
+                decrypt=True,
+            ))
+            if batched:
+                var_ctx = gcm_ops.make_varlen_context(
+                    dk.data_key, dk.aad, chunk_bytes
+                )
+                rows = window
+                while rows <= 16 * window:
+                    warm = np.zeros((rows, var_ctx.max_bytes + 16), np.uint8)
+                    warm[:, var_ctx.max_bytes + 12] = 16
+                    np.asarray(backend._launch_packed(
+                        var_ctx, backend._stage_packed(warm, True), True,
+                        decrypt=True,
+                    ))
+                    rows *= 2
+            backend.reset_dispatch_stats()
+
+            errors: list = []
+
+            def worker(wid: int, backend=backend, n_windows=n_windows,
+                       streams=streams, errors=errors) -> None:
+                for i in range(wid, n_windows, streams):
+                    got = backend.detransform(list(wire[i]), d_opts)
+                    if got != plain[i]:
+                        errors.append(i)
+
+            threads = [
+                _threading.Thread(target=worker, args=(wid,))
+                for wid in range(streams)
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            elapsed = time.perf_counter() - t0
+            if errors:
+                raise AssertionError(f"byte diffs in windows {errors[:5]}")
+            stats = backend.dispatch_stats
+            total_bytes = n_windows * window * chunk_bytes
+            mode = "batched" if batched else "unbatched"
+            out[f"{mode}_fetch_gibs_{streams}"] = round(
+                total_bytes / (1 << 30) / elapsed, 4
+            )
+            out[f"{mode}_fetch_launches_{streams}"] = stats.dispatches
+            out[f"{mode}_fetch_windows_{streams}"] = stats.windows
+            if batched:
+                out[f"batched_fetch_occupancy_{streams}"] = round(
+                    backend.batcher.mean_occupancy, 3
+                )
+            backend.close()
+    return out
+
+
 def bench_tunnel_roundtrip(total_bytes: int) -> float:
     """Zero-compute control: ship bytes to the device, touch them with one
     xor, fetch them back. Upper-bounds ANY transfer-inclusive number."""
@@ -678,6 +786,25 @@ def run_bench() -> dict:
     except Exception as exc:
         extras["hot_error"] = f"{type(exc).__name__}: {exc}"
         _err(f"[bench] hot-tier bench failed: {extras['hot_error']}")
+
+    # 1d. CROSS-REQUEST BATCHING (ISSUE 15): concurrent-stream decrypt
+    # through the WindowBatcher vs the unbatched control. Guarded the same
+    # way: a batcher failure must never cost the kernel numbers.
+    try:
+        extras.update(bench_batched_fetch(dk))
+        _err(
+            "[bench] batched fetch: "
+            + " ".join(
+                f"s={s}:"
+                f"{extras[f'batched_fetch_launches_{s}']}L"
+                f"/occ={extras[f'batched_fetch_occupancy_{s}']}"
+                f" vs {extras[f'unbatched_fetch_launches_{s}']}L"
+                for s in (1, 8, 64, 512)
+            )
+        )
+    except Exception as exc:
+        extras["batched_fetch_error"] = f"{type(exc).__name__}: {exc}"
+        _err(f"[bench] batched-fetch bench failed: {extras['batched_fetch_error']}")
 
     # 2. Zero-compute transfer control (the harness-link speed of light).
     ctrl_s = bench_tunnel_roundtrip(min(total_bytes, 64 << 20))

@@ -91,14 +91,20 @@ class TestTagVerification:
         """The device path must verify tags with hmac.compare_digest, not
         bytes !=: a revert is behaviorally invisible (same accept/reject
         decision) but reopens the remote timing side channel the CPU path's
-        `cryptography` verify closes, so pin it at the source level."""
+        `cryptography` verify closes, so pin it at the source level — at
+        BOTH verify sites: the direct window path and the cross-request
+        batcher's merged-flush demux (ISSUE 15)."""
         import inspect
 
+        from tieredstorage_tpu.transform import batcher as batcher_mod
         from tieredstorage_tpu.transform import tpu as tpu_mod
 
-        src = inspect.getsource(tpu_mod.TpuTransformBackend._decrypt_batch)
+        src = inspect.getsource(tpu_mod.TpuTransformBackend._decrypt_window)
         assert "hmac.compare_digest" in src
         assert "!= received_tags" not in src
+        flush_src = inspect.getsource(batcher_mod.WindowBatcher._flush_group)
+        assert "hmac.compare_digest" in flush_src
+        assert "!= e.tags" not in flush_src
 
 
 class TestMeshSharding:

@@ -38,6 +38,7 @@ DAEMON_LOOP_FUNCTIONS = {
     "tieredstorage_tpu/storage/replicated.py:HealthProber._run",
     "tieredstorage_tpu/sidecar/server.py:main",
     "tieredstorage_tpu/fleet/gossip.py:GossipAgent._run",
+    "tieredstorage_tpu/transform/batcher.py:WindowBatcher._run",
 }
 
 #: Blocking-wait method names checked for a clamped timeout argument.
@@ -129,6 +130,9 @@ SANCTIONED_THREAD_SPAWNS = {
         "gateway accept loop (workers ride the bounded executor)",
     "tieredstorage_tpu/fleet/gossip.py:GossipAgent.start":
         "gossip membership daemon (one per fleet member, stopped via stop)",
+    "tieredstorage_tpu/transform/batcher.py:WindowBatcher.start":
+        "cross-request GCM flush daemon (one device queue per backend, "
+        "stopped via stop)",
 }
 
 

@@ -86,6 +86,11 @@ HOT_PATH_ROOTS = (
     "tieredstorage_tpu/ops/gcm.py:gcm_varlen_window_packed",
     "tieredstorage_tpu/fetch/cache/device_hot.py:DeviceHotCache.get_chunks",
     "tieredstorage_tpu/fetch/cache/device_hot.py:DeviceHotCache.device_rows",
+    # The cross-request batcher (ISSUE 15) is the decrypt hot path under
+    # concurrency: a hidden materialization in submit or the merged flush
+    # would pay once per COALESCED launch and stall every waiter at once.
+    "tieredstorage_tpu/transform/batcher.py:WindowBatcher.submit",
+    "tieredstorage_tpu/transform/batcher.py:WindowBatcher._flush_group",
 )
 
 #: Modules the closure may traverse: the window path and the kernel stack
@@ -101,6 +106,7 @@ HOT_PATH_MODULES = (
     "tieredstorage_tpu/ops/ghash_pallas.py",
     "tieredstorage_tpu/parallel/mesh.py",
     "tieredstorage_tpu/fetch/cache/device_hot.py",
+    "tieredstorage_tpu/transform/batcher.py",
 )
 
 #: Functions allowed to materialize device values, with the reason. This is
@@ -109,7 +115,7 @@ SANCTIONED_MATERIALIZERS = {
     "tieredstorage_tpu/transform/tpu.py:TpuTransformBackend._encrypt_finish":
         "the window's ONE device->host fetch: blocks on the oldest staged "
         "window after pipeline_depth newer ones were dispatched",
-    "tieredstorage_tpu/transform/tpu.py:TpuTransformBackend._decrypt_batch":
+    "tieredstorage_tpu/transform/tpu.py:TpuTransformBackend._decrypt_window":
         "decrypt finish half: one fetch of plaintext+expected tags, "
         "verified host-side (the launch half is still checked upstream)",
     "tieredstorage_tpu/ops/gcm.py:_derive_h":
@@ -117,6 +123,10 @@ SANCTIONED_MATERIALIZERS = {
         "never on the per-window path",
     "tieredstorage_tpu/ops/aes_bitsliced.py:_forced_crosscheck_ok":
         "one-time forced-Pallas output cross-check at first use, memoized",
+    "tieredstorage_tpu/transform/batcher.py:WindowBatcher._flush_group":
+        "the merged flush's ONE device->host fetch, demultiplexed to every "
+        "coalesced waiter with per-row tag verification (the batched "
+        "counterpart of _decrypt_batch's finish half)",
 }
 
 #: Vetted jit wrappers: every shape family they compile is bounded (the

@@ -85,6 +85,10 @@ SHARED_CLASSES = {
     "tieredstorage_tpu/utils/flightrecorder.py:FlightRecorder":
         "one recorder per RSM, archiving records from every gateway "
         "worker and RSM operation thread (retention rings + counters)",
+    "tieredstorage_tpu/transform/batcher.py:WindowBatcher":
+        "one device queue per backend: every request thread submits into "
+        "the shared buckets while the flusher daemon drains them "
+        "(pending maps, in-flight count, coalescing counters)",
     "tieredstorage_tpu/metrics/slo.py:SloEngine":
         "one engine per RSM, ticked by every metrics scrape (gauge reads "
         "on exporter threads) and every GET /slo gateway worker",

@@ -175,6 +175,16 @@ def _collect_caches() -> dict[str, list[str]]:
     return _group_names(registry)
 
 
+def _collect_batch() -> dict[str, list[str]]:
+    from tieredstorage_tpu.metrics.batch_metrics import register_batch_metrics
+    from tieredstorage_tpu.metrics.core import MetricsRegistry
+    from tieredstorage_tpu.transform.batcher import WindowBatcher
+
+    registry = MetricsRegistry()
+    register_batch_metrics(registry, WindowBatcher(None))
+    return _group_names(registry)
+
+
 def _collect_backends() -> dict[str, list[str]]:
     from tieredstorage_tpu.storage.azure.metrics import AzureMetricCollector
     from tieredstorage_tpu.storage.gcs.metrics import GcsMetricCollector
@@ -243,6 +253,7 @@ def generate() -> str:
     for heading, collected in [
         ("RemoteStorageManager metrics", _collect_rsm()),
         ("Cache and thread-pool metrics", _collect_caches()),
+        ("Cross-request GCM batching metrics", _collect_batch()),
         ("Resilience metrics", _collect_resilience()),
         ("Replication metrics", _collect_replication()),
         ("Fleet metrics", _collect_fleet()),

@@ -173,6 +173,13 @@ class DefaultChunkManager(ChunkManager):
         # duck-typed, zero coupling).
         thread_counters = getattr(self._backend, "thread_dispatch_counters", None)
         counters_before = thread_counters() if thread_counters is not None else None
+        # Batch-evidence seam (ISSUE 15): with cross-request batching on,
+        # this request's launches ride the flusher thread — the per-thread
+        # dispatch counters above stay 0 by design, and the batcher's own
+        # evidence (coalesced windows, occupancy, shared batch id) is what
+        # proves which launch the request shared.
+        batch_seam = getattr(self._backend, "thread_batch_evidence", None)
+        batch_before = batch_seam() if batch_seam is not None else None
         try:
             with self.tracer.span(
                 "chunk.detransform", chunks=len(stored), bytes_in=stored_bytes,
@@ -198,6 +205,17 @@ class DefaultChunkManager(ChunkManager):
             flight.note("gcm.windows")
             flight.note("gcm.dispatches", dispatches)
             flight.note("gcm.hbm_roundtrips", roundtrips)
+        if batch_before is not None:
+            windows, occupancy_sum, last_batch_id = batch_seam()
+            batched = windows - batch_before[0]
+            if batched:
+                flight.note("gcm.batched_windows", batched)
+                flight.note(
+                    "gcm.batch_occupancy", occupancy_sum - batch_before[1]
+                )
+                # The shared-launch marker: records carrying the same
+                # gcm.batch:<id> stage rode the SAME device launch.
+                flight.stage(f"gcm.batch:{last_batch_id}")
         flight.stage("backend.detransformed")
         if self.on_fetch is not None:
             self.on_fetch(

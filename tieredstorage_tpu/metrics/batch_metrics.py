@@ -1,0 +1,84 @@
+"""Cross-request GCM batching metrics (ISSUE 15).
+
+Publishes the ``WindowBatcher``'s coalescing counters as supplier gauges
+and materializes two histograms in the ``batch-metrics`` group:
+
+- ``batch-occupancy`` — windows coalesced per shared launch (the lever:
+  ``dispatches_per_window`` is its reciprocal under load);
+- ``batch-added-wait-time-ms`` — how long each coalesced window waited in
+  the device queue before its flush launched (the price; bounded by
+  ``transform.batch.wait.ms`` and the deadline-aware flush floor).
+
+The batcher stays metrics-free: its ``on_flush`` hook is pointed at the
+histograms here, mirroring how the chunk manager's ``on_fetch`` feeds the
+latency histograms (fetch/chunk_manager.py).
+"""
+
+from __future__ import annotations
+
+from tieredstorage_tpu.metrics.core import Histogram, MetricName, MetricsRegistry
+
+BATCH_METRIC_GROUP = "batch-metrics"
+
+#: Occupancy buckets: exact small counts, then powers of two up to the
+#: plausible windows-per-flush ceiling (`transform.batch.windows`).
+_OCCUPANCY_BUCKETS = (1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64, 96, 128)
+
+
+def register_batch_metrics(registry: MetricsRegistry, batcher) -> None:
+    """Publish a ``WindowBatcher``'s counters + flush histograms."""
+
+    def gauge(name: str, supplier, description: str = "") -> None:
+        registry.add_gauge(
+            MetricName.of(name, BATCH_METRIC_GROUP, description), supplier
+        )
+
+    gauge("batch-windows-submitted-total",
+          lambda: float(batcher.windows_submitted),
+          "Decrypt windows routed through the cross-request batcher")
+    gauge("batch-coalesced-windows-total",
+          lambda: float(batcher.batched_windows),
+          "Windows that rode a SHARED merged launch")
+    gauge("batch-launches-total", lambda: float(batcher.launches),
+          "Merged flush launches (one fused dispatch each)")
+    gauge("batch-fast-path-windows-total",
+          lambda: float(batcher.fast_path_windows),
+          "Windows dispatched inline by the idle-batcher fast path "
+          "(zero added wait)")
+    gauge("batch-expired-windows-total",
+          lambda: float(batcher.expired_windows),
+          "Queued windows failed fast because their deadline expired "
+          "before launch (excluded from the pack)")
+    gauge("batch-launch-failures-total",
+          lambda: float(batcher.launch_failures),
+          "Merged flushes whose launch raised (every waiter woken with "
+          "the error)")
+    gauge("batch-mean-occupancy", lambda: float(batcher.mean_occupancy),
+          "Coalesced windows per merged launch since start")
+
+    occupancy = registry.sensor("gcm-batch.occupancy").ensure_stats(lambda: [
+        (
+            MetricName.of(
+                "batch-occupancy", BATCH_METRIC_GROUP,
+                "Windows coalesced per merged launch (histogram)",
+            ),
+            Histogram(buckets=_OCCUPANCY_BUCKETS),
+        ),
+    ])
+    added_wait = registry.sensor("gcm-batch.added-wait").ensure_stats(lambda: [
+        (
+            MetricName.of(
+                "batch-added-wait-time-ms", BATCH_METRIC_GROUP,
+                "Per-window queue wait before its merged flush launched "
+                "(ms, log-scale buckets)",
+            ),
+            Histogram(),
+        ),
+    ])
+
+    def on_flush(occ: int, added_wait_ms: list) -> None:
+        occupancy.record(float(occ))
+        for ms in added_wait_ms:
+            added_wait.record(float(ms))
+
+    batcher.on_flush = on_flush

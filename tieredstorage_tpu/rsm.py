@@ -861,6 +861,13 @@ class RemoteStorageManager:
             )
 
             register_hot_cache_metrics(registry, self._device_hot)
+        batcher = getattr(self._transform_backend, "batcher", None)
+        if batcher is not None:
+            from tieredstorage_tpu.metrics.batch_metrics import (
+                register_batch_metrics,
+            )
+
+            register_batch_metrics(registry, batcher)
 
     def _build_chunk_manager(self, backend) -> ChunkManager:
         factory = ChunkManagerFactory()

@@ -1,0 +1,487 @@
+"""Cross-request GCM dispatch batcher: one device queue for the decrypt path.
+
+PR 8 fused a whole window into ONE device launch — but batching stopped at
+the request boundary: under massed consumer replay a hundred concurrent
+fetches stage a hundred small packed windows and pay a hundred per-launch
+floors. Continuous-batching inference servers (Orca, OSDI '22; vLLM)
+showed the fix: coalesce *concurrent* requests into shared device
+launches. ``WindowBatcher`` applies the same shape to the GCM data plane:
+
+- ``TpuTransformBackend._decrypt_batch`` routes eligible windows here
+  (``transform.batch.enabled``); each caller blocks while its rows ride a
+  SHARED packed ``uint8[B, n_bytes + 16]`` launch and gets its own slice
+  of the one output buffer back (results demultiplexed per caller).
+- Grouping is by ``(data_key, aad, bucket_max_bytes(max_size))`` — the
+  SAME jit-shape ladder the unbatched varlen path quantizes through
+  (``ops/gcm.py``), so coalescing can never introduce a retrace; merged
+  row counts are padded up a power-of-two ladder for the same reason.
+- The flush policy is deadline-aware: a bucket flushes when its queued
+  windows or bytes reach the caps, when the oldest waiter has waited
+  ``wait_ms``, or when the oldest waiter's remaining deadline minus the
+  observed launch p95 hits the floor — so batching never converts an
+  on-time request into a deadline miss.
+- **Single-waiter fast path**: a submit that finds the batcher idle (no
+  queue, no launch in flight) dispatches inline through the ordinary
+  unbatched window path — light load pays ZERO added latency and keeps
+  byte-identical behavior (including the hot-tier retention hook).
+- **Per-row error isolation**: tags are verified per caller after the
+  merged fetch; one forged row fails that one request with
+  ``AuthenticationError``, never its batch-mates. A waiter whose deadline
+  expired before launch fails fast with ``DeadlineExceededException`` and
+  is excluded from the pack (it cannot poison the batch).
+
+Accounting: the flusher's launches land in the owning backend's
+``DispatchStats`` (one launch, one staging transfer, one fetch per flush),
+while each coalesced window still counts as a window — so
+``dispatches_per_window`` becomes ``<= 1/occupancy`` under concurrency and
+the ``make transform-demo`` gates (``<= 1``) hold by construction. The
+per-thread evidence seam (``thread_evidence``) lets the chunk manager
+flight-record which launch a request shared (``gcm.batch:<id>`` stage +
+occupancy counters on ``GET /debug/requests``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hmac
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from tieredstorage_tpu.security.aes import IV_SIZE, TAG_SIZE
+from tieredstorage_tpu.utils.locks import new_condition, note_mutation
+
+
+class BatcherStoppedError(RuntimeError):
+    """A window was submitted to (or stranded in) a stopped batcher."""
+
+
+def bucket_rows(n: int) -> int:
+    """Round a merged row count up to a power of two (min 8).
+
+    The merged launch's jit shape is ``(rows, bucket_bytes + 16)``; the
+    byte axis is already quantized by ``bucket_max_bytes``, and without a
+    row ladder every distinct occupancy would compile a fresh program.
+    Powers of two bound the compile set to ~log2(max rows) entries at a
+    worst-case 2x padded compute — padding rows are zero-filled one-block
+    GCM rows, identical to the mesh padding ``_stage_packed`` adds."""
+    if n < 1:
+        raise ValueError(f"row count must be >= 1, got {n}")
+    return 1 << max(3, (n - 1).bit_length())
+
+
+@dataclasses.dataclass
+class _PendingWindow:
+    """One caller's window, queued for a shared launch. Mutated by the
+    submitting thread before enqueue and by the flusher after dequeue; the
+    per-entry Event is the happens-before edge between them."""
+
+    payloads: list
+    sizes: list
+    ivs: np.ndarray
+    tags: list
+    n_bytes: int
+    enqueued_at: float
+    deadline_at: Optional[float]
+    event: threading.Event = dataclasses.field(default_factory=threading.Event)
+    result: Optional[list] = None
+    error: Optional[BaseException] = None
+    batch_id: int = 0
+    occupancy: int = 0
+    added_wait_ms: float = 0.0
+
+
+class WindowBatcher:
+    """Coalesces concurrent decrypt windows into shared packed launches.
+
+    One daemon flusher thread owns the device queue; submitting threads
+    block on their entry's event. All shared state mutates under the one
+    ``_cond`` (guarded-by checked + runtime-witnessed); the flush itself
+    runs OUTSIDE the lock so staging/launch never serializes submitters.
+    """
+
+    #: Flush when the oldest waiter's remaining deadline minus the observed
+    #: launch p95 drops to this floor (ms): the last moment a queued window
+    #: can still launch and land inside its budget.
+    DEADLINE_FLOOR_MS = 5.0
+    #: Launch-duration samples retained for the p95 estimate.
+    LAUNCH_SAMPLES = 64
+
+    #: Optional flush hook ``(occupancy, added_wait_ms_list)`` — the
+    #: batch-metrics group (metrics/batch_metrics.py) points it at the
+    #: occupancy and added-wait histograms.
+    on_flush: Optional[Callable] = None
+
+    def __init__(
+        self,
+        backend,
+        *,
+        wait_ms: float = 2.0,
+        max_windows: int = 16,
+        max_bytes: int = 64 << 20,
+        time_source: Callable[[], float] = time.monotonic,
+    ) -> None:
+        if wait_ms < 0:
+            raise ValueError(f"wait_ms must be >= 0, got {wait_ms}")
+        if max_windows < 2:
+            raise ValueError(f"max_windows must be >= 2, got {max_windows}")
+        if max_bytes < 1:
+            raise ValueError(f"max_bytes must be >= 1, got {max_bytes}")
+        self._backend = backend
+        self.wait_ms = float(wait_ms)
+        self.max_windows = int(max_windows)
+        self.max_bytes = int(max_bytes)
+        self._now = time_source
+        #: The ONE guard of every shared field below; doubles as the
+        #: flusher's wakeup condition (the admission-controller idiom, so
+        #: the lock-order checker sees wait() release the held lock).
+        self._cond = new_condition("batcher.WindowBatcher._cond")
+        #: bucket key (data_key, aad, bucket_bytes) -> queued entries.
+        self._buckets: dict[tuple, list[_PendingWindow]] = {}
+        self._launch_s: list[float] = []
+        self._inflight = 0
+        self._stopped = False
+        self._thread: Optional[threading.Thread] = None
+        self._tls = threading.local()
+        self._batch_seq = 0
+        # Counters (exported by metrics/batch_metrics.py).
+        self.windows_submitted = 0
+        self.fast_path_windows = 0
+        self.batched_windows = 0
+        self.launches = 0
+        self.expired_windows = 0
+        self.launch_failures = 0
+
+    # --------------------------------------------------------------- lifecycle
+    def start(self) -> "WindowBatcher":
+        """Spawn the flusher daemon (idempotent)."""
+        with self._cond:
+            if self._thread is not None:
+                return self
+            self._stopped = False
+            self._thread = threading.Thread(
+                target=self._run, name="gcm-window-batcher", daemon=True
+            )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop the flusher and drain any stranded waiters."""
+        with self._cond:
+            self._stopped = True
+            thread = self._thread
+            self._thread = None
+            self._cond.notify_all()
+        if thread is not None:
+            thread.join(timeout=30)
+        self.flush_now()
+
+    @property
+    def mean_occupancy(self) -> float:
+        """Coalesced windows per shared launch (fast-path dispatches are
+        occupancy-1 by definition and excluded)."""
+        with self._cond:
+            return self.batched_windows / self.launches if self.launches else 0.0
+
+    def thread_evidence(self) -> tuple[int, float, int]:
+        """This THREAD's cumulative (coalesced windows, occupancy sum, last
+        batch id) — the flight-recorder seam
+        (``TpuTransformBackend.thread_batch_evidence``). Thread-local by
+        construction: only the submitting thread writes its own cell."""
+        t = self._tls
+        return (
+            getattr(t, "windows", 0),
+            getattr(t, "occupancy_sum", 0.0),
+            getattr(t, "last_batch_id", 0),
+        )
+
+    # ------------------------------------------------------------------ submit
+    def submit(self, enc, payloads, sizes, ivs, tags) -> list:
+        """Decrypt one window, coalescing with concurrent submitters.
+
+        Blocks until the window's rows came back from a (possibly shared)
+        launch; returns the plaintext chunks or raises this CALLER's error
+        only (``AuthenticationError`` on its own rows,
+        ``DeadlineExceededException`` when its budget expired in queue)."""
+        from tieredstorage_tpu.ops import gcm as gcm_ops
+
+        with self._cond:
+            if self._stopped:
+                raise BatcherStoppedError("WindowBatcher is stopped")
+            self.windows_submitted += 1
+            note_mutation("batcher.WindowBatcher.windows_submitted")
+            fast = not self._buckets and self._inflight == 0
+            if fast:
+                self._inflight += 1
+                note_mutation("batcher.WindowBatcher._inflight")
+                self.fast_path_windows += 1
+                note_mutation("batcher.WindowBatcher.fast_path_windows")
+        if fast:
+            # Idle batcher: dispatch inline through the ordinary unbatched
+            # window path — light load pays zero added latency and keeps
+            # the hot-tier retention hook. While this launch runs, new
+            # arrivals queue behind `_inflight` and coalesce.
+            try:
+                return self._backend._decrypt_window(
+                    enc, payloads, sizes, ivs, tags
+                )
+            finally:
+                with self._cond:
+                    self._inflight -= 1
+                    note_mutation("batcher.WindowBatcher._inflight")
+                    if self._buckets:
+                        self._cond.notify()
+
+        from tieredstorage_tpu.utils import deadline as deadline_util
+
+        now = self._now()
+        remaining = deadline_util.remaining_s()
+        entry = _PendingWindow(
+            payloads=list(payloads),
+            sizes=list(sizes),
+            ivs=ivs,
+            tags=list(tags),
+            n_bytes=sum(sizes),
+            enqueued_at=now,
+            deadline_at=None if remaining is None else now + remaining,
+        )
+        key = (
+            bytes(enc.data_key),
+            bytes(enc.aad),
+            gcm_ops.bucket_max_bytes(max(sizes)),
+        )
+        with self._cond:
+            if self._stopped:
+                raise BatcherStoppedError("WindowBatcher is stopped")
+            self._buckets.setdefault(key, []).append(entry)
+            self._cond.notify()
+        # The flusher owns the entry from here; wait out the flush. The
+        # timeout is a liveness backstop only (deadline expiry is enforced
+        # by the flusher's fail-fast) — clamped to the caller's remaining
+        # budget plus slack when one exists.
+        timeout = None
+        if entry.deadline_at is not None:
+            timeout = max(0.0, entry.deadline_at - self._now()) + 60.0
+        if not entry.event.wait(timeout=timeout):
+            raise BatcherStoppedError(
+                "batched window was never flushed (flusher dead?)"
+            )
+        if entry.batch_id:
+            t = self._tls
+            t.windows = getattr(t, "windows", 0) + 1
+            t.occupancy_sum = getattr(t, "occupancy_sum", 0.0) + entry.occupancy
+            t.last_batch_id = entry.batch_id
+        if entry.error is not None:
+            raise entry.error
+        return entry.result
+
+    # ----------------------------------------------------------- flush policy
+    def _launch_p95_s(self) -> float:
+        """p95 of recent launch wall times (0 before the first sample) —
+        callers must hold ``_cond``."""
+        if not self._launch_s:
+            return 0.0
+        ordered = sorted(self._launch_s)
+        return ordered[min(len(ordered) - 1, int(0.95 * len(ordered)))]
+
+    def _due_keys_locked(self, now: float) -> tuple[list, Optional[float]]:
+        """(bucket keys due to flush now, seconds until the next one is).
+
+        A bucket is due when: queued windows >= ``max_windows``; queued
+        bytes >= ``max_bytes``; the oldest waiter aged past ``wait_ms``;
+        or the tightest waiter's remaining deadline minus the launch p95
+        estimate is at the ``DEADLINE_FLOOR_MS`` floor."""
+        due: list = []
+        next_wake: Optional[float] = None
+        p95 = self._launch_p95_s()
+        floor_s = self.DEADLINE_FLOOR_MS / 1000.0
+        wait_s = self.wait_ms / 1000.0
+        for key, entries in self._buckets.items():
+            if len(entries) >= self.max_windows:
+                due.append(key)
+                continue
+            if sum(e.n_bytes for e in entries) >= self.max_bytes:
+                due.append(key)
+                continue
+            wake = entries[0].enqueued_at + wait_s
+            deadlines = [
+                e.deadline_at for e in entries if e.deadline_at is not None
+            ]
+            if deadlines:
+                wake = min(wake, min(deadlines) - p95 - floor_s)
+            if wake <= now:
+                due.append(key)
+            elif next_wake is None or wake < next_wake:
+                next_wake = wake
+        timeout = None if next_wake is None else max(0.0, next_wake - now)
+        return due, timeout
+
+    def _take_locked(self, key: tuple) -> list:
+        """Pop a bucket's oldest entries up to the windows/bytes caps
+        (callers hold ``_cond``). A storm larger than one flush leaves the
+        remainder queued — still due, so the flusher drains it in capped
+        launches whose shapes stay on the warmed row ladder instead of
+        compiling one giant program."""
+        entries = self._buckets.get(key)
+        take: list = []
+        total = 0
+        while entries and len(take) < self.max_windows and total < self.max_bytes:
+            e = entries.pop(0)
+            take.append(e)
+            total += e.n_bytes
+        if not entries:
+            self._buckets.pop(key, None)
+        return take
+
+    def _run(self) -> None:
+        """Flusher daemon: wait for a due bucket, take a capped batch,
+        flush outside the lock — the one device queue every stream
+        shares."""
+        while True:
+            with self._cond:
+                if self._stopped:
+                    return
+                due, timeout = self._due_keys_locked(self._now())
+                if not due:
+                    self._cond.wait(timeout)
+                    continue
+                groups = [(key, self._take_locked(key)) for key in due]
+                self._inflight += 1
+                note_mutation("batcher.WindowBatcher._inflight")
+            try:
+                for key, entries in groups:
+                    self._flush_group(key, entries)
+            finally:
+                with self._cond:
+                    self._inflight -= 1
+                    note_mutation("batcher.WindowBatcher._inflight")
+
+    def flush_now(self) -> int:
+        """Flush every queued window synchronously on the calling thread
+        (tests and ``stop`` drain), in capped batches; returns the number
+        of flushes."""
+        flushes = 0
+        while True:
+            with self._cond:
+                groups = [
+                    (key, self._take_locked(key))
+                    for key in list(self._buckets.keys())
+                ]
+            if not groups:
+                return flushes
+            for key, entries in groups:
+                if entries:
+                    self._flush_group(key, entries)
+                    flushes += 1
+
+    # ------------------------------------------------------------------ flush
+    def _flush_group(self, key: tuple, entries: list) -> None:
+        """ONE shared launch for a bucket's queued windows: merge rows into
+        a single packed buffer, stage + launch through the owning backend
+        (donation and DispatchStats intact), fetch once, then demultiplex
+        per caller with per-row tag verification. The np.asarray here is
+        the merged flush's ONE sanctioned device->host materialization."""
+        from tieredstorage_tpu.ops import gcm as gcm_ops
+        from tieredstorage_tpu.transform.api import AuthenticationError
+        from tieredstorage_tpu.utils.deadline import DeadlineExceededException
+
+        now = self._now()
+        live: list[_PendingWindow] = []
+        expired = 0
+        for e in entries:
+            if e.deadline_at is not None and e.deadline_at <= now:
+                # Fail fast WITHOUT poisoning the batch: the expired waiter
+                # never joins the pack, its batch-mates launch on time.
+                e.error = DeadlineExceededException(
+                    "deadline expired while queued for a batched GCM launch"
+                )
+                e.event.set()
+                expired += 1
+            else:
+                live.append(e)
+        if expired:
+            with self._cond:
+                self.expired_windows += expired
+                note_mutation("batcher.WindowBatcher.expired_windows")
+        if not live:
+            return
+
+        backend = self._backend
+        try:
+            ctx = gcm_ops.make_varlen_context(key[0], key[1], key[2])
+            n_bytes = ctx.max_bytes
+            rows = sum(len(e.sizes) for e in live)
+            packed = np.zeros((bucket_rows(rows), n_bytes + TAG_SIZE), np.uint8)
+            r = 0
+            for e in live:
+                for i, p in enumerate(e.payloads):
+                    packed[r, : e.sizes[i]] = np.frombuffer(p, np.uint8)
+                    packed[r, n_bytes : n_bytes + IV_SIZE] = e.ivs[i]
+                    r += 1
+                packed[r - len(e.sizes) : r, n_bytes + IV_SIZE :] = (
+                    np.asarray(e.sizes, dtype="<u4").view(np.uint8).reshape(-1, 4)
+                )
+            # Row-ladder padding mirrors _stage_packed's mesh padding: one
+            # 16-byte block per dummy row (zero-length rows are excluded
+            # by the varlen contract).
+            packed[rows:, n_bytes + IV_SIZE] = 16
+            t0 = self._now()
+            staged = backend._stage_packed(packed, True)
+            out = backend._launch_packed(ctx, staged, True, decrypt=True)
+            host = np.asarray(out)
+            launch_s = self._now() - t0
+        except BaseException as exc:  # noqa: BLE001 - every waiter must wake
+            with self._cond:
+                self.launch_failures += 1
+                note_mutation("batcher.WindowBatcher.launch_failures")
+            for e in live:
+                e.error = exc
+                e.event.set()
+            return
+        backend._note_batched_fetch()
+        for e in live:
+            backend._note_batched_window(e.n_bytes)
+
+        occupancy = len(live)
+        with self._cond:
+            self._batch_seq += 1
+            note_mutation("batcher.WindowBatcher._batch_seq")
+            batch_id = self._batch_seq
+            self.launches += 1
+            note_mutation("batcher.WindowBatcher.launches")
+            self.batched_windows += occupancy
+            note_mutation("batcher.WindowBatcher.batched_windows")
+            self._launch_s.append(launch_s)
+            if len(self._launch_s) > self.LAUNCH_SAMPLES:
+                del self._launch_s[0]
+
+        added_waits: list[float] = []
+        r = 0
+        for e in live:
+            n = len(e.sizes)
+            bad = [
+                i
+                for i in range(n)
+                if not hmac.compare_digest(
+                    host[r + i, n_bytes:].tobytes(), e.tags[i]
+                )
+            ]
+            if bad:
+                # Per-row error isolation: one forged row fails ITS
+                # request; batch-mates still get their plaintext.
+                e.error = AuthenticationError(f"GCM tag mismatch on chunks {bad}")
+            else:
+                e.result = [
+                    host[r + i, : e.sizes[i]].tobytes() for i in range(n)
+                ]
+            r += n
+            e.batch_id = batch_id
+            e.occupancy = occupancy
+            e.added_wait_ms = max(0.0, (t0 - e.enqueued_at) * 1000.0)
+            added_waits.append(e.added_wait_ms)
+            e.event.set()
+        hook = self.on_flush
+        if hook is not None:
+            hook(occupancy, added_waits)

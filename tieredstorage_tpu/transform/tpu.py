@@ -55,6 +55,14 @@ from tieredstorage_tpu.transform.api import (
 )
 
 
+def _parse_bool(value) -> bool:
+    """Config booleans arrive as real bools from dict configs and as
+    strings from properties files — accept both spellings."""
+    if isinstance(value, str):
+        return value.strip().lower() in ("1", "true", "yes")
+    return bool(value)
+
+
 def _spanned(name: str, count=len, n_bytes=None):
     """Trace a backend stage; `count` maps the first positional arg to the
     span's chunks attribute (mirrors rsm._traced — one wrapper, no _inner
@@ -181,6 +189,10 @@ class TpuTransformBackend(TransformBackend):
         self._pool: Optional[ThreadPoolExecutor] = None
         self._stats_lock = new_lock("tpu.TpuTransformBackend._stats_lock")
         self.dispatch_stats = DispatchStats()
+        #: Cross-request decrypt batcher (transform/batcher.py), built by
+        #: `configure()` from `transform.batch.enabled` or explicitly via
+        #: `enable_batching()`; None = every window dispatches unbatched.
+        self.batcher = None
 
     def reset_dispatch_stats(self) -> DispatchStats:
         """Swap in fresh counters; returns the retired snapshot."""
@@ -212,6 +224,57 @@ class TpuTransformBackend(TransformBackend):
         # normalizes a 1-device mesh to the fallback plan).
         self._mesh_spec = configs.get("mesh.devices", "all")
         self._plan = None  # resolve lazily at the first staged window
+        if _parse_bool(configs.get("batch.enabled", False)):
+            self.enable_batching(
+                wait_ms=float(configs.get("batch.wait.ms", 2)),
+                max_windows=int(configs.get("batch.windows", 16)),
+            )
+
+    def enable_batching(
+        self, *, wait_ms: float = 2.0, max_windows: int = 16,
+        max_bytes: Optional[int] = None,
+    ):
+        """Build + start the cross-request decrypt batcher (idempotent).
+        The flush byte cap defaults to the window byte cap
+        (`transform.batch.bytes`): a merged launch never exceeds the HBM
+        budget one pipelined window was already sized for."""
+        if self.batcher is None:
+            from tieredstorage_tpu.transform.batcher import WindowBatcher
+
+            self.batcher = WindowBatcher(
+                self,
+                wait_ms=wait_ms,
+                max_windows=max_windows,
+                max_bytes=(
+                    self.preferred_batch_bytes if max_bytes is None else max_bytes
+                ),
+            ).start()
+        return self.batcher
+
+    def thread_batch_evidence(self) -> tuple[int, float, int]:
+        """This THREAD's cumulative (coalesced windows, occupancy sum,
+        last shared batch id) — the flight recorder's batch-evidence seam
+        (fetch/chunk_manager.py differences it around one detransform so
+        `GET /debug/requests` shows which requests shared a launch).
+        Duck-typed like `thread_dispatch_counters`."""
+        batcher = self.batcher
+        return (0, 0.0, 0) if batcher is None else batcher.thread_evidence()
+
+    def _note_batched_window(self, n_bytes: int) -> None:
+        """Window accounting for a batched decrypt (the flusher launches;
+        every coalesced window still counts, so `dispatches_per_window`
+        reads `launches/windows <= 1/occupancy`)."""
+        with self._stats_lock:
+            self.dispatch_stats.windows += 1
+            self.dispatch_stats.bytes_in += n_bytes
+            note_mutation("tpu.TpuTransformBackend.dispatch_stats")
+
+    def _note_batched_fetch(self) -> None:
+        """One device→host fetch for a merged flush (shared by every
+        window it coalesced)."""
+        with self._stats_lock:
+            self.dispatch_stats.d2h_fetches += 1
+            note_mutation("tpu.TpuTransformBackend.dispatch_stats")
 
     def mesh_plan(self) -> MeshPlan:
         """The resolved sharding plan (builds the mesh on first use)."""
@@ -225,6 +288,9 @@ class TpuTransformBackend(TransformBackend):
         return self._pool
 
     def close(self) -> None:
+        if self.batcher is not None:
+            self.batcher.stop()
+            self.batcher = None
         if self._pool is not None:
             self._pool.shutdown(wait=False)
             self._pool = None
@@ -509,7 +575,13 @@ class TpuTransformBackend(TransformBackend):
         """Fetch-direction window through the same fused single-dispatch
         path as encrypt: one packed staging transfer, one device program
         computing plaintext + EXPECTED tags, one fetch; tags verified
-        host-side against the received ones."""
+        host-side against the received ones. With cross-request batching
+        enabled (`transform.batch.enabled`, transform/batcher.py) the
+        window instead joins the shared device queue and may ride ONE
+        merged launch with windows from concurrent requests — the
+        single-waiter fast path falls straight back to `_decrypt_window`,
+        so light load is byte- and latency-identical to the unbatched
+        path."""
         enc = opts.encryption
         for i, c in enumerate(chunks):
             if len(c) < IV_SIZE + TAG_SIZE:
@@ -520,7 +592,23 @@ class TpuTransformBackend(TransformBackend):
         received_tags = [c[-TAG_SIZE:] for c in chunks]
         sizes = [len(c) - IV_SIZE - TAG_SIZE for c in chunks]
         payloads = [c[IV_SIZE:-TAG_SIZE] for c in chunks]
+        batcher = self.batcher
+        if batcher is not None and min(sizes) > 0:
+            # Zero-length rows are excluded by the varlen window contract
+            # the merged launch uses; such windows take the direct path.
+            return batcher.submit(enc, payloads, sizes, ivs, received_tags)
+        return self._decrypt_window(enc, payloads, sizes, ivs, received_tags)
 
+    def _decrypt_window(
+        self, enc, payloads: list, sizes: list[int], ivs: np.ndarray,
+        received_tags: list,
+    ) -> list[bytes]:
+        """The unbatched decrypt window: ONE staging transfer, ONE fused
+        launch, ONE fetch for this caller's rows alone. Also the
+        batcher's single-waiter fast path (zero added latency at light
+        load — including the hot-tier retention hook, which only fires
+        here: a merged buffer interleaves requests and is never offered
+        for retention)."""
         varlen = len(set(sizes)) != 1
         if varlen:
             ctx = make_varlen_context(enc.data_key, enc.aad, max(sizes))
@@ -542,7 +630,7 @@ class TpuTransformBackend(TransformBackend):
             note_mutation("tpu.TpuTransformBackend.dispatch_stats")
         bad = [
             i
-            for i in range(len(chunks))
+            for i in range(len(sizes))
             if not hmac.compare_digest(
                 host[i, n_bytes:].tobytes(), received_tags[i]
             )
@@ -552,7 +640,7 @@ class TpuTransformBackend(TransformBackend):
         hook = self.on_decrypt_window
         if hook is not None:
             hook(out, sizes, n_bytes, self.mesh_plan().size)
-        return [host[i, : sizes[i]].tobytes() for i in range(len(chunks))]
+        return [host[i, : sizes[i]].tobytes() for i in range(len(sizes))]
 
 
 def _definition():
@@ -573,7 +661,8 @@ def _definition():
         doc="Window byte cap. With pipeline.depth staged windows in flight, "
             "each window pins roughly 5x its bytes of HBM intermediates; the "
             "default 64 MiB keeps the steady state near ~1.3 GiB of a v5e's "
-            "16 GiB.",
+            "16 GiB. Also the flush byte cap of a merged cross-request "
+            "decrypt launch (batch.enabled).",
     ))
     d.define(ConfigKey(
         "pipeline.depth", "int", default=3, validator=in_range(1, None),
@@ -581,6 +670,33 @@ def _definition():
         doc="Double-buffer depth of transform_windows: staged windows kept "
             "in flight before blocking on the oldest (host compress || "
             "device encrypt || device->host copy).",
+    ))
+    d.define(ConfigKey(
+        "batch.enabled", "bool", default=False, importance="medium",
+        doc="Coalesce decrypt windows from CONCURRENT requests into shared "
+            "fused launches (transform/batcher.py): one device queue whose "
+            "flush policy is deadline-aware, grouped by the bucket_max_bytes "
+            "jit-shape ladder so coalescing never retraces. A submit that "
+            "finds the batcher idle dispatches inline (the single-waiter "
+            "fast path), so light load pays zero added latency. Default "
+            "off: every window dispatches unbatched, exactly the pre-batch "
+            "path.",
+    ))
+    d.define(ConfigKey(
+        "batch.wait.ms", "long", default=2, validator=in_range(0, None),
+        importance="medium",
+        doc="Max added wait (ms) a queued decrypt window tolerates before "
+            "its bucket flushes regardless of occupancy. Flushes also fire "
+            "when batch.windows or batch.bytes is reached, or when the "
+            "oldest waiter's remaining deadline minus the observed launch "
+            "p95 hits the floor.",
+    ))
+    d.define(ConfigKey(
+        "batch.windows", "int", default=16, validator=in_range(2, None),
+        importance="medium",
+        doc="Max windows coalesced into one shared decrypt launch (the "
+            "occupancy cap per flush); batch.bytes (the window byte cap) "
+            "bounds the merged launch's bytes.",
     ))
     d.define(ConfigKey(
         "mesh.devices", "int", default=0, validator=in_range(0, None),

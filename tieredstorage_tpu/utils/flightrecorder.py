@@ -110,6 +110,14 @@ class RequestRecord:
             out["gcm_hbm_roundtrips_per_window"] = round(
                 self.counters.get("gcm.hbm_roundtrips", 0.0) / windows, 3
             )
+        batched = self.counters.get("gcm.batched_windows", 0.0)
+        if batched:
+            # Mean occupancy of the shared launches this request's windows
+            # rode (ISSUE 15); the per-launch identity is the
+            # `gcm.batch:<id>` stage marker.
+            out["gcm_batch_occupancy"] = round(
+                self.counters.get("gcm.batch_occupancy", 0.0) / batched, 3
+            )
         return out
 
 

@@ -88,6 +88,12 @@ DEFAULT_TARGETS = [
     # wrong requests as evidence.
     ("tieredstorage_tpu/utils/flightrecorder.py", ["tests/test_flight_recorder.py"]),
     ("tieredstorage_tpu/metrics/slo.py", ["tests/test_slo.py"]),
+    # ISSUE 15: the cross-request batcher's flush-policy arithmetic
+    # (windows/bytes/age/deadline-floor triggers, capped takes, the row
+    # ladder) and the per-caller demux are pure logic; an operator flip
+    # silently stops coalescing, mixes buckets, or hands a caller its
+    # batch-mate's rows.
+    ("tieredstorage_tpu/transform/batcher.py", ["tests/test_window_batcher.py"]),
 ]
 
 _CMP_SWAP = {

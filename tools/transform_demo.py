@@ -194,6 +194,61 @@ def run(out_path: pathlib.Path) -> int:
         gcm._gcm_process_batch.clear_cache()
         gcm._gcm_varlen_batch.clear_cache()
 
+    # 3c. Batched-mode cross-check (ISSUE 15): the SAME decrypt workload
+    # through a backend with cross-request batching enabled, submitted by
+    # concurrent threads so windows coalesce into shared launches. Every
+    # PR-8/13 gate must hold THROUGH the batcher: dispatches_per_window
+    # and hbm_roundtrips_per_window stay <= 1 (they drop below 1 — that
+    # is the point), every merged launch still donates its staged buffer,
+    # and the demultiplexed bytes are identical to the unbatched path's.
+    import threading
+
+    batched = TpuTransformBackend()
+    batched.enable_batching(wait_ms=150, max_windows=8)
+    submissions = [list(w_out) for w_out in out_windows] * 2
+    results: list = [None] * len(submissions)
+    errors: list = []
+    barrier = threading.Barrier(len(submissions))
+
+    def decrypt_one(i: int) -> None:
+        try:
+            barrier.wait(timeout=60)
+            results[i] = batched.detransform(submissions[i], d_opts)
+        except Exception as exc:  # noqa: BLE001 - reported as a gate fail
+            errors.append((i, f"{type(exc).__name__}: {exc}"))
+
+    threads = [
+        threading.Thread(target=decrypt_one, args=(i,))
+        for i in range(len(submissions))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    bstats = batched.dispatch_stats
+    batcher = batched.batcher
+    report["batched_dispatch_stats"] = bstats.as_dict()
+    report["batched_mean_occupancy"] = round(batcher.mean_occupancy, 3)
+    report["batched_coalesced_windows"] = batcher.batched_windows
+    expected = [c for w in windows for c in w] * 2
+    flat_results = [c for r in results for c in (r or [])]
+    checks["batched_parity_with_unbatched_path"] = (
+        not errors and flat_results == expected
+    )
+    checks["batched_dispatches_per_window_le_1"] = (
+        0.0 < bstats.dispatches_per_window <= 1.0
+    )
+    checks["batched_hbm_roundtrips_per_window_le_1"] = (
+        bstats.hbm_roundtrips_per_window <= 1.0
+    )
+    checks["batched_donation_survives_merge"] = (
+        bstats.donated_buffers == bstats.dispatches
+    )
+    checks["batched_coalescing_engaged"] = (
+        batcher.batched_windows >= 2 and batcher.mean_occupancy > 1.0
+    )
+    batched.close()
+
     # 4. Eligibility at the default bench shapes is pure host logic.
     from tieredstorage_tpu.ops.aes_pallas import use_pallas_aes
     from tieredstorage_tpu.ops.gf128 import ghash_agg_plan
@@ -224,6 +279,9 @@ def run(out_path: pathlib.Path) -> int:
         f"(ladder control "
         f"{loaded['ladder_hbm_roundtrips_per_window']}) "
         f"bytes_per_dispatch={loaded['dispatch_stats']['bytes_per_dispatch']} "
+        f"batched_mode dpw="
+        f"{loaded['batched_dispatch_stats']['dispatches_per_window']} "
+        f"occupancy={loaded['batched_mean_occupancy']} "
         f"in {loaded['elapsed_ms']} ms -> {out_path}"
     )
     return 0 if loaded["ok"] else 1
