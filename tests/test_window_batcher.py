@@ -202,7 +202,7 @@ class TestFlushPolicy:
         batcher = self.make(wait_ms=10_000.0)  # age never fires here
         _, wire = make_window(5, [512] * 2)
         with batcher._cond:
-            batcher._launch_s.extend([0.010] * 19 + [0.040])  # p95 = 40ms
+            batcher._launch_s.extend([0.040] * 20)  # p95 = 40ms
             batcher._buckets[("k", "a", 1024)] = [
                 _entry(wire, now=0.0, deadline_at=0.100)
             ]
@@ -212,12 +212,34 @@ class TestFlushPolicy:
         due, _ = self.due(batcher, 0.056)
         assert due == [("k", "a", 1024)]
 
-    def test_launch_p95_empty_is_zero(self):
+    def test_launch_p95_nearest_rank(self):
         batcher = self.make()
         with batcher._cond:
             assert batcher._launch_p95_s() == 0.0
             batcher._launch_s.extend([0.001, 0.002, 0.003])
-            assert batcher._launch_p95_s() == pytest.approx(0.003)
+            # nearest-rank index int(0.95 * 2) = 1
+            assert batcher._launch_p95_s() == pytest.approx(0.002)
+            batcher._launch_s[:] = [i / 1000.0 for i in range(1, 21)]
+            # 20 samples: index int(0.95 * 19) = 18 -> the 19 ms sample
+            assert batcher._launch_p95_s() == pytest.approx(0.019)
+
+    def test_added_wait_is_exact_on_a_fake_clock(self):
+        batcher = self.make(wait_ms=1.0)
+        plain, wire = make_window(7, [512])
+        entry = _entry(wire, now=1.0)
+        # Real flush through the backend, timed by the fake clock: the
+        # launch starts at t=3.5, so the queued window waited exactly
+        # (3.5 - 1.0) s = 2500 ms.
+        key = (bytes(DK.data_key), bytes(DK.aad), 1024)
+        waits: list = []
+        batcher.on_flush = lambda occ, added: waits.extend(added)
+        with batcher._cond:
+            batcher._buckets[key] = [entry]
+        self.clock[0] = 3.5
+        assert batcher.flush_now() == 1
+        assert entry.error is None and entry.result == plain
+        assert entry.added_wait_ms == pytest.approx(2500.0)
+        assert waits == [pytest.approx(2500.0)]
 
     def test_take_locked_caps_windows_and_bytes_fifo(self):
         batcher = self.make(max_windows=2, max_bytes=10_000)

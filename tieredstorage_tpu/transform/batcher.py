@@ -284,7 +284,9 @@ class WindowBatcher:
         if not self._launch_s:
             return 0.0
         ordered = sorted(self._launch_s)
-        return ordered[min(len(ordered) - 1, int(0.95 * len(ordered)))]
+        # Nearest-rank on the closed index range [0, n-1]: in range by
+        # construction, no clamp needed.
+        return ordered[int(0.95 * (len(ordered) - 1))]
 
     def _due_keys_locked(self, now: float) -> tuple[list, Optional[float]]:
         """(bucket keys due to flush now, seconds until the next one is).
