@@ -129,6 +129,9 @@ class TestValidation:
             WindowBatcher(backend, max_windows=1)
         with pytest.raises(ValueError):
             WindowBatcher(backend, max_bytes=0)
+        # Exact boundary values are legal.
+        ok = WindowBatcher(backend, wait_ms=0, max_windows=2, max_bytes=1)
+        assert (ok.wait_ms, ok.max_windows, ok.max_bytes) == (0.0, 2, 1)
         backend.close()
 
     def test_stopped_batcher_refuses_submit(self):
@@ -388,6 +391,40 @@ class TestCoalescing:
         assert batcher.launches == 1
         # Expired windows never count as launched windows in the stats.
         assert backend.dispatch_stats.windows == 1
+        backend.close()
+
+    def test_wait_grace_outlives_an_expired_deadline(self):
+        """A waiter whose budget is tiny still outlives it by WAIT_GRACE_S:
+        the flusher's deadline fail-fast (not a spurious wait timeout) is
+        what reports the expiry."""
+        from tieredstorage_tpu.utils.deadline import Deadline, deadline_scope
+
+        backend = TpuTransformBackend()
+        batcher = WindowBatcher(backend, wait_ms=50)
+        batcher.WAIT_GRACE_S = 0.5
+        release = park_fast_path(batcher)
+        _, wire = make_window(35, [600])
+        payloads, sizes, ivs, tags = parse_wire(wire)
+        box: list = [None, None]
+
+        def run():
+            try:
+                with deadline_scope(Deadline.after(0.02)):
+                    box[0] = batcher.submit(DK, payloads, sizes, ivs, tags)
+            except BaseException as exc:  # noqa: BLE001
+                box[1] = exc
+
+        t = threading.Thread(target=run)
+        t.start()
+        wait_queued(batcher, 1)
+        time.sleep(0.05)  # let the 20 ms budget expire in queue
+        batcher.flush_now()
+        release()
+        t.join(timeout=30)
+        # The grace kept the waiter alive long enough to receive the
+        # flusher's verdict — DeadlineExceeded, never BatcherStoppedError.
+        assert isinstance(box[1], DeadlineExceededException), box
+        assert batcher.expired_windows == 1
         backend.close()
 
     def test_launch_failure_wakes_every_waiter(self):

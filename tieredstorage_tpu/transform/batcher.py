@@ -108,6 +108,10 @@ class WindowBatcher:
     DEADLINE_FLOOR_MS = 5.0
     #: Launch-duration samples retained for the p95 estimate.
     LAUNCH_SAMPLES = 64
+    #: Liveness-backstop slack past a waiter's own deadline: the waiter
+    #: outlives its budget by this much so the flusher's fail-fast (not a
+    #: spurious wait timeout) is what reports deadline expiry.
+    WAIT_GRACE_S = 60.0
 
     #: Optional flush hook ``(occupancy, added_wait_ms_list)`` — the
     #: batch-metrics group (metrics/batch_metrics.py) points it at the
@@ -263,7 +267,9 @@ class WindowBatcher:
         # budget plus slack when one exists.
         timeout = None
         if entry.deadline_at is not None:
-            timeout = max(0.0, entry.deadline_at - self._now()) + 60.0
+            timeout = (
+                max(0.0, entry.deadline_at - self._now()) + self.WAIT_GRACE_S
+            )
         if not entry.event.wait(timeout=timeout):
             raise BatcherStoppedError(
                 "batched window was never flushed (flusher dead?)"
