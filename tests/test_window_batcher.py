@@ -226,6 +226,38 @@ class TestFlushPolicy:
             # 20 samples: index int(0.95 * 19) = 18 -> the 19 ms sample
             assert batcher._launch_p95_s() == pytest.approx(0.019)
 
+    def test_wait_timeout_arithmetic_is_exact(self):
+        batcher = self.make()
+        batcher.WAIT_GRACE_S = 0.5
+        self.clock[0] = 2.0
+        assert batcher._wait_timeout_s(
+            _entry(make_window(8, [256])[1], deadline_at=5.0)
+        ) == pytest.approx(3.5)
+        # Expired budget clamps to the grace alone; no deadline = None.
+        assert batcher._wait_timeout_s(
+            _entry(make_window(8, [256])[1], deadline_at=1.0)
+        ) == pytest.approx(0.5)
+        assert batcher._wait_timeout_s(
+            _entry(make_window(8, [256])[1])
+        ) is None
+
+    def test_exactly_expired_entry_is_failed_fast(self):
+        """deadline_at == now is EXPIRED (<=, not <): a budget with zero
+        remaining must never launch."""
+        batcher = self.make()
+        plain, wire = make_window(9, [512])
+        on_time = _entry(wire, now=0.0, deadline_at=4.0)
+        boundary = _entry(wire, now=0.0, deadline_at=3.5)
+        key = (bytes(DK.data_key), bytes(DK.aad), 1024)
+        with batcher._cond:
+            batcher._buckets[key] = [on_time, boundary]
+        self.clock[0] = 3.5
+        assert batcher.flush_now() == 1
+        assert isinstance(boundary.error, DeadlineExceededException)
+        assert boundary.result is None
+        assert on_time.error is None and on_time.result == plain
+        assert batcher.expired_windows == 1
+
     def test_added_wait_is_exact_on_a_fake_clock(self):
         batcher = self.make(wait_ms=1.0)
         plain, wire = make_window(7, [512])

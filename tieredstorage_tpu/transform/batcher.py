@@ -265,12 +265,7 @@ class WindowBatcher:
         # timeout is a liveness backstop only (deadline expiry is enforced
         # by the flusher's fail-fast) — clamped to the caller's remaining
         # budget plus slack when one exists.
-        timeout = None
-        if entry.deadline_at is not None:
-            timeout = (
-                max(0.0, entry.deadline_at - self._now()) + self.WAIT_GRACE_S
-            )
-        if not entry.event.wait(timeout=timeout):
+        if not entry.event.wait(timeout=self._wait_timeout_s(entry)):
             raise BatcherStoppedError(
                 "batched window was never flushed (flusher dead?)"
             )
@@ -282,6 +277,15 @@ class WindowBatcher:
         if entry.error is not None:
             raise entry.error
         return entry.result
+
+    def _wait_timeout_s(self, entry: _PendingWindow) -> Optional[float]:
+        """A queued waiter's liveness backstop: its remaining deadline
+        budget plus ``WAIT_GRACE_S`` of slack (None = wait indefinitely for
+        an unconstrained caller — the flusher's wait_ms bound is the
+        pacing, not this)."""
+        if entry.deadline_at is None:
+            return None
+        return max(0.0, entry.deadline_at - self._now()) + self.WAIT_GRACE_S
 
     # ----------------------------------------------------------- flush policy
     def _launch_p95_s(self) -> float:
