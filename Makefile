@@ -150,9 +150,16 @@ hot-demo:
 # with cross-request GCM batching on vs off (byte parity, mean batch
 # occupancy > 1, launches-per-window strictly below the unbatched control,
 # p99 within SLO by the PR-14 engine, flight records carrying the shared-
-# launch evidence). Writes artifacts/load_report.json +
-# artifacts/BENCH_LOAD.json (the committed BENCH_LOAD_r01.json trajectory
-# point) and re-validates both.
+# launch evidence). ISSUE 16 put the integrity daemons INSIDE the chaos
+# window: every instance runs the scrubber + anti-entropy repairer on
+# ~1s periods through both kills (each survivor must show verification
+# progress strictly after the replica kill, zero corrupt chunks, SLO
+# verdicts still all-ok), and the capacity probe re-runs with
+# background-work-class scrub verification racing the same device queue —
+# the work-class scheduler must keep the fetch SLO verdict ok while scrub
+# throughput stays > 0 (fetch p99 with/without active scrub is recorded).
+# Writes artifacts/load_report.json + artifacts/BENCH_LOAD.json (the
+# committed BENCH_LOAD_r01.json trajectory point) and re-validates both.
 load-demo:
 	TSTPU_LOCK_WITNESS=1 $(PYTHON) tools/load_demo.py --out artifacts/load_report.json --bench-out artifacts/BENCH_LOAD.json
 
@@ -183,7 +190,7 @@ lint: analyze
 # /root/reference/build.gradle:24): flips operators in core pure-logic
 # modules and requires the owning suites to notice.
 mutation:
-	$(PYTHON) tools/mutation_test.py --budget 96
+	$(PYTHON) tools/mutation_test.py --budget 120
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -37,6 +37,7 @@ from tieredstorage_tpu.transform.batcher import (  # noqa: E402
     _PendingWindow,
     bucket_rows,
 )
+from tieredstorage_tpu.transform.scheduler import LATENCY  # noqa: E402
 from tieredstorage_tpu.transform.tpu import TpuTransformBackend  # noqa: E402
 from tieredstorage_tpu.utils.deadline import (  # noqa: E402
     DeadlineExceededException,
@@ -44,6 +45,9 @@ from tieredstorage_tpu.utils.deadline import (  # noqa: E402
 
 DK = AesEncryptionProvider.create_data_key_and_aad()
 D_OPTS = DetransformOptions(encryption=DK)
+#: A synthetic latency-class decrypt bucket key (work_class, decrypt,
+#: data_key, aad, bucket_bytes) for flush-policy tests on a fake clock.
+KEY = (LATENCY, True, "k", "a", 1024)
 
 
 def make_window(seed: int, sizes) -> tuple[list[bytes], list[bytes]]:
@@ -176,44 +180,44 @@ class TestFlushPolicy:
         batcher = self.make()
         _, wire = make_window(2, [512] * 2)
         with batcher._cond:
-            batcher._buckets[("k", "a", 1024)] = [_entry(wire, now=0.0)]
+            batcher._buckets[KEY] = [_entry(wire, now=0.0)]
         due, timeout = self.due(batcher, 0.004)
         assert due == [] and timeout == pytest.approx(0.006)
         due, timeout = self.due(batcher, 0.010)
-        assert due == [("k", "a", 1024)] and timeout is None
+        assert due == [KEY] and timeout is None
 
     def test_windows_trigger_fires_before_age(self):
         batcher = self.make(max_windows=3)
         _, wire = make_window(3, [512] * 2)
         entries = [_entry(wire, now=0.0) for _ in range(3)]
         with batcher._cond:
-            batcher._buckets[("k", "a", 1024)] = entries
+            batcher._buckets[KEY] = entries
         due, _ = self.due(batcher, 0.0)
-        assert due == [("k", "a", 1024)]
+        assert due == [KEY]
 
     def test_bytes_trigger_fires_before_age(self):
         batcher = self.make(max_bytes=1500)
         _, wire = make_window(4, [900] * 1)
         with batcher._cond:
-            batcher._buckets[("k", "a", 1024)] = [
+            batcher._buckets[KEY] = [
                 _entry(wire, now=0.0), _entry(wire, now=0.0),
             ]
         due, _ = self.due(batcher, 0.0)
-        assert due == [("k", "a", 1024)]
+        assert due == [KEY]
 
     def test_deadline_floor_trigger_uses_launch_p95(self):
         batcher = self.make(wait_ms=10_000.0)  # age never fires here
         _, wire = make_window(5, [512] * 2)
         with batcher._cond:
             batcher._launch_s.extend([0.040] * 20)  # p95 = 40ms
-            batcher._buckets[("k", "a", 1024)] = [
+            batcher._buckets[KEY] = [
                 _entry(wire, now=0.0, deadline_at=0.100)
             ]
         # wake = deadline - p95 - floor = 100 - 40 - 5 = 55ms
         due, timeout = self.due(batcher, 0.050)
         assert due == [] and timeout == pytest.approx(0.005)
         due, _ = self.due(batcher, 0.056)
-        assert due == [("k", "a", 1024)]
+        assert due == [KEY]
 
     def test_launch_p95_nearest_rank(self):
         batcher = self.make()
@@ -248,7 +252,7 @@ class TestFlushPolicy:
         plain, wire = make_window(9, [512])
         on_time = _entry(wire, now=0.0, deadline_at=4.0)
         boundary = _entry(wire, now=0.0, deadline_at=3.5)
-        key = (bytes(DK.data_key), bytes(DK.aad), 1024)
+        key = (LATENCY, True, bytes(DK.data_key), bytes(DK.aad), 1024)
         with batcher._cond:
             batcher._buckets[key] = [on_time, boundary]
         self.clock[0] = 3.5
@@ -265,9 +269,9 @@ class TestFlushPolicy:
         # Real flush through the backend, timed by the fake clock: the
         # launch starts at t=3.5, so the queued window waited exactly
         # (3.5 - 1.0) s = 2500 ms.
-        key = (bytes(DK.data_key), bytes(DK.aad), 1024)
+        key = (LATENCY, True, bytes(DK.data_key), bytes(DK.aad), 1024)
         waits: list = []
-        batcher.on_flush = lambda occ, added: waits.extend(added)
+        batcher.on_flush = lambda occ, added, cls: waits.extend(added)
         with batcher._cond:
             batcher._buckets[key] = [entry]
         self.clock[0] = 3.5
@@ -281,14 +285,14 @@ class TestFlushPolicy:
         _, wire = make_window(6, [512] * 2)
         entries = [_entry(wire, now=float(i)) for i in range(5)]
         with batcher._cond:
-            batcher._buckets[("k", "a", 1024)] = list(entries)
-            take = batcher._take_locked(("k", "a", 1024))
+            batcher._buckets[KEY] = list(entries)
+            take = batcher._take_locked(KEY)
             assert take == entries[:2]  # FIFO, capped at max_windows
-            assert batcher._buckets[("k", "a", 1024)] == entries[2:]
+            assert batcher._buckets[KEY] == entries[2:]
         byte_capped = self.make(max_windows=16, max_bytes=1500)
         with byte_capped._cond:
-            byte_capped._buckets[("k", "a", 1024)] = list(entries)
-            take = byte_capped._take_locked(("k", "a", 1024))
+            byte_capped._buckets[KEY] = list(entries)
+            take = byte_capped._take_locked(KEY)
             # 1024 bytes per entry: the second pop crosses max_bytes.
             assert take == entries[:2]
 

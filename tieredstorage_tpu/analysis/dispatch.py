@@ -91,6 +91,9 @@ HOT_PATH_ROOTS = (
     # would pay once per COALESCED launch and stall every waiter at once.
     "tieredstorage_tpu/transform/batcher.py:WindowBatcher.submit",
     "tieredstorage_tpu/transform/batcher.py:WindowBatcher._flush_group",
+    # The work-class scheduler half (ISSUE 16): the encrypt submit path is
+    # the produce hot path under concurrency, same bar as submit.
+    "tieredstorage_tpu/transform/batcher.py:WindowBatcher.submit_encrypt",
 )
 
 #: Modules the closure may traverse: the window path and the kernel stack
@@ -107,6 +110,7 @@ HOT_PATH_MODULES = (
     "tieredstorage_tpu/parallel/mesh.py",
     "tieredstorage_tpu/fetch/cache/device_hot.py",
     "tieredstorage_tpu/transform/batcher.py",
+    "tieredstorage_tpu/transform/scheduler.py",
 )
 
 #: Functions allowed to materialize device values, with the reason. This is
@@ -171,6 +175,10 @@ DEVICE_PRODUCER_NAMES = {
     "gcm_encrypt_chunks", "gcm_decrypt_chunks",
     "gcm_encrypt_varlen", "gcm_decrypt_varlen", "_run_varlen",
     "_launch_packed", "_stage_packed", "_encrypt_dispatch",
+    # ISSUE 16 seam: the batcher-aware encrypt dispatch returns either a
+    # staged device tuple or an _EncryptHandle wrapping one — tainted
+    # either way.
+    "_dispatch_encrypt_window",
     "_gcm_process_batch", "_gcm_varlen_batch",
     "aes_encrypt_blocks", "ctr_keystream_batch",
     "aes_encrypt_planes_pallas", "ghash_level1_pallas",

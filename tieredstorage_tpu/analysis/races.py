@@ -86,9 +86,12 @@ SHARED_CLASSES = {
         "one recorder per RSM, archiving records from every gateway "
         "worker and RSM operation thread (retention rings + counters)",
     "tieredstorage_tpu/transform/batcher.py:WindowBatcher":
-        "one device queue per backend: every request thread submits into "
-        "the shared buckets while the flusher daemon drains them "
-        "(pending maps, in-flight count, coalescing counters)",
+        "one device queue per backend: every request thread (fetch "
+        "decrypts, produce encrypts, background scrub verification — each "
+        "under its work class) submits into the shared class-keyed "
+        "buckets while the flusher daemon drains them (pending maps, "
+        "in-flight count, coalescing + per-class counters, fair-share "
+        "deficit and admission-allowance state)",
     "tieredstorage_tpu/metrics/slo.py:SloEngine":
         "one engine per RSM, ticked by every metrics scrape (gauge reads "
         "on exporter threads) and every GET /slo gateway worker",

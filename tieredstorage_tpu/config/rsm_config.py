@@ -492,8 +492,11 @@ def _base_def() -> ConfigDef:
     d.define(ConfigKey(
         "scrub.rate.bytes", "int", default=8 * 1024 * 1024,
         validator=null_or(in_range(16 * 1024, INT_MAX)), importance="medium",
-        doc="Scrub read budget in bytes/s (token bucket) so scrubbing never "
-            "starves foreground fetches; null disables throttling.",
+        doc="Scrub budget in bytes/s so scrubbing never starves foreground "
+            "fetches; null disables throttling. Paces both halves of a "
+            "pass: storage-IO walks through a host token bucket, and — "
+            "when cross-request batching runs — device GCM verification "
+            "through the window scheduler's background admission class.",
     ))
     d.define(ConfigKey(
         "scrub.repair.enabled", "bool", default=False, importance="medium",

@@ -205,6 +205,16 @@ class DefaultChunkManager(ChunkManager):
             flight.note("gcm.windows")
             flight.note("gcm.dispatches", dispatches)
             flight.note("gcm.hbm_roundtrips", roundtrips)
+            # Which work class this request's GCM windows submitted under
+            # (transform/scheduler.py): breach evidence shows whether
+            # latency-class fetch work or a background scrub held the
+            # device. Unscoped fetch threads default to latency.
+            from tieredstorage_tpu.transform.scheduler import (
+                LATENCY,
+                current_work_class,
+            )
+
+            flight.stage(f"gcm.class:{current_work_class() or LATENCY}")
         if batch_before is not None:
             windows, occupancy_sum, last_batch_id = batch_seam()
             batched = windows - batch_before[0]

@@ -1,4 +1,4 @@
-"""Cross-request GCM batching metrics (ISSUE 15).
+"""Cross-request GCM batching metrics (ISSUE 15; work classes ISSUE 16).
 
 Publishes the ``WindowBatcher``'s coalescing counters as supplier gauges
 and materializes two histograms in the ``batch-metrics`` group:
@@ -9,6 +9,13 @@ and materializes two histograms in the ``batch-metrics`` group:
   the device queue before its flush launched (the price; bounded by
   ``transform.batch.wait.ms`` and the deadline-aware flush floor).
 
+With the device queue work-class-aware, each class (``latency`` fetch
+decrypts / ``throughput`` produce encrypts / ``background`` scrub
+verification) additionally exports queued-depth, flushed-window, launch
+and added-wait gauges — the observability behind the isolation claim: a
+breach investigation reads which class held the device (paired with the
+flight records' ``gcm.class:<cls>`` stage markers) instead of guessing.
+
 The batcher stays metrics-free: its ``on_flush`` hook is pointed at the
 histograms here, mirroring how the chunk manager's ``on_fetch`` feeds the
 latency histograms (fetch/chunk_manager.py).
@@ -17,6 +24,7 @@ latency histograms (fetch/chunk_manager.py).
 from __future__ import annotations
 
 from tieredstorage_tpu.metrics.core import Histogram, MetricName, MetricsRegistry
+from tieredstorage_tpu.transform.scheduler import WORK_CLASSES
 
 BATCH_METRIC_GROUP = "batch-metrics"
 
@@ -35,7 +43,7 @@ def register_batch_metrics(registry: MetricsRegistry, batcher) -> None:
 
     gauge("batch-windows-submitted-total",
           lambda: float(batcher.windows_submitted),
-          "Decrypt windows routed through the cross-request batcher")
+          "GCM windows routed through the cross-request batcher")
     gauge("batch-coalesced-windows-total",
           lambda: float(batcher.batched_windows),
           "Windows that rode a SHARED merged launch")
@@ -51,10 +59,28 @@ def register_batch_metrics(registry: MetricsRegistry, batcher) -> None:
           "before launch (excluded from the pack)")
     gauge("batch-launch-failures-total",
           lambda: float(batcher.launch_failures),
-          "Merged flushes whose launch raised (every waiter woken with "
-          "the error)")
+          "Merged flushes whose launch raised (woken waiters limited to "
+          "the failing launch's one work class)")
     gauge("batch-mean-occupancy", lambda: float(batcher.mean_occupancy),
           "Coalesced windows per merged launch since start")
+
+    # Per-work-class gauges: the scheduler's isolation surface. Late-bound
+    # per class via default args so each closure reads ITS class.
+    for cls in WORK_CLASSES:
+        gauge(f"batch-class-{cls}-queued-windows",
+              lambda c=cls: float(batcher.class_queued()[c]),
+              f"{cls}-class windows currently queued on the device "
+              "scheduler")
+        gauge(f"batch-class-{cls}-flushed-windows-total",
+              lambda c=cls: float(batcher.class_flushed_windows[c]),
+              f"{cls}-class windows flushed through merged launches")
+        gauge(f"batch-class-{cls}-launches-total",
+              lambda c=cls: float(batcher.class_launches[c]),
+              f"Merged launches holding the device for the {cls} class")
+        gauge(f"batch-class-{cls}-added-wait-ms-total",
+              lambda c=cls: float(batcher.class_added_wait_ms[c]),
+              f"Summed queue wait (ms) {cls}-class windows paid before "
+              "their flush launched (mean = total / flushed windows)")
 
     occupancy = registry.sensor("gcm-batch.occupancy").ensure_stats(lambda: [
         (
@@ -76,7 +102,7 @@ def register_batch_metrics(registry: MetricsRegistry, batcher) -> None:
         ),
     ])
 
-    def on_flush(occ: int, added_wait_ms: list) -> None:
+    def on_flush(occ: int, added_wait_ms: list, work_class: str) -> None:
         occupancy.record(float(occ))
         for ms in added_wait_ms:
             added_wait.record(float(ms))

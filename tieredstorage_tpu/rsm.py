@@ -484,7 +484,13 @@ class RemoteStorageManager:
     def _wire_scrubber(self, config: RemoteStorageManagerConfig) -> None:
         """Background integrity scrubbing (scrub/): enumerate + verify +
         quarantine/repair on a jittered period, throttled so it never
-        starves foreground fetches."""
+        starves foreground fetches. `scrub.rate.bytes` paces BOTH halves
+        of a pass: the host TokenBucket throttles its storage-IO walks,
+        and — when the transform backend runs the cross-request window
+        batcher — the same rate becomes the device scheduler's background
+        admission class, replacing any host-side throttle on device GCM
+        work (the scrubber's verification decrypts submit under
+        `work_class_scope(BACKGROUND)`)."""
         if not config.scrub_enabled:
             return
         from tieredstorage_tpu.scrub import ScrubMetrics, ScrubScheduler, Scrubber
@@ -495,6 +501,12 @@ class RemoteStorageManager:
             if config.scrub_rate_bytes is not None
             else None
         )
+        if config.scrub_rate_bytes is not None:
+            batcher = getattr(self._transform_backend, "batcher", None)
+            if batcher is not None:
+                from tieredstorage_tpu.transform.scheduler import BACKGROUND
+
+                batcher.set_class_rate(BACKGROUND, config.scrub_rate_bytes)
         inner = self._innermost_chunk_manager(self._chunk_manager)
         quarantine = inner.quarantine if inner is not None else None
         self._scrubber = Scrubber(

@@ -100,11 +100,21 @@ class AntiEntropyRepairer:
 
     # ------------------------------------------------------------------ pass
     def run_once(self) -> AntiEntropyReport:
+        from tieredstorage_tpu.transform.scheduler import (
+            BACKGROUND,
+            work_class_scope,
+        )
+
         # Monotonic, like ScrubReport.started_at: an ordering instant on the
         # process clock, not a calendar timestamp.
         report = AntiEntropyReport(started_at=time.monotonic())
         start = time.monotonic()
-        with self.tracer.span("replication.antientropy", prefix=self.prefix):
+        # The whole pass runs background-class: any device GCM work its
+        # hashing/repair walk triggers joins the scheduler's background
+        # admission class with the scrubber's, never a foreground bucket.
+        with work_class_scope(BACKGROUND), self.tracer.span(
+            "replication.antientropy", prefix=self.prefix
+        ):
             replicas = self._replicated.replica_states
             listings = self._list_all(replicas, report)
             all_keys = sorted(set().union(*listings.values())) if listings else []

@@ -8,14 +8,16 @@ A pass has three stages:
    crashed upload whose rollback never ran, or by manual meddling).
 2. **Verify** — each manifest's chunk index is cross-checked against the
    store: the `.log` object is stream-fetched in contiguous chunk batches
-   (throttled through a `TokenBucket` so scrubbing never starves foreground
-   fetches), every batch is CRC32C-verified against the manifest's
+   (storage IO throttled through a `TokenBucket` so scrubbing never starves
+   foreground fetches), every batch is CRC32C-verified against the manifest's
    `chunkChecksums` through the batched MXU kernel (`ops/crc32c.crc32c_batch`,
    host-table fallback), and transformed segments additionally round-trip
    detransform (AES-GCM tag check / decompress) — byte-identical coverage to
-   a real fetch, without a consumer in the loop. Size drift is caught
-   structurally: short reads inside the chunk walk, range probes past the
-   expected end.
+   a real fetch, without a consumer in the loop. The detransform runs under
+   the BACKGROUND work class (`transform/scheduler.py`): its device windows
+   join the shared scheduler's background admission class rather than racing
+   foreground fetch decrypts. Size drift is caught structurally: short reads
+   inside the chunk walk, range probes past the expected end.
 3. **Repair** — corrupt/missing objects are re-uploaded from a supplied
    local segment source (`repair_source`) when one is available, orphans are
    deleted, and every corrupt object is pushed through the chunk-manager
@@ -345,7 +347,14 @@ class Scrubber:
         self, key, manifest, chunks, stored, already_bad: set[int], report: ScrubReport
     ) -> None:
         """GCM-tag / decompress round-trip for transformed segments: the same
-        failure a real fetch would hit, caught before any consumer does."""
+        failure a real fetch would hit, caught before any consumer does.
+        The device work runs under the BACKGROUND work class: with
+        cross-request batching enabled, verification windows join the
+        scheduler's background admission class (paced by
+        ``scrub.rate.bytes`` scheduler-side, bounded-age starvation
+        watchdog) instead of racing foreground fetch decrypts for the
+        device — and a device failure mid-scrub wakes background waiters
+        only, never a latency-class fetch."""
         if (
             not self._verify_transforms
             or self._transform_backend is None
@@ -353,19 +362,25 @@ class Scrubber:
         ):
             return
         from tieredstorage_tpu.transform.api import DetransformOptions
+        from tieredstorage_tpu.transform.scheduler import (
+            BACKGROUND,
+            work_class_scope,
+        )
 
         opts = DetransformOptions.from_manifest(manifest)
         clean = [(c, b) for c, b in zip(chunks, stored) if c.id not in already_bad]
         if not clean:
             return
         try:
-            self._transform_backend.detransform([b for _, b in clean], opts)
+            with work_class_scope(BACKGROUND):
+                self._transform_backend.detransform([b for _, b in clean], opts)
             return
         except Exception:  # noqa: BLE001 — isolate the culprit chunk below
             pass
         for c, b in clean:
             try:
-                self._transform_backend.detransform([b], opts)
+                with work_class_scope(BACKGROUND):
+                    self._transform_backend.detransform([b], opts)
             except Exception as e:  # noqa: BLE001 — per-chunk verdict
                 self._finding(
                     report,
@@ -495,9 +510,14 @@ class Scrubber:
             return False
 
     def _throttle(self, n_bytes: int) -> None:
-        """Consume scrub budget; batches larger than the bucket capacity are
-        drained in capacity-sized slices so big windows still pace correctly
-        (TokenBucket.consume clamps single requests at capacity)."""
+        """Consume scrub STORAGE-IO budget (ranged fetches, index reads);
+        batches larger than the bucket capacity are drained in
+        capacity-sized slices so big windows still pace correctly
+        (TokenBucket.consume clamps single requests at capacity). Device
+        GCM work is NOT throttled here: with cross-request batching
+        enabled, verification windows are paced by the device scheduler's
+        background admission class instead (the rsm wiring maps
+        ``scrub.rate.bytes`` onto both)."""
         bucket = self._rate_bucket
         if bucket is None:
             return

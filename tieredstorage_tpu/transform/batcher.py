@@ -1,31 +1,51 @@
-"""Cross-request GCM dispatch batcher: one device queue for the decrypt path.
+"""Work-class-aware device scheduler: ONE GCM queue for fetch, encrypt, scrub.
 
 PR 8 fused a whole window into ONE device launch — but batching stopped at
 the request boundary: under massed consumer replay a hundred concurrent
 fetches stage a hundred small packed windows and pay a hundred per-launch
 floors. Continuous-batching inference servers (Orca, OSDI '22; vLLM)
 showed the fix: coalesce *concurrent* requests into shared device
-launches. ``WindowBatcher`` applies the same shape to the GCM data plane:
+launches. ``WindowBatcher`` applies the same shape to the GCM data plane,
+and (ISSUE 16) extends it with Clockwork-style (OSDI '20) work classes so
+every device consumer — fetch decrypts, encrypt windows coalesced across
+concurrent produces, scrub/anti-entropy verification — shares the one
+queue under an explicit isolation policy (transform/scheduler.py):
 
 - ``TpuTransformBackend._decrypt_batch`` routes eligible windows here
   (``transform.batch.enabled``); each caller blocks while its rows ride a
   SHARED packed ``uint8[B, n_bytes + 16]`` launch and gets its own slice
   of the one output buffer back (results demultiplexed per caller).
-- Grouping is by ``(data_key, aad, bucket_max_bytes(max_size))`` — the
-  SAME jit-shape ladder the unbatched varlen path quantizes through
-  (``ops/gcm.py``), so coalescing can never introduce a retrace; merged
-  row counts are padded up a power-of-two ladder for the same reason.
-- The flush policy is deadline-aware: a bucket flushes when its queued
-  windows or bytes reach the caps, when the oldest waiter has waited
-  ``wait_ms``, or when the oldest waiter's remaining deadline minus the
-  observed launch p95 hits the floor — so batching never converts an
-  on-time request into a deadline miss.
-- **Single-waiter fast path**: a submit that finds the batcher idle (no
-  queue, no launch in flight) dispatches inline through the ordinary
-  unbatched window path — light load pays ZERO added latency and keeps
-  byte-identical behavior (including the hot-tier retention hook).
-- **Per-row error isolation**: tags are verified per caller after the
-  merged fetch; one forged row fails that one request with
+  ``transform_windows`` routes encrypt windows through ``submit_encrypt``
+  / ``_EncryptHandle.wait`` — async, so ``pipeline.depth`` overlap is
+  preserved — and concurrent produces coalesce the same way.
+- Grouping is by ``(work_class, direction, data_key, aad,
+  bucket_max_bytes(max_size))`` — the SAME jit-shape ladder the unbatched
+  varlen path quantizes through (``ops/gcm.py``), so coalescing can never
+  introduce a retrace; merged row counts are padded up a power-of-two
+  ladder for the same reason. Classes (and directions) structurally NEVER
+  share a merged launch: a launch failure in a background scrub flush
+  wakes background waiters only, never a latency-class fetch.
+- The flush policy is deadline-aware and class-aware: a bucket flushes
+  when its queued windows or bytes reach the caps, when the oldest waiter
+  aged past its class bound (``wait_ms`` for latency/throughput; the
+  ``background_max_age_ms`` starvation watchdog for background — bounded
+  forward progress under sustained foreground pressure), or when the
+  oldest waiter's remaining deadline minus the observed launch p95 hits
+  the floor. Due buckets launch in scheduler order: latency-class windows
+  out-rank queued throughput/background work at EVERY flush decision,
+  with weighted-deficit fair share among the rest.
+- **Per-class admission**: a class with a configured byte rate
+  (``set_class_rate``; rsm wiring maps ``scrub.rate.bytes`` onto the
+  background class) accrues launch budget scheduler-side — the
+  replacement for the scrubber's host token bucket on device work.
+- **Single-waiter fast path**: a foreground submit that finds the batcher
+  idle (no queue, no launch in flight) dispatches inline through the
+  ordinary unbatched window path — light load pays ZERO added latency and
+  keeps byte-identical behavior (including the hot-tier retention hook).
+  Background submits always queue, so admission and the watchdog govern
+  every background launch.
+- **Per-row error isolation**: decrypt tags are verified per caller after
+  the merged fetch; one forged row fails that one request with
   ``AuthenticationError``, never its batch-mates. A waiter whose deadline
   expired before launch fails fast with ``DeadlineExceededException`` and
   is excluded from the pack (it cannot poison the batch).
@@ -37,7 +57,8 @@ while each coalesced window still counts as a window — so
 the ``make transform-demo`` gates (``<= 1``) hold by construction. The
 per-thread evidence seam (``thread_evidence``) lets the chunk manager
 flight-record which launch a request shared (``gcm.batch:<id>`` stage +
-occupancy counters on ``GET /debug/requests``).
+occupancy counters on ``GET /debug/requests``); per-class counters feed
+the ``batch-metrics`` group's class gauges.
 """
 
 from __future__ import annotations
@@ -51,6 +72,20 @@ from typing import Callable, Optional
 import numpy as np
 
 from tieredstorage_tpu.security.aes import IV_SIZE, TAG_SIZE
+from tieredstorage_tpu.transform.scheduler import (
+    BACKGROUND,
+    DEFAULT_BACKGROUND_MAX_AGE_MS,
+    DEFAULT_SHARES,
+    LATENCY,
+    THROUGHPUT,
+    WORK_CLASSES,
+    admission_defer_s,
+    admission_refill,
+    class_max_age_ms,
+    current_work_class,
+    flush_priority,
+    validate_work_class,
+)
 from tieredstorage_tpu.utils.locks import new_condition, note_mutation
 
 
@@ -81,10 +116,12 @@ class _PendingWindow:
     payloads: list
     sizes: list
     ivs: np.ndarray
-    tags: list
+    tags: Optional[list]  # None on the encrypt direction (nothing to verify)
     n_bytes: int
     enqueued_at: float
     deadline_at: Optional[float]
+    work_class: str = LATENCY
+    decrypt: bool = True
     event: threading.Event = dataclasses.field(default_factory=threading.Event)
     result: Optional[list] = None
     error: Optional[BaseException] = None
@@ -93,8 +130,31 @@ class _PendingWindow:
     added_wait_ms: float = 0.0
 
 
+class _EncryptHandle:
+    """An in-flight encrypt window: resolve with ``wait()``. Either an
+    inline dispatch (the staged tuple of ``_encrypt_dispatch``, finished
+    through the ordinary ``_encrypt_finish`` fetch) or a queued entry
+    riding a merged flush — callers can hold ``pipeline.depth`` of these
+    without blocking, so coalescing never costs the produce pipeline its
+    upload ∥ compute ∥ download overlap."""
+
+    __slots__ = ("_batcher", "_staged", "_entry")
+
+    def __init__(self, batcher, staged=None, entry=None) -> None:
+        self._batcher = batcher
+        self._staged = staged
+        self._entry = entry
+
+    def wait(self) -> list:
+        """Block until this window's wire chunks (IV || ct || tag) exist."""
+        if self._staged is not None:
+            return self._batcher._backend._encrypt_finish(self._staged)
+        return self._batcher._await_entry(self._entry)
+
+
 class WindowBatcher:
-    """Coalesces concurrent decrypt windows into shared packed launches.
+    """Coalesces concurrent GCM windows into shared packed launches, one
+    work class per launch.
 
     One daemon flusher thread owns the device queue; submitting threads
     block on their entry's event. All shared state mutates under the one
@@ -113,9 +173,9 @@ class WindowBatcher:
     #: spurious wait timeout) is what reports deadline expiry.
     WAIT_GRACE_S = 60.0
 
-    #: Optional flush hook ``(occupancy, added_wait_ms_list)`` — the
-    #: batch-metrics group (metrics/batch_metrics.py) points it at the
-    #: occupancy and added-wait histograms.
+    #: Optional flush hook ``(occupancy, added_wait_ms_list, work_class)``
+    #: — the batch-metrics group (metrics/batch_metrics.py) points it at
+    #: the occupancy/added-wait histograms and the per-class counters.
     on_flush: Optional[Callable] = None
 
     def __init__(
@@ -125,6 +185,8 @@ class WindowBatcher:
         wait_ms: float = 2.0,
         max_windows: int = 16,
         max_bytes: int = 64 << 20,
+        background_max_age_ms: float = DEFAULT_BACKGROUND_MAX_AGE_MS,
+        class_shares: Optional[dict] = None,
         time_source: Callable[[], float] = time.monotonic,
     ) -> None:
         if wait_ms < 0:
@@ -133,16 +195,29 @@ class WindowBatcher:
             raise ValueError(f"max_windows must be >= 2, got {max_windows}")
         if max_bytes < 1:
             raise ValueError(f"max_bytes must be >= 1, got {max_bytes}")
+        if background_max_age_ms < 0:
+            raise ValueError(
+                f"background_max_age_ms must be >= 0, got {background_max_age_ms}"
+            )
         self._backend = backend
         self.wait_ms = float(wait_ms)
         self.max_windows = int(max_windows)
         self.max_bytes = int(max_bytes)
+        self.background_max_age_ms = float(background_max_age_ms)
+        self.class_shares = dict(DEFAULT_SHARES)
+        for cls, share in (class_shares or {}).items():
+            validate_work_class(cls)
+            if share <= 0:
+                raise ValueError(f"share for {cls!r} must be > 0, got {share}")
+            self.class_shares[cls] = float(share)
         self._now = time_source
         #: The ONE guard of every shared field below; doubles as the
         #: flusher's wakeup condition (the admission-controller idiom, so
         #: the lock-order checker sees wait() release the held lock).
         self._cond = new_condition("batcher.WindowBatcher._cond")
-        #: bucket key (data_key, aad, bucket_bytes) -> queued entries.
+        #: bucket key (work_class, decrypt, data_key, aad, bucket_bytes)
+        #: -> queued entries. One class + one direction per merged launch,
+        #: structurally.
         self._buckets: dict[tuple, list[_PendingWindow]] = {}
         self._launch_s: list[float] = []
         self._inflight = 0
@@ -150,6 +225,14 @@ class WindowBatcher:
         self._thread: Optional[threading.Thread] = None
         self._tls = threading.local()
         self._batch_seq = 0
+        #: Deficit-fair-share accounting: bytes each class launched.
+        self._served_bytes = {cls: 0 for cls in WORK_CLASSES}
+        #: Per-class admission (set_class_rate): bytes/s rate, burst cap,
+        #: current allowance, and the last refill instant.
+        self._class_rate: dict[str, float] = {}
+        self._class_burst: dict[str, float] = {}
+        self._class_allowance: dict[str, float] = {}
+        self._class_refill_at: dict[str, float] = {}
         # Counters (exported by metrics/batch_metrics.py).
         self.windows_submitted = 0
         self.fast_path_windows = 0
@@ -157,6 +240,11 @@ class WindowBatcher:
         self.launches = 0
         self.expired_windows = 0
         self.launch_failures = 0
+        #: Per-class counters: windows that rode a merged flush, merged
+        #: launches, and the summed added queue wait — the class gauges.
+        self.class_flushed_windows = {cls: 0 for cls in WORK_CLASSES}
+        self.class_launches = {cls: 0 for cls in WORK_CLASSES}
+        self.class_added_wait_ms = {cls: 0.0 for cls in WORK_CLASSES}
 
     # --------------------------------------------------------------- lifecycle
     def start(self) -> "WindowBatcher":
@@ -189,6 +277,43 @@ class WindowBatcher:
         with self._cond:
             return self.batched_windows / self.launches if self.launches else 0.0
 
+    def set_class_rate(
+        self, work_class: str, rate_bytes: Optional[float],
+        burst_bytes: Optional[float] = None,
+    ) -> None:
+        """Admit ``work_class`` launches at ``rate_bytes``/s (burst cap
+        defaults to one second of rate, like ``TokenBucket``); None clears
+        the rate (unlimited). The rsm scrub wiring maps ``scrub.rate.bytes``
+        here so the scrubber's device budget is a scheduler admission class
+        instead of a host-side token bucket."""
+        validate_work_class(work_class)
+        with self._cond:
+            if rate_bytes is None or rate_bytes <= 0:
+                self._class_rate.pop(work_class, None)
+                self._class_burst.pop(work_class, None)
+                self._class_allowance.pop(work_class, None)
+                self._class_refill_at.pop(work_class, None)
+            else:
+                self._class_rate[work_class] = float(rate_bytes)
+                self._class_burst[work_class] = float(
+                    rate_bytes if burst_bytes is None else burst_bytes
+                )
+                self._class_allowance[work_class] = self._class_burst[work_class]
+                self._class_refill_at[work_class] = self._now()
+            note_mutation("batcher.WindowBatcher._class_rate")
+            note_mutation("batcher.WindowBatcher._class_burst")
+            note_mutation("batcher.WindowBatcher._class_allowance")
+            note_mutation("batcher.WindowBatcher._class_refill_at")
+            self._cond.notify()
+
+    def class_queued(self) -> dict[str, int]:
+        """Currently queued windows per class (the queue-depth gauges)."""
+        out = {cls: 0 for cls in WORK_CLASSES}
+        with self._cond:
+            for key, entries in self._buckets.items():
+                out[key[0]] += len(entries)
+        return out
+
     def thread_evidence(self) -> tuple[int, float, int]:
         """This THREAD's cumulative (coalesced windows, occupancy sum, last
         batch id) — the flight-recorder seam
@@ -208,15 +333,22 @@ class WindowBatcher:
         Blocks until the window's rows came back from a (possibly shared)
         launch; returns the plaintext chunks or raises this CALLER's error
         only (``AuthenticationError`` on its own rows,
-        ``DeadlineExceededException`` when its budget expired in queue)."""
-        from tieredstorage_tpu.ops import gcm as gcm_ops
-
+        ``DeadlineExceededException`` when its budget expired in queue).
+        The work class is the thread's ambient ``work_class_scope``
+        (default ``latency`` — the fetch path)."""
+        work_class = current_work_class() or LATENCY
         with self._cond:
             if self._stopped:
                 raise BatcherStoppedError("WindowBatcher is stopped")
             self.windows_submitted += 1
             note_mutation("batcher.WindowBatcher.windows_submitted")
-            fast = not self._buckets and self._inflight == 0
+            # Background work never takes the inline fast path: admission
+            # and the starvation watchdog govern every background launch.
+            fast = (
+                work_class != BACKGROUND
+                and not self._buckets
+                and self._inflight == 0
+            )
             if fast:
                 self._inflight += 1
                 note_mutation("batcher.WindowBatcher._inflight")
@@ -238,6 +370,66 @@ class WindowBatcher:
                     if self._buckets:
                         self._cond.notify()
 
+        entry = self._enqueue(
+            enc, payloads, sizes, ivs, tags, work_class, decrypt=True
+        )
+        return self._await_entry(entry)
+
+    def submit_encrypt(self, chunks, opts) -> _EncryptHandle:
+        """Encrypt one window, coalescing with CONCURRENT produces.
+
+        Asynchronous: returns a handle immediately (resolve with
+        ``wait()``), so ``transform_windows`` keeps ``pipeline.depth``
+        windows in flight exactly as on the unbatched path. An idle
+        batcher dispatches inline (``_inflight`` held only across the
+        async dispatch — a single pipelined produce stream never queues);
+        concurrent produces collide on the in-flight count and merge into
+        one shared varlen launch with byte-identical wire output (GCM is
+        deterministic per (key, aad, IV, plaintext) row). The work class
+        is the thread's ambient scope (default ``throughput`` — the
+        upload path)."""
+        work_class = current_work_class() or THROUGHPUT
+        backend = self._backend
+        with self._cond:
+            if self._stopped:
+                raise BatcherStoppedError("WindowBatcher is stopped")
+            self.windows_submitted += 1
+            note_mutation("batcher.WindowBatcher.windows_submitted")
+            fast = (
+                work_class != BACKGROUND
+                and not self._buckets
+                and self._inflight == 0
+            )
+            if fast:
+                self._inflight += 1
+                note_mutation("batcher.WindowBatcher._inflight")
+                self.fast_path_windows += 1
+                note_mutation("batcher.WindowBatcher.fast_path_windows")
+        if fast:
+            try:
+                staged = backend._encrypt_dispatch(chunks, opts)
+            finally:
+                with self._cond:
+                    self._inflight -= 1
+                    note_mutation("batcher.WindowBatcher._inflight")
+                    if self._buckets:
+                        self._cond.notify()
+            return _EncryptHandle(self, staged=staged)
+
+        sizes = [len(c) for c in chunks]
+        ivs = backend._make_ivs(len(chunks), opts)
+        enc = opts.encryption
+        entry = self._enqueue(
+            enc, chunks, sizes, ivs, None, work_class, decrypt=False
+        )
+        return _EncryptHandle(self, entry=entry)
+
+    def _enqueue(
+        self, enc, payloads, sizes, ivs, tags, work_class: str, *, decrypt: bool
+    ) -> _PendingWindow:
+        """Queue one window under its class+direction bucket and wake the
+        flusher; the flusher owns the entry from here."""
+        from tieredstorage_tpu.ops import gcm as gcm_ops
         from tieredstorage_tpu.utils import deadline as deadline_util
 
         now = self._now()
@@ -246,12 +438,16 @@ class WindowBatcher:
             payloads=list(payloads),
             sizes=list(sizes),
             ivs=ivs,
-            tags=list(tags),
+            tags=None if tags is None else list(tags),
             n_bytes=sum(sizes),
             enqueued_at=now,
             deadline_at=None if remaining is None else now + remaining,
+            work_class=work_class,
+            decrypt=decrypt,
         )
         key = (
+            work_class,
+            decrypt,
             bytes(enc.data_key),
             bytes(enc.aad),
             gcm_ops.bucket_max_bytes(max(sizes)),
@@ -261,10 +457,13 @@ class WindowBatcher:
                 raise BatcherStoppedError("WindowBatcher is stopped")
             self._buckets.setdefault(key, []).append(entry)
             self._cond.notify()
-        # The flusher owns the entry from here; wait out the flush. The
-        # timeout is a liveness backstop only (deadline expiry is enforced
-        # by the flusher's fail-fast) — clamped to the caller's remaining
-        # budget plus slack when one exists.
+        return entry
+
+    def _await_entry(self, entry: _PendingWindow) -> list:
+        """Wait out a queued entry's flush; raises this caller's error
+        only. The timeout is a liveness backstop (deadline expiry is
+        enforced by the flusher's fail-fast) — clamped to the caller's
+        remaining budget plus slack when one exists."""
         if not entry.event.wait(timeout=self._wait_timeout_s(entry)):
             raise BatcherStoppedError(
                 "batched window was never flushed (flusher dead?)"
@@ -298,35 +497,73 @@ class WindowBatcher:
         # construction, no clamp needed.
         return ordered[int(0.95 * (len(ordered) - 1))]
 
+    def _admission_ready_at_locked(
+        self, work_class: str, need_bytes: int, now: float
+    ) -> float:
+        """When the class admission budget covers ``need_bytes`` (clamped
+        at the burst/flush caps, so oversized backlogs admit in paced
+        slices) — callers hold ``_cond``. Refills the allowance to
+        ``now`` as a side effect."""
+        rate = self._class_rate.get(work_class)
+        if rate is None:
+            return now
+        burst = self._class_burst[work_class]
+        elapsed = max(0.0, now - self._class_refill_at[work_class])
+        self._class_allowance[work_class] = admission_refill(
+            self._class_allowance[work_class], rate, burst, elapsed
+        )
+        self._class_refill_at[work_class] = now
+        note_mutation("batcher.WindowBatcher._class_allowance")
+        note_mutation("batcher.WindowBatcher._class_refill_at")
+        need = min(need_bytes, burst, self.max_bytes)
+        return now + admission_defer_s(
+            self._class_allowance[work_class], need, rate
+        )
+
     def _due_keys_locked(self, now: float) -> tuple[list, Optional[float]]:
-        """(bucket keys due to flush now, seconds until the next one is).
+        """(bucket keys due to flush now — scheduler order, seconds until
+        the next one is).
 
         A bucket is due when: queued windows >= ``max_windows``; queued
-        bytes >= ``max_bytes``; the oldest waiter aged past ``wait_ms``;
-        or the tightest waiter's remaining deadline minus the launch p95
-        estimate is at the ``DEADLINE_FLOOR_MS`` floor."""
+        bytes >= ``max_bytes``; the oldest waiter aged past its CLASS
+        bound (``wait_ms``, or the background starvation watchdog); or
+        the tightest waiter's remaining deadline minus the launch p95
+        estimate is at the ``DEADLINE_FLOOR_MS`` floor. A class with an
+        admission rate is additionally deferred until its byte budget
+        covers the flush. Due keys come back sorted by flush priority:
+        latency strictly first, then weighted deficit."""
         due: list = []
         next_wake: Optional[float] = None
         p95 = self._launch_p95_s()
         floor_s = self.DEADLINE_FLOOR_MS / 1000.0
-        wait_s = self.wait_ms / 1000.0
         for key, entries in self._buckets.items():
-            if len(entries) >= self.max_windows:
-                due.append(key)
-                continue
-            if sum(e.n_bytes for e in entries) >= self.max_bytes:
-                due.append(key)
-                continue
-            wake = entries[0].enqueued_at + wait_s
-            deadlines = [
-                e.deadline_at for e in entries if e.deadline_at is not None
-            ]
-            if deadlines:
-                wake = min(wake, min(deadlines) - p95 - floor_s)
+            work_class = key[0]
+            queued_bytes = sum(e.n_bytes for e in entries)
+            if len(entries) >= self.max_windows or queued_bytes >= self.max_bytes:
+                wake = now
+            else:
+                age_s = class_max_age_ms(
+                    work_class, self.wait_ms, self.background_max_age_ms
+                ) / 1000.0
+                wake = entries[0].enqueued_at + age_s
+                deadlines = [
+                    e.deadline_at for e in entries if e.deadline_at is not None
+                ]
+                if deadlines:
+                    wake = min(wake, min(deadlines) - p95 - floor_s)
+            wake = max(
+                wake, self._admission_ready_at_locked(work_class, queued_bytes, now)
+            )
             if wake <= now:
                 due.append(key)
             elif next_wake is None or wake < next_wake:
                 next_wake = wake
+        due.sort(key=lambda k: flush_priority(
+            k[0],
+            self._served_bytes[k[0]],
+            self.class_shares[k[0]],
+            self._buckets[k][0].enqueued_at,
+        ))
         timeout = None if next_wake is None else max(0.0, next_wake - now)
         return due, timeout
 
@@ -335,7 +572,8 @@ class WindowBatcher:
         (callers hold ``_cond``). A storm larger than one flush leaves the
         remainder queued — still due, so the flusher drains it in capped
         launches whose shapes stay on the warmed row ladder instead of
-        compiling one giant program."""
+        compiling one giant program. Taken bytes land in the class's
+        deficit account and draw down its admission allowance."""
         entries = self._buckets.get(key)
         take: list = []
         total = 0
@@ -345,12 +583,22 @@ class WindowBatcher:
             total += e.n_bytes
         if not entries:
             self._buckets.pop(key, None)
+        if take:
+            work_class = key[0]
+            self._served_bytes[work_class] += total
+            note_mutation("batcher.WindowBatcher._served_bytes")
+            if work_class in self._class_rate:
+                # Allowance may go negative (a watchdog-forced flush larger
+                # than the remaining budget): the debt defers the NEXT
+                # background flush, standard token-bucket pacing.
+                self._class_allowance[work_class] -= total
+                note_mutation("batcher.WindowBatcher._class_allowance")
         return take
 
     def _run(self) -> None:
         """Flusher daemon: wait for a due bucket, take a capped batch,
         flush outside the lock — the one device queue every stream
-        shares."""
+        shares. Groups flush in scheduler order (latency first)."""
         while True:
             with self._cond:
                 if self._stopped:
@@ -372,15 +620,19 @@ class WindowBatcher:
 
     def flush_now(self) -> int:
         """Flush every queued window synchronously on the calling thread
-        (tests and ``stop`` drain), in capped batches; returns the number
+        (tests and ``stop`` drain), in capped batches and scheduler order,
+        ignoring admission (a drain must terminate); returns the number
         of flushes."""
         flushes = 0
         while True:
             with self._cond:
-                groups = [
-                    (key, self._take_locked(key))
-                    for key in list(self._buckets.keys())
-                ]
+                keys = sorted(self._buckets.keys(), key=lambda k: flush_priority(
+                    k[0],
+                    self._served_bytes[k[0]],
+                    self.class_shares[k[0]],
+                    self._buckets[k][0].enqueued_at,
+                ))
+                groups = [(key, self._take_locked(key)) for key in keys]
             if not groups:
                 return flushes
             for key, entries in groups:
@@ -393,12 +645,16 @@ class WindowBatcher:
         """ONE shared launch for a bucket's queued windows: merge rows into
         a single packed buffer, stage + launch through the owning backend
         (donation and DispatchStats intact), fetch once, then demultiplex
-        per caller with per-row tag verification. The np.asarray here is
-        the merged flush's ONE sanctioned device->host materialization."""
+        per caller — with per-row tag verification on the decrypt
+        direction, wire assembly (IV || ct || tag) on encrypt. The
+        np.asarray here is the merged flush's ONE sanctioned device->host
+        materialization. The bucket key carries ONE work class and ONE
+        direction, so a failure here wakes that class's waiters only."""
         from tieredstorage_tpu.ops import gcm as gcm_ops
         from tieredstorage_tpu.transform.api import AuthenticationError
         from tieredstorage_tpu.utils.deadline import DeadlineExceededException
 
+        work_class, decrypt = key[0], key[1]
         now = self._now()
         live: list[_PendingWindow] = []
         expired = 0
@@ -422,7 +678,7 @@ class WindowBatcher:
 
         backend = self._backend
         try:
-            ctx = gcm_ops.make_varlen_context(key[0], key[1], key[2])
+            ctx = gcm_ops.make_varlen_context(key[2], key[3], key[4])
             n_bytes = ctx.max_bytes
             rows = sum(len(e.sizes) for e in live)
             packed = np.zeros((bucket_rows(rows), n_bytes + TAG_SIZE), np.uint8)
@@ -441,13 +697,15 @@ class WindowBatcher:
             packed[rows:, n_bytes + IV_SIZE] = 16
             t0 = self._now()
             staged = backend._stage_packed(packed, True)
-            out = backend._launch_packed(ctx, staged, True, decrypt=True)
+            out = backend._launch_packed(ctx, staged, True, decrypt=decrypt)
             host = np.asarray(out)
             launch_s = self._now() - t0
         except BaseException as exc:  # noqa: BLE001 - every waiter must wake
             with self._cond:
                 self.launch_failures += 1
                 note_mutation("batcher.WindowBatcher.launch_failures")
+            # Classes never share a merged launch, so this failure is
+            # delivered to THIS class's waiters alone.
             for e in live:
                 e.error = exc
                 e.event.set()
@@ -465,6 +723,10 @@ class WindowBatcher:
             note_mutation("batcher.WindowBatcher.launches")
             self.batched_windows += occupancy
             note_mutation("batcher.WindowBatcher.batched_windows")
+            self.class_launches[work_class] += 1
+            note_mutation("batcher.WindowBatcher.class_launches")
+            self.class_flushed_windows[work_class] += occupancy
+            note_mutation("batcher.WindowBatcher.class_flushed_windows")
             self._launch_s.append(launch_s)
             if len(self._launch_s) > self.LAUNCH_SAMPLES:
                 del self._launch_s[0]
@@ -473,20 +735,32 @@ class WindowBatcher:
         r = 0
         for e in live:
             n = len(e.sizes)
-            bad = [
-                i
-                for i in range(n)
-                if not hmac.compare_digest(
-                    host[r + i, n_bytes:].tobytes(), e.tags[i]
-                )
-            ]
-            if bad:
-                # Per-row error isolation: one forged row fails ITS
-                # request; batch-mates still get their plaintext.
-                e.error = AuthenticationError(f"GCM tag mismatch on chunks {bad}")
+            if decrypt:
+                bad = [
+                    i
+                    for i in range(n)
+                    if not hmac.compare_digest(
+                        host[r + i, n_bytes:].tobytes(), e.tags[i]
+                    )
+                ]
+                if bad:
+                    # Per-row error isolation: one forged row fails ITS
+                    # request; batch-mates still get their plaintext.
+                    e.error = AuthenticationError(
+                        f"GCM tag mismatch on chunks {bad}"
+                    )
+                else:
+                    e.result = [
+                        host[r + i, : e.sizes[i]].tobytes() for i in range(n)
+                    ]
             else:
+                # Encrypt demux: the same wire assembly _encrypt_finish
+                # does — IV || ciphertext || tag per row.
                 e.result = [
-                    host[r + i, : e.sizes[i]].tobytes() for i in range(n)
+                    e.ivs[i].tobytes()
+                    + host[r + i, : e.sizes[i]].tobytes()
+                    + host[r + i, n_bytes:].tobytes()
+                    for i in range(n)
                 ]
             r += n
             e.batch_id = batch_id
@@ -494,6 +768,9 @@ class WindowBatcher:
             e.added_wait_ms = max(0.0, (t0 - e.enqueued_at) * 1000.0)
             added_waits.append(e.added_wait_ms)
             e.event.set()
+        with self._cond:
+            self.class_added_wait_ms[work_class] += sum(added_waits)
+            note_mutation("batcher.WindowBatcher.class_added_wait_ms")
         hook = self.on_flush
         if hook is not None:
-            hook(occupancy, added_waits)
+            hook(occupancy, added_waits, work_class)

@@ -228,19 +228,26 @@ class TpuTransformBackend(TransformBackend):
             self.enable_batching(
                 wait_ms=float(configs.get("batch.wait.ms", 2)),
                 max_windows=int(configs.get("batch.windows", 16)),
+                background_max_age_ms=float(
+                    configs.get("batch.background.max.age.ms", 50)
+                ),
             )
 
     def enable_batching(
         self, *, wait_ms: float = 2.0, max_windows: int = 16,
         max_bytes: Optional[int] = None,
+        background_max_age_ms: Optional[float] = None,
     ):
-        """Build + start the cross-request decrypt batcher (idempotent).
-        The flush byte cap defaults to the window byte cap
-        (`transform.batch.bytes`): a merged launch never exceeds the HBM
-        budget one pipelined window was already sized for."""
+        """Build + start the cross-request window batcher / device
+        scheduler (idempotent). The flush byte cap defaults to the window
+        byte cap (`transform.batch.bytes`): a merged launch never exceeds
+        the HBM budget one pipelined window was already sized for."""
         if self.batcher is None:
             from tieredstorage_tpu.transform.batcher import WindowBatcher
 
+            kwargs = {}
+            if background_max_age_ms is not None:
+                kwargs["background_max_age_ms"] = background_max_age_ms
             self.batcher = WindowBatcher(
                 self,
                 wait_ms=wait_ms,
@@ -248,6 +255,7 @@ class TpuTransformBackend(TransformBackend):
                 max_bytes=(
                     self.preferred_batch_bytes if max_bytes is None else max_bytes
                 ),
+                **kwargs,
             ).start()
         return self.batcher
 
@@ -261,9 +269,9 @@ class TpuTransformBackend(TransformBackend):
         return (0, 0.0, 0) if batcher is None else batcher.thread_evidence()
 
     def _note_batched_window(self, n_bytes: int) -> None:
-        """Window accounting for a batched decrypt (the flusher launches;
-        every coalesced window still counts, so `dispatches_per_window`
-        reads `launches/windows <= 1/occupancy`)."""
+        """Window accounting for a batched window — either direction (the
+        flusher launches; every coalesced window still counts, so
+        `dispatches_per_window` reads `launches/windows <= 1/occupancy`)."""
         with self._stats_lock:
             self.dispatch_stats.windows += 1
             self.dispatch_stats.bytes_in += n_bytes
@@ -303,7 +311,7 @@ class TpuTransformBackend(TransformBackend):
         if opts.compression:
             out = self._compress_batch(out, opts)
         if opts.encryption is not None:
-            out = self._encrypt_finish(self._encrypt_dispatch(out, opts))
+            out = self._finish_or_empty(self._dispatch_encrypt_window(out, opts))
         return out
 
     #: Staged windows kept in flight before blocking on the oldest: at depth
@@ -349,15 +357,33 @@ class TpuTransformBackend(TransformBackend):
                 iv_offset += len(chunks)
             if opts.compression:
                 chunks = self._compress_batch(chunks, w_opts)
-            staged = self._encrypt_dispatch(chunks, w_opts) if chunks else None
+            staged = self._dispatch_encrypt_window(chunks, w_opts) if chunks else None
             pending.append(staged)
             while len(pending) > max(1, self.pipeline_depth):
                 yield self._finish_or_empty(pending.popleft())
         while pending:
             yield self._finish_or_empty(pending.popleft())
 
+    def _dispatch_encrypt_window(self, chunks: list[bytes], opts: TransformOptions):
+        """Dispatch one encrypt window asynchronously. With the batcher
+        enabled the window joins the shared work-class-aware device queue
+        (`submit_encrypt` — idle batchers dispatch inline, CONCURRENT
+        produces coalesce into one merged varlen launch); otherwise, or
+        for windows with zero-length chunks (excluded by the merged
+        launch's varlen contract), it stages directly. Either way the
+        return is un-materialized: `_finish_or_empty` blocks pipeline_depth
+        windows later."""
+        batcher = self.batcher
+        if batcher is not None and min(len(c) for c in chunks) > 0:
+            return batcher.submit_encrypt(chunks, opts)
+        return self._encrypt_dispatch(chunks, opts)
+
     def _finish_or_empty(self, staged) -> list[bytes]:
-        return [] if staged is None else self._encrypt_finish(staged)
+        if staged is None:
+            return []
+        if hasattr(staged, "wait"):  # batched: an _EncryptHandle
+            return staged.wait()
+        return self._encrypt_finish(staged)
 
     @_spanned("transform.compress")
     def _compress_batch(self, chunks: list[bytes], opts: TransformOptions) -> list[bytes]:
@@ -673,23 +699,36 @@ def _definition():
     ))
     d.define(ConfigKey(
         "batch.enabled", "bool", default=False, importance="medium",
-        doc="Coalesce decrypt windows from CONCURRENT requests into shared "
-            "fused launches (transform/batcher.py): one device queue whose "
-            "flush policy is deadline-aware, grouped by the bucket_max_bytes "
-            "jit-shape ladder so coalescing never retraces. A submit that "
-            "finds the batcher idle dispatches inline (the single-waiter "
-            "fast path), so light load pays zero added latency. Default "
-            "off: every window dispatches unbatched, exactly the pre-batch "
+        doc="Coalesce GCM windows from CONCURRENT requests into shared "
+            "fused launches (transform/batcher.py): one work-class-aware "
+            "device queue (latency fetch decrypts / throughput produce "
+            "encrypts / background scrub verification — classes never "
+            "share a merged launch) whose flush policy is deadline- and "
+            "class-aware, grouped by the bucket_max_bytes jit-shape ladder "
+            "so coalescing never retraces. A foreground submit that finds "
+            "the batcher idle dispatches inline (the single-waiter fast "
+            "path), so light load pays zero added latency. Default off: "
+            "every window dispatches unbatched, exactly the pre-batch "
             "path.",
     ))
     d.define(ConfigKey(
         "batch.wait.ms", "long", default=2, validator=in_range(0, None),
         importance="medium",
-        doc="Max added wait (ms) a queued decrypt window tolerates before "
-            "its bucket flushes regardless of occupancy. Flushes also fire "
-            "when batch.windows or batch.bytes is reached, or when the "
-            "oldest waiter's remaining deadline minus the observed launch "
-            "p95 hits the floor.",
+        doc="Max added wait (ms) a queued foreground (latency/throughput "
+            "class) window tolerates before its bucket flushes regardless "
+            "of occupancy. Flushes also fire when batch.windows or "
+            "batch.bytes is reached, or when the oldest waiter's remaining "
+            "deadline minus the observed launch p95 hits the floor.",
+    ))
+    d.define(ConfigKey(
+        "batch.background.max.age.ms", "long", default=50,
+        validator=in_range(0, None), importance="low",
+        doc="Starvation-watchdog bound (ms) for background-class (scrub / "
+            "anti-entropy verification) windows on the shared device "
+            "queue: the max age a background bucket may sit queued under "
+            "sustained foreground pressure before it must flush (admission "
+            "budget permitting) — bounded forward progress without letting "
+            "background work bite foreground latency.",
     ))
     d.define(ConfigKey(
         "batch.windows", "int", default=16, validator=in_range(2, None),

@@ -45,6 +45,24 @@ ROADMAP-item-4 remainders:
    engine's own verdict, and flight records carrying the shared-launch
    evidence (``gcm.batch:<id>``).
 
+ISSUE 16 put the integrity daemons INSIDE the chaos window and proved the
+work-class scheduler isolates them from the latency path:
+
+8. **Scrub under chaos** — every instance runs the scrubber (1s period,
+   CRC32C over recorded ``chunkChecksums``) and the anti-entropy repairer
+   (1.5s period) THROUGH both kills. The gate: each survivor shows scrub
+   chunk verification and anti-entropy passes strictly AFTER the replica
+   kill opened the chaos window, zero corrupt chunks, and — per gate 1 —
+   every SLO verdict still all-ok.
+9. **Latency isolation in the probe** — the batched capacity-probe phase
+   re-runs with ``PROBE_SCRUB_STREAMS`` closed-loop verification workers
+   decrypting through the SAME device queue under the BACKGROUND work
+   class (rate-limited by the scheduler's admission class exactly as the
+   rsm wires ``scrub.rate.bytes``). The judge is the SLO engine's own
+   fetch-latency verdict — still ok with scrub racing the storm — while
+   scrub verification throughput stays > 0; fetch p99 with/without the
+   active scrub is recorded as the isolation trajectory number.
+
 Writes ``artifacts/load_report.json`` (re-read + re-validated) and the
 bench-trajectory point ``BENCH_LOAD_r01.json`` (throughput, p50/p99,
 shed %, failover count, cache-tier hit %, probe occupancy + GiB/s) so
@@ -123,6 +141,20 @@ PROBE_CHUNKS_PER_SEGMENT = 32
 PROBE_WINDOW = 8          # chunks per consumer read = one decrypt window
 PROBE_READS_PER_STREAM = 2
 PROBE_SLO_THRESHOLD_MS = 15_000.0
+
+#: Scrub under chaos (ISSUE 16): the integrity daemons run INSIDE the
+#: chaos window on every instance — periods small enough that passes land
+#: between the kills and keep landing through overload + recovery.
+SCRUB_INTERVAL_MS = 1_000
+SCRUB_RATE_BYTES = 4 * 1024 * 1024
+ANTIENTROPY_INTERVAL_MS = 1_500
+
+#: Capacity-probe isolation phase (ISSUE 16 tentpole proof): this many
+#: closed-loop background-class verification threads decrypt through the
+#: SAME batched backend while the fetch storm replays; the scheduler must
+#: keep the fetch SLO verdict ok while their throughput stays > 0.
+PROBE_SCRUB_STREAMS = 4
+PROBE_SCRUB_RATE_BYTES = 8 * 1024 * 1024
 
 
 def segment_payload(i: int) -> bytes:
@@ -215,6 +247,21 @@ def make_rsm(name: str, tmp: pathlib.Path) -> RemoteStorageManager:
         "slo.fetch.latency.objective.percent": 99,
         "slo.error.rate.objective.percent": 99,
         "slo.shed.rate.max.percent": SHED_MAX_PERCENT,
+        # ISSUE 16: the integrity daemons share the fleet with the chaos
+        # load. The scrub walk CRC32C-verifies every chunk (checksums are
+        # recorded at upload) on a 1s period; anti-entropy converges the
+        # 2-replica store on a 1.5s period. Storage IO is token-bucketed
+        # host-side; any device GCM verification submits under the
+        # scheduler's background admission class. Repair stays off: a
+        # produce in flight (log up, manifest not yet) is a transient
+        # orphan finding, never a deletion.
+        "scrub.enabled": True,
+        "scrub.interval.ms": SCRUB_INTERVAL_MS,
+        "scrub.rate.bytes": SCRUB_RATE_BYTES,
+        "scrub.checksums.enabled": True,
+        "replication.antientropy.enabled": True,
+        "replication.antientropy.interval.ms": ANTIENTROPY_INTERVAL_MS,
+        "replication.antientropy.rate.bytes": SCRUB_RATE_BYTES,
     })
     return rsm
 
@@ -272,6 +319,10 @@ class Coordinator:
         self.requests = 0
         self.replica_killed_at = None
         self.instance_killed_at = None
+        #: Scrub/anti-entropy counters snapshotted the instant the chaos
+        #: window opens (replica kill): the end-of-run gate asserts the
+        #: daemons made strict progress AFTER this point.
+        self.scrub_at_chaos = None
         self.byte_diffs = 0
         self.retries = 0
         self.client_errors = 0
@@ -285,6 +336,16 @@ class Coordinator:
             n = self.requests
             if n == KILL_REPLICA_AT and self.replica_killed_at is None:
                 self.replica_killed_at = n
+                # The chaos window opens: snapshot each instance's scrub /
+                # anti-entropy progress so the end-of-run gate can prove
+                # the daemons kept verifying THROUGH the kills.
+                self.scrub_at_chaos = {
+                    name: {
+                        "chunks_verified": self.rsms[name].scrubber.chunks_verified_total,
+                        "antientropy_passes": self.rsms[name].antientropy.passes,
+                    }
+                    for name in self.alive
+                }
                 # Replica a's data vanishes fleet-wide: every pre-kill
                 # object on it becomes a failover to replica b.
                 (self.tmp / "replica-a").rename(self.tmp / "replica-a.dead")
@@ -571,7 +632,7 @@ def _build_probe_chain(batch: bool):
         short_window_s=1.0,
         long_window_s=4.0,
     )
-    return backend, cache, segments, recorder, engine
+    return backend, cache, segments, recorder, engine, fetcher
 
 
 def capacity_probe(streams: int) -> dict:
@@ -580,10 +641,13 @@ def capacity_probe(streams: int) -> dict:
     shape: start offsets staggered across each segment), batching ON, then
     the identical workload against a batching-OFF control chain."""
 
-    def run_mode(batch: bool) -> dict:
-        backend, cache, segments, recorder, engine = _build_probe_chain(batch)
+    def run_mode(batch: bool, scrub_streams: int = 0) -> dict:
+        backend, cache, segments, recorder, engine, fetcher = (
+            _build_probe_chain(batch)
+        )
         windows_per_segment = PROBE_CHUNKS_PER_SEGMENT // PROBE_WINDOW
         errors: list = []
+        latencies_ms: list[float] = []
         started = threading.Barrier(min(streams, 256))
 
         def consumer(c: int) -> None:
@@ -596,10 +660,62 @@ def capacity_probe(streams: int) -> dict:
             for r in range(PROBE_READS_PER_STREAM):
                 w = (start_w + r) % windows_per_segment
                 ids = list(range(w * PROBE_WINDOW, (w + 1) * PROBE_WINDOW))
+                t0 = time.monotonic()
                 with recorder.request("probe.fetch", trace_id=f"p-{c}-{r}"):
                     got = cache.get_chunks(key, manifest, ids)
+                latencies_ms.append((time.monotonic() - t0) * 1000.0)
                 if got != chunks[ids[0] : ids[-1] + 1]:
                     errors.append((c, w))
+
+        # ISSUE 16 isolation phase: closed-loop scrub-verification workers
+        # decrypting through the SAME backend under the BACKGROUND work
+        # class while the fetch storm runs — the scheduler's admission
+        # class + starvation watchdog pace them, never the fetch buckets.
+        scrub_stop = threading.Event()
+        scrub_errors: list = []
+        scrub_counts = Counter()
+        t_chunk = PROBE_CHUNK + 28  # transformed chunk: 12B IV + 16B tag
+
+        def scrub_worker(w: int) -> None:
+            from tieredstorage_tpu.transform.api import DetransformOptions
+            from tieredstorage_tpu.transform.scheduler import (
+                BACKGROUND,
+                work_class_scope,
+            )
+
+            i = w
+            while not scrub_stop.is_set():
+                key, manifest, chunks = segments[i % PROBE_SEGMENTS]
+                wi = (i // PROBE_SEGMENTS) % windows_per_segment
+                ids = list(range(wi * PROBE_WINDOW, (wi + 1) * PROBE_WINDOW))
+                blob = scrub_blobs[key.value]
+                stored = [
+                    blob[c * t_chunk : (c + 1) * t_chunk] for c in ids
+                ]
+                opts = DetransformOptions.from_manifest(manifest)
+                with work_class_scope(BACKGROUND):
+                    out = backend.detransform(stored, opts)
+                if out != chunks[ids[0] : ids[-1] + 1]:
+                    scrub_errors.append((w, wi))
+                scrub_counts["chunks"] += len(ids)
+                scrub_counts["bytes"] += sum(len(b) for b in stored)
+                i += scrub_streams
+
+        scrub_threads = []
+        scrub_blobs: dict[str, bytes] = dict(fetcher.blobs)
+        if scrub_streams:
+            from tieredstorage_tpu.transform.scheduler import BACKGROUND
+
+            # The background class is rate-limited exactly the way the rsm
+            # wires `scrub.rate.bytes`: scheduler admission, not a
+            # host-side token bucket.
+            backend.batcher.set_class_rate(BACKGROUND, PROBE_SCRUB_RATE_BYTES)
+            scrub_threads = [
+                threading.Thread(
+                    target=scrub_worker, args=(w,), name=f"probe-scrub-{w}"
+                )
+                for w in range(scrub_streams)
+            ]
 
         ticking = threading.Event()
 
@@ -614,11 +730,16 @@ def capacity_probe(streams: int) -> dict:
         ]
         t0 = time.monotonic()
         tick_thread.start()
+        for t in scrub_threads:
+            t.start()
         for t in threads:
             t.start()
         for t in threads:
             t.join(timeout=600)
         elapsed_s = time.monotonic() - t0
+        scrub_stop.set()
+        for t in scrub_threads:
+            t.join(timeout=60)
         ticking.set()
         tick_thread.join(timeout=10)
         verdicts = engine.evaluate()
@@ -630,11 +751,14 @@ def capacity_probe(streams: int) -> dict:
             if rec.counters.get("gcm.batched_windows")
         )
         batcher = backend.batcher
+        sorted_lat = sorted(latencies_ms)
         mode = {
             "streams": streams,
             "reads": streams * PROBE_READS_PER_STREAM,
             "byte_errors": len(errors),
             "elapsed_s": round(elapsed_s, 2),
+            "fetch_p50_ms": round(percentile(sorted_lat, 0.50), 2),
+            "fetch_p99_ms": round(percentile(sorted_lat, 0.99), 2),
             "aggregate_gibs": round(
                 served_bytes / (1 << 30) / max(elapsed_s, 1e-9), 4
             ),
@@ -654,6 +778,22 @@ def capacity_probe(streams: int) -> dict:
                 "fast_path_windows": batcher.fast_path_windows,
                 "expired_windows": batcher.expired_windows,
             })
+        if scrub_streams:
+            from tieredstorage_tpu.transform.scheduler import BACKGROUND
+
+            mode["scrub"] = {
+                "streams": scrub_streams,
+                "chunks_verified": scrub_counts["chunks"],
+                "bytes_verified": scrub_counts["bytes"],
+                "verify_mibs": round(
+                    scrub_counts["bytes"] / (1 << 20) / max(elapsed_s, 1e-9), 3
+                ),
+                "byte_errors": len(scrub_errors),
+                "background_windows_flushed": (
+                    batcher.class_flushed_windows[BACKGROUND]
+                ),
+                "background_launches": batcher.class_launches[BACKGROUND],
+            }
         cache.close()
         backend.close()
         assert errors == [], f"byte diffs from probe streams {errors[:5]}"
@@ -662,8 +802,13 @@ def capacity_probe(streams: int) -> dict:
         return mode
 
     batched = run_mode(batch=True)
+    isolated = run_mode(batch=True, scrub_streams=PROBE_SCRUB_STREAMS)
     control = run_mode(batch=False)
-    probe = {"batched": batched, "unbatched_control": control}
+    probe = {
+        "batched": batched,
+        "batched_with_scrub": isolated,
+        "unbatched_control": control,
+    }
     # The tentpole gates (ISSUE 15 acceptance): coalescing engaged, and
     # strictly fewer launches per window than the control in the SAME run.
     assert batched["batch_mean_occupancy"] > 1.0, batched
@@ -674,6 +819,23 @@ def capacity_probe(streams: int) -> dict:
     assert control["dispatches_per_window"] == 1.0, control
     assert batched["hbm_roundtrips_per_window"] <= 1.0, batched
     assert batched["flight_records_with_batch_evidence"] > 0, batched
+    # ISSUE 16 isolation gates: with background-class scrub verification
+    # racing the same device queue, the judge is the SLO engine's OWN
+    # verdict over the live fetch histogram (not a hardcoded threshold) —
+    # it must stay ok while verification throughput stays > 0 and the
+    # background windows demonstrably flowed through the shared scheduler.
+    scrub = isolated["scrub"]
+    assert isolated["slo_ok"], isolated
+    assert isolated["byte_errors"] == 0, isolated
+    assert scrub["byte_errors"] == 0, scrub
+    assert scrub["chunks_verified"] > 0, scrub
+    assert scrub["background_windows_flushed"] > 0, scrub
+    probe["isolation"] = {
+        "fetch_p99_ms_without_scrub": batched["fetch_p99_ms"],
+        "fetch_p99_ms_with_scrub": isolated["fetch_p99_ms"],
+        "scrub_verify_mibs_during_storm": scrub["verify_mibs"],
+        "scrub_chunks_verified_during_storm": scrub["chunks_verified"],
+    }
     return probe
 
 
@@ -1002,6 +1164,43 @@ def run(out_path: pathlib.Path, bench_path: pathlib.Path) -> int:
             }
         report["flight"] = flight_section
 
+        # -------------------------------------- scrub under chaos (ISSUE 16)
+        # The integrity daemons ran INSIDE the chaos window: every survivor
+        # must show scrub + anti-entropy progress strictly AFTER the
+        # replica kill opened the window, with zero corruption found and —
+        # established above — every SLO verdict still all-ok. The victim's
+        # daemons are irrelevant: its gateway is dead, its counters frozen.
+        assert coord.scrub_at_chaos is not None, "chaos window never opened"
+        scrub_section = {}
+        for name in survivors:
+            scrubber = rsms[name].scrubber
+            ae = rsms[name].antientropy
+            at_kill = coord.scrub_at_chaos[name]
+            scrub_section[name] = {
+                "passes": scrubber.passes,
+                "chunks_verified_total": scrubber.chunks_verified_total,
+                "chunks_verified_at_chaos": at_kill["chunks_verified"],
+                "bytes_scanned_total": scrubber.bytes_scanned_total,
+                "corrupt_chunks_total": scrubber.corrupt_chunks_total,
+                "missing_objects_total": scrubber.missing_objects_total,
+                "antientropy_passes": ae.passes,
+                "antientropy_passes_at_chaos": at_kill["antientropy_passes"],
+                "antientropy_repairs_total": ae.repairs_total,
+                "antientropy_diffs_total": ae.diffs_total,
+            }
+            assert scrubber.passes > 0, f"{name}: scrubber never ran"
+            assert (
+                scrubber.chunks_verified_total > at_kill["chunks_verified"]
+            ), f"{name}: no scrub verification inside the chaos window"
+            assert ae.passes > at_kill["antientropy_passes"], (
+                f"{name}: no anti-entropy pass inside the chaos window"
+            )
+            # The store is healthy modulo the staged kill: the scrubber
+            # must not cry corruption (transient orphan findings from
+            # produces in flight are expected and benign — repair is off).
+            assert scrubber.corrupt_chunks_total == 0, scrub_section[name]
+        report["scrub_under_chaos"] = scrub_section
+
         # ------------------------------------------------ capacity probe
         # ISSUE 15 tentpole proof: the massed consumer-group-replay phase
         # at >= 512 concurrent streams with cross-request batching on vs
@@ -1070,6 +1269,15 @@ def run(out_path: pathlib.Path, bench_path: pathlib.Path) -> int:
         "probe_unbatched_gibs": (
             report["capacity_probe"]["unbatched_control"]["aggregate_gibs"]
         ),
+        "probe_fetch_p99_ms_without_scrub": (
+            report["capacity_probe"]["isolation"]["fetch_p99_ms_without_scrub"]
+        ),
+        "probe_fetch_p99_ms_with_scrub": (
+            report["capacity_probe"]["isolation"]["fetch_p99_ms_with_scrub"]
+        ),
+        "probe_scrub_verify_mibs": (
+            report["capacity_probe"]["isolation"]["scrub_verify_mibs_during_storm"]
+        ),
         "workload": (
             f"{WORKERS} closed-loop workers x {REQUESTS_PER_WORKER} zipf({ZIPF_EXPONENT}) "
             f"fetches + {PRODUCED_SEGMENTS} produces over a 3-instance fleet / "
@@ -1115,6 +1323,20 @@ def run(out_path: pathlib.Path, bench_path: pathlib.Path) -> int:
         < probe["unbatched_control"]["dispatches_per_window"]
     )
     assert probe["batched"]["slo_ok"] and probe["unbatched_control"]["slo_ok"]
+    assert probe["batched_with_scrub"]["slo_ok"]
+    assert probe["batched_with_scrub"]["scrub"]["chunks_verified"] > 0
+    assert probe["batched_with_scrub"]["scrub"]["byte_errors"] == 0
+    assert probe["batched_with_scrub"]["scrub"]["background_windows_flushed"] > 0
+    scrub_chaos = parsed["scrub_under_chaos"]
+    assert all(
+        v["chunks_verified_total"] > v["chunks_verified_at_chaos"]
+        for v in scrub_chaos.values()
+    )
+    assert all(
+        v["antientropy_passes"] > v["antientropy_passes_at_chaos"]
+        for v in scrub_chaos.values()
+    )
+    assert all(v["corrupt_chunks_total"] == 0 for v in scrub_chaos.values())
     parsed_bench = json.loads(bench_path.read_text())
     assert parsed_bench["value"] == parsed["client"]["p99_ms"]
     print(
@@ -1129,6 +1351,12 @@ def run(out_path: pathlib.Path, bench_path: pathlib.Path) -> int:
         f"probe_occupancy={probe['batched']['batch_mean_occupancy']} "
         f"probe_dpw={probe['batched']['dispatches_per_window']} "
         f"(control {probe['unbatched_control']['dispatches_per_window']}) "
+        f"scrub_chunks="
+        f"{sum(v['chunks_verified_total'] for v in scrub_chaos.values())} "
+        f"isolation_p99="
+        f"{probe['isolation']['fetch_p99_ms_with_scrub']}ms"
+        f"(no-scrub {probe['isolation']['fetch_p99_ms_without_scrub']}ms) "
+        f"scrub_mibs={probe['isolation']['scrub_verify_mibs_during_storm']} "
         f"byte_diffs=0 out={out_path}"
     )
     return 0

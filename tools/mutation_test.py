@@ -94,6 +94,15 @@ DEFAULT_TARGETS = [
     # silently stops coalescing, mixes buckets, or hands a caller its
     # batch-mate's rows.
     ("tieredstorage_tpu/transform/batcher.py", ["tests/test_window_batcher.py"]),
+    # ISSUE 16: the work-class scheduler's pure policy arithmetic —
+    # class ranking/deficit priority, the background starvation bound,
+    # and the admission refill/defer math. An operator flip silently
+    # inverts a flush decision, lets background starve, or collapses the
+    # pacing that keeps scrub off the latency path.
+    (
+        "tieredstorage_tpu/transform/scheduler.py",
+        ["tests/test_device_scheduler.py"],
+    ),
 ]
 
 _CMP_SWAP = {
