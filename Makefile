@@ -190,7 +190,7 @@ lint: analyze
 # /root/reference/build.gradle:24): flips operators in core pure-logic
 # modules and requires the owning suites to notice.
 mutation:
-	$(PYTHON) tools/mutation_test.py --budget 120
+	$(PYTHON) tools/mutation_test.py --budget 135
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} +

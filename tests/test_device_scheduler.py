@@ -294,7 +294,7 @@ class TestSchedulerPolicy:
         assert due == [lat_key, bg_key]
         # And the drain path launches in the same order.
         order: list = []
-        batcher.on_flush = lambda occ, added, cls: order.append(cls)
+        batcher.on_flush = lambda occ, added, cls, *rest: order.append(cls)
         assert batcher.flush_now() == 2
         assert order == [LATENCY, BACKGROUND]
         batcher._backend.close()
@@ -403,7 +403,7 @@ class TestClassIsolation:
         job_b = scoped_submit(batcher, wire_b, BACKGROUND)
         wait_queued(batcher, 2)
         classes: list = []
-        batcher.on_flush = lambda occ, added, cls: classes.append((cls, occ))
+        batcher.on_flush = lambda occ, added, cls, *rest: classes.append((cls, occ))
         with batcher._cond:
             assert len(batcher._buckets) == 2
         assert batcher.flush_now() == 2

@@ -69,6 +69,7 @@ def test_metrics_rst_covers_all_groups():
         "s3-client-metrics",
         "gcs-client-metrics",
         "azure-blob-client-metrics",
+        "timeline-metrics",
     ):
         assert f"Group ``{group}``" in rst
     for name in (
@@ -93,6 +94,10 @@ def test_metrics_rst_covers_all_groups():
         "object-download-requests-total",
         "blob-upload-requests-total",
         "throttling-errors-total",
+        "timeline-events-evicted-total",
+        "timeline-ring-occupancy",
+        "batch-class-latency-added-wait-time-ms",
+        "batch-class-latency-last-batch-id",
     ):
         assert f"``{name}``" in rst
 

@@ -297,3 +297,28 @@ class TestHistogramExemplars:
         h.record(5.0, 0.0)
         assert h.exemplars() == []
         assert h.count == 1  # the observation itself still lands
+
+    def test_explicit_trace_id_needs_no_ambient_record(self):
+        # ISSUE 17: the batch flusher thread has no ambient flight record,
+        # so per-class added-wait exemplars arrive via the explicit param.
+        h = Histogram(buckets=(10.0,))
+        h.record(3.0, 0.0, trace_id="t-hook")
+        assert h.exemplars() == [(10.0, "t-hook", 3.0)]
+
+    def test_explicit_trace_id_overrides_ambient(self):
+        from tieredstorage_tpu.utils.flightrecorder import FlightRecorder
+
+        recorder = FlightRecorder(enabled=True)
+        h = Histogram(buckets=(10.0,))
+        with recorder.request("r", trace_id="t-ambient"):
+            h.record(3.0, 0.0, trace_id="t-explicit")
+        assert h.exemplars() == [(10.0, "t-explicit", 3.0)]
+
+    def test_none_trace_id_falls_back_to_ambient(self):
+        from tieredstorage_tpu.utils.flightrecorder import FlightRecorder
+
+        recorder = FlightRecorder(enabled=True)
+        h = Histogram(buckets=(10.0,))
+        with recorder.request("r", trace_id="t-ambient"):
+            h.record(3.0, 0.0, trace_id=None)
+        assert h.exemplars() == [(10.0, "t-ambient", 3.0)]

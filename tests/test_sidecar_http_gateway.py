@@ -522,6 +522,8 @@ class TestObservabilityRoutes:
                 "deadline.default.ms": 30_000,
                 "fleet.enabled": True,
                 "fleet.instance.id": "obs",
+                "timeline.enabled": True,
+                "timeline.ring.size": 64,
             })
             gw = SidecarHttpGateway(rsm).start()
             yield gw, rsm, _json
@@ -529,8 +531,9 @@ class TestObservabilityRoutes:
             rsm.close()
 
     def test_disabled_routes_map_to_404(self, gateway):
-        # The module-scope gateway runs without slo/flight/fleet.
-        for path in ("/slo", "/debug/requests", "/fleet/telemetry"):
+        # The module-scope gateway runs without slo/flight/fleet/timeline.
+        for path in ("/slo", "/debug/requests", "/fleet/telemetry",
+                     "/debug/timeline"):
             status, body = _get(gateway.port, path)
             assert status == 404, (path, body)
 
@@ -591,6 +594,55 @@ class TestObservabilityRoutes:
         for bad in ("abc", "-1", "0", ""):
             status, _ = _get(gw.port, f"/debug/requests?n={bad}")
             assert status == 400, bad
+
+    def test_debug_requests_trace_and_slowest_filters(self, obs_gateway):
+        """ISSUE 17: the fleet stitcher's per-member query — ?trace=<id>
+        filters to one trace's records (404 when nothing retained carries
+        it), ?slowest=<n> returns just the n slowest completed records."""
+        gw, rsm, json = obs_gateway
+        with rsm.flight_recorder.request(
+            "gateway.fetch", trace_id="trace-filter-1"
+        ):
+            pass
+        status, body = _get(gw.port, "/debug/requests?trace=trace-filter-1")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["trace"] == "trace-filter-1"
+        assert payload["failed"] == []
+        assert {r["trace_id"] for r in payload["slowest"]} == {
+            "trace-filter-1"
+        }
+        status, body = _get(gw.port, "/debug/requests?trace=no-such-trace")
+        assert status == 404, body
+        status, body = _get(gw.port, "/debug/requests?trace=")
+        assert status == 400, body
+        status, body = _get(gw.port, "/debug/requests?slowest=1")
+        assert status == 200
+        payload = json.loads(body)
+        assert len(payload["slowest"]) == 1
+        assert payload["failed"] == []
+        for bad in ("abc", "-1", "0", ""):
+            status, _ = _get(gw.port, f"/debug/requests?slowest={bad}")
+            assert status == 400, bad
+
+    def test_debug_timeline_route(self, obs_gateway):
+        gw, rsm, json = obs_gateway
+        rsm.timeline.record_flush(
+            batch_id=3, work_class="latency", decrypt=True,
+            bucket_bytes=4096, rows=2, n_bytes=8192, occupancy=2,
+            queued_age_ms=1.0, begin_s=1.0, end_s=1.002,
+        )
+        status, body = _get(gw.port, "/debug/timeline")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["enabled"] is True
+        assert payload["ring_size"] == 64
+        assert payload["launches_recorded"] >= 1
+        assert set(payload["epoch"]) == {"wall_s", "mono_s"}
+        flushes = [e for e in payload["events"] if e["kind"] == "flush"]
+        assert any(e["batch_id"] == 3 for e in flushes)
+        # v1-prefixed alias, like every other route.
+        assert _get(gw.port, "/v1/debug/timeline")[0] == 200
 
     def test_fleet_telemetry_route(self, obs_gateway):
         gw, _, json = obs_gateway

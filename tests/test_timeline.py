@@ -1,0 +1,832 @@
+"""ISSUE 17: device-scheduler timeline ring + fleet-stitched timelines.
+
+Covers the recorder exactly (fake-clock event ordering, FIFO ring
+eviction math, the disabled-mode zero-work contract under a poisoned
+lock — the mutation-testing surface), the batcher -> timeline feed (a
+real merged flush records its full scheduler context, with the waiters'
+flight-recorder trace ids captured at enqueue on the request threads),
+the Chrome-trace export (required ``ph``/``ts``/``pid``/``tid`` keys,
+per-track monotonic timestamps, the flow-event join on ``gcm.batch:<id>``
+and its per-instance category scoping), the pure fleet stitcher
+(hop-edge causal order — never raw cross-instance clocks), and the
+assemble path over real HTTP gateways (two instances, one traceparent).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import random
+import threading
+import time
+
+import pytest
+
+from tieredstorage_tpu.fleet.telemetry import FleetTelemetry, stitch_trace
+from tieredstorage_tpu.metrics.timeline import (
+    BATCH_STAGE_PREFIX,
+    CLASS_TIDS,
+    NOOP_TIMELINE,
+    TimelineRecorder,
+    batch_ids_of,
+    chrome_trace_events,
+    flow_cat,
+    launch_chrome_events,
+    register_timeline_metrics,
+    request_chrome_events,
+    validate_chrome_events,
+)
+
+
+def flush_kwargs(**overrides):
+    base = dict(
+        batch_id=1, work_class="latency", decrypt=True, bucket_bytes=1024,
+        rows=2, n_bytes=2048, occupancy=2, queued_age_ms=1.5,
+        begin_s=10.0, end_s=10.002,
+    )
+    base.update(overrides)
+    return base
+
+
+class _PoisonLock:
+    def __enter__(self):
+        raise AssertionError("disabled timeline acquired its lock")
+
+    def __exit__(self, *exc):  # pragma: no cover — never entered
+        return False
+
+
+class TestRecorderRing:
+    def test_ctor_validates_ring_size(self):
+        with pytest.raises(ValueError):
+            TimelineRecorder(enabled=True, ring_size=0)
+
+    def test_fake_clock_event_ordering(self):
+        """Events retain INSERTION order (the ring is FIFO by arrival),
+        and record_expired stamps the injected clock when no explicit
+        instant is given."""
+        clock = [100.0]
+        rec = TimelineRecorder(enabled=True, time_source=lambda: clock[0])
+        rec.record_flush(**flush_kwargs(batch_id=1, begin_s=100.0))
+        clock[0] = 100.5
+        rec.record_expired("background", 2)
+        clock[0] = 101.0
+        rec.record_flush(**flush_kwargs(batch_id=2, begin_s=101.0))
+        events = rec.events()
+        assert [e["kind"] for e in events] == ["flush", "expired", "flush"]
+        assert events[1]["begin_s"] == 100.5
+        assert events[1]["count"] == 2
+        assert [e.get("batch_id") for e in events] == [1, None, 2]
+        rec.record_expired("latency", 1, at_s=42.0)
+        assert rec.events()[-1]["begin_s"] == 42.0
+
+    def test_flush_event_carries_full_scheduler_context(self):
+        rec = TimelineRecorder(enabled=True)
+        rec.record_flush(**flush_kwargs(
+            batch_id=9, work_class="throughput", decrypt=False,
+            bucket_bytes=4096, rows=8, n_bytes=30_000, occupancy=5,
+            queued_age_ms=3.25, queue_depths={"latency": 1, "background": 2},
+            trace_ids=["t1", None, "t2", ""],
+        ))
+        (ev,) = rec.events()
+        assert ev == {
+            "kind": "flush", "batch_id": 9, "work_class": "throughput",
+            "direction": "encrypt", "bucket_bytes": 4096, "rows": 8,
+            "bytes": 30_000, "occupancy": 5, "waiters": 5,
+            "queued_age_ms": 3.25, "begin_s": 10.0, "end_s": 10.002,
+            "queue_depths": {"latency": 1, "background": 2},
+            # Falsy ids filtered: only real flight-recorder trace ids join.
+            "trace_ids": ["t1", "t2"],
+        }
+        assert rec.launches_recorded == 1 and rec.expired_recorded == 0
+
+    def test_ring_eviction_math(self):
+        """Strict FIFO past ring_size, with EXPLICIT eviction accounting:
+        recorded - evicted == retained, oldest evicted first."""
+        rec = TimelineRecorder(enabled=True, ring_size=4)
+        for i in range(10):
+            rec.record_flush(**flush_kwargs(batch_id=i, begin_s=float(i)))
+        assert rec.events_recorded == 10
+        assert rec.events_evicted == 6
+        assert rec.ring_occupancy == 4
+        assert rec.events_recorded - rec.events_evicted == rec.ring_occupancy
+        assert [e["batch_id"] for e in rec.events()] == [6, 7, 8, 9]
+
+    def test_disabled_mode_is_zero_work(self):
+        """The LockWitness pattern: disabled recording is ONE attribute
+        read — a poisoned lock proves the lock is never acquired."""
+        rec = TimelineRecorder(enabled=False)
+        rec._lock = _PoisonLock()
+        rec.record_flush(**flush_kwargs())
+        rec.record_expired("latency", 1)
+        assert rec.events_recorded == 0
+        assert rec.events_evicted == 0
+        assert rec.launches_recorded == 0
+        assert rec.expired_recorded == 0
+        assert len(rec._ring) == 0
+        assert NOOP_TIMELINE.enabled is False
+
+    def test_status_payload(self):
+        rec = TimelineRecorder(enabled=True, ring_size=8)
+        rec.record_flush(**flush_kwargs())
+        rec.record_expired("background", 1)
+        status = rec.status()
+        assert status["enabled"] is True
+        assert status["ring_size"] == 8
+        assert status["ring_occupancy"] == 2
+        assert status["events_recorded"] == 2
+        assert status["events_evicted"] == 0
+        assert status["launches_recorded"] == 1
+        assert status["expired_recorded"] == 1
+        assert len(status["events"]) == 2
+        assert set(status["epoch"]) == {"wall_s", "mono_s"}
+        json.dumps(status)  # the /debug/timeline body must be JSON-safe
+
+    def test_epoch_pins_monotonic_to_wall_axis(self):
+        rec = TimelineRecorder(enabled=True)
+        epoch = rec.epoch()
+        assert rec.ts_us(epoch["mono_s"]) == pytest.approx(
+            epoch["wall_s"] * 1e6
+        )
+        assert rec.ts_us(epoch["mono_s"] + 1.0) == pytest.approx(
+            (epoch["wall_s"] + 1.0) * 1e6
+        )
+
+    def test_epoch_reads_injected_wall_clock_exactly_once(self):
+        walls = [1000.0, 9999.0]  # a second read would expose drift
+        rec = TimelineRecorder(
+            enabled=True,
+            time_source=lambda: 50.0,
+            wall_clock=lambda: walls.pop(0),
+        )
+        assert rec.epoch() == {"wall_s": 1000.0, "mono_s": 50.0}
+        assert rec.ts_us(52.5) == pytest.approx(1002.5 * 1e6)
+        assert walls == [9999.0]
+
+    def test_registered_gauges_read_live_counters(self):
+        from tieredstorage_tpu.metrics.core import MetricConfig, MetricsRegistry
+
+        registry = MetricsRegistry(MetricConfig())
+        rec = TimelineRecorder(enabled=True, ring_size=2)
+        register_timeline_metrics(registry, rec)
+        for i in range(3):
+            rec.record_flush(**flush_kwargs(batch_id=i))
+
+        def gauge(name):
+            (metric_name,) = registry.find(name)
+            return registry.value(metric_name)
+
+        assert gauge("timeline-enabled") == 1.0
+        assert gauge("timeline-events-recorded-total") == 3.0
+        assert gauge("timeline-events-evicted-total") == 1.0
+        assert gauge("timeline-launches-recorded-total") == 3.0
+        assert gauge("timeline-expired-recorded-total") == 0.0
+        assert gauge("timeline-ring-occupancy") == 2.0
+
+
+class TestBatchIdsOf:
+    def test_parses_batch_stage_markers_in_order(self):
+        record = {"stages": [
+            ["fetch", 1.0, None],
+            [f"{BATCH_STAGE_PREFIX}12", 2.0, None],
+            ["decrypt", 3.0, None],
+            [f"{BATCH_STAGE_PREFIX}7", 4.0, None],
+            [f"{BATCH_STAGE_PREFIX}nope", 5.0, None],  # malformed: skipped
+        ]}
+        assert batch_ids_of(record) == [12, 7]
+
+    def test_empty_and_absent_stages(self):
+        assert batch_ids_of({}) == []
+        assert batch_ids_of({"stages": []}) == []
+
+
+class TestBatcherFeedsTimeline:
+    """A REAL merged flush records its scheduler context, including the
+    waiters' trace ids captured at enqueue on the request threads (the
+    flusher thread has no ambient flight record)."""
+
+    def test_merged_flush_records_event_with_trace_ids(self):
+        pytest.importorskip("jax")
+        import numpy as np
+
+        from tieredstorage_tpu.security.aes import (
+            IV_SIZE,
+            TAG_SIZE,
+            AesEncryptionProvider,
+        )
+        from tieredstorage_tpu.transform.api import TransformOptions
+        from tieredstorage_tpu.transform.batcher import WindowBatcher
+        from tieredstorage_tpu.transform.tpu import TpuTransformBackend
+        from tieredstorage_tpu.utils.flightrecorder import FlightRecorder
+
+        dk = AesEncryptionProvider.create_data_key_and_aad()
+        rng = random.Random(17)
+        backend = TpuTransformBackend()
+        chunks = [bytes(rng.getrandbits(8) for _ in range(700))
+                  for _ in range(2)]
+        ivs = [(i + 1).to_bytes(4, "big") * 3 for i in range(2)]
+        wire = backend.transform(
+            chunks, TransformOptions(encryption=dk, ivs=ivs)
+        )
+        batcher = WindowBatcher(backend, wait_ms=50, max_windows=8)
+        timeline = TimelineRecorder(enabled=True)
+        batcher.timeline = timeline
+        flight = FlightRecorder(enabled=True)
+        # Park the fast path so both 1-window submits queue and merge.
+        with batcher._cond:
+            batcher._inflight += 1
+
+        def submit(i: int, box: list) -> None:
+            c = wire[i]
+            with flight.request(f"req-{i}", trace_id=f"trace-{i}"):
+                try:
+                    box[i] = batcher.submit(
+                        dk, [c[IV_SIZE:-TAG_SIZE]],
+                        [len(c) - IV_SIZE - TAG_SIZE],
+                        np.stack([np.frombuffer(c[:IV_SIZE], np.uint8)]),
+                        [c[-TAG_SIZE:]],
+                    )
+                except BaseException as exc:  # noqa: BLE001
+                    box[i] = exc
+
+        box: list = [None, None]
+        threads = [
+            threading.Thread(target=submit, args=(i, box)) for i in range(2)
+        ]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with batcher._cond:
+                if sum(len(v) for v in batcher._buckets.values()) >= 2:
+                    break
+            time.sleep(0.001)
+        assert batcher.flush_now() == 1
+        with batcher._cond:
+            batcher._inflight -= 1
+        for t in threads:
+            t.join(timeout=30)
+        assert box[0] == [chunks[0]] and box[1] == [chunks[1]]
+
+        (ev,) = timeline.events()
+        assert ev["kind"] == "flush"
+        assert ev["work_class"] == "latency"  # unscoped default
+        assert ev["direction"] == "decrypt"
+        assert ev["rows"] == 2 and ev["occupancy"] == 2
+        assert ev["batch_id"] > 0
+        assert ev["bytes"] == sum(len(c) - IV_SIZE - TAG_SIZE for c in wire)
+        assert ev["queued_age_ms"] >= 0.0
+        assert ev["end_s"] >= ev["begin_s"]
+        assert set(ev["queue_depths"]) == {
+            "latency", "throughput", "background"
+        }
+        # Trace ids captured at ENQUEUE on the request threads.
+        assert sorted(ev["trace_ids"]) == ["trace-0", "trace-1"]
+        assert timeline.launches_recorded == 1
+        backend.close()
+
+
+class TestChromeExport:
+    EPOCH = {"wall_s": 1000.0, "mono_s": 50.0}
+
+    def flush_event(self, batch_id=5, work_class="latency", begin_s=51.0):
+        return {
+            "kind": "flush", "batch_id": batch_id, "work_class": work_class,
+            "direction": "decrypt", "bucket_bytes": 1024, "rows": 2,
+            "bytes": 2048, "occupancy": 2, "waiters": 2,
+            "queued_age_ms": 1.0, "begin_s": begin_s, "end_s": begin_s + 0.004,
+            "queue_depths": {}, "trace_ids": ["t-1"],
+        }
+
+    def record(self, trace_id="t-1", start_s=50.9, batch_id=5,
+               name="gateway.fetch"):
+        return {
+            "name": name, "trace_id": trace_id, "start_s": start_s,
+            "duration_ms": 200.0, "error": None, "tiers": {"backend": 1},
+            "stages": [
+                ["fetch", 10.0, 1000.0],
+                [f"{BATCH_STAGE_PREFIX}{batch_id}", 120.0, 900.0],
+            ],
+        }
+
+    def test_launch_slice_and_flow_finish(self):
+        events = launch_chrome_events(
+            [self.flush_event()], pid=3, epoch=self.EPOCH
+        )
+        slice_ev, flow_ev = events
+        assert slice_ev["ph"] == "X"
+        assert slice_ev["name"] == "gcm.batch:5"
+        assert slice_ev["cat"] == "device-scheduler"
+        assert slice_ev["tid"] == CLASS_TIDS["latency"]
+        assert slice_ev["pid"] == 3
+        # Epoch-pinned wall microseconds: (1000 + (51 - 50)) * 1e6.
+        assert slice_ev["ts"] == pytest.approx(1001.0 * 1e6)
+        assert slice_ev["dur"] == pytest.approx(4000.0)
+        assert slice_ev["args"]["occupancy"] == 2
+        assert flow_ev["ph"] == "f" and flow_ev["bp"] == "e"
+        assert flow_ev["id"] == 5 and flow_ev["cat"] == flow_cat()
+        # The finish binds INSIDE the slice so Perfetto attaches the arrow.
+        assert slice_ev["ts"] < flow_ev["ts"] < slice_ev["ts"] + 4000.0
+
+    def test_expired_event_renders_as_instant(self):
+        ev = {"kind": "expired", "work_class": "background", "count": 3,
+              "begin_s": 51.0}
+        (out,) = launch_chrome_events([ev], pid=1, epoch=self.EPOCH)
+        assert out["ph"] == "i" and out["s"] == "t"
+        assert out["name"] == "gcm.expired"
+        assert out["tid"] == CLASS_TIDS["background"]
+        assert out["args"]["count"] == 3
+
+    def test_request_track_and_flow_start(self):
+        events = request_chrome_events(
+            [self.record()], pid=3, epoch=self.EPOCH, known_batches={5}
+        )
+        phases = [e["ph"] for e in events]
+        assert phases == ["X", "i", "i", "s"]
+        slice_ev = events[0]
+        assert slice_ev["cat"] == "request"
+        assert slice_ev["tid"] == 10  # REQUEST_TID_BASE
+        assert slice_ev["dur"] == pytest.approx(200.0 * 1e3)
+        flow_start = events[-1]
+        assert flow_start["id"] == 5
+        # The flow start sits AT the gcm.batch stage instant.
+        assert flow_start["ts"] == pytest.approx(
+            slice_ev["ts"] + 120.0 * 1e3
+        )
+
+    def test_unknown_batches_emit_no_dangling_flow_start(self):
+        events = request_chrome_events(
+            [self.record(batch_id=9)], pid=1, epoch=self.EPOCH,
+            known_batches={5},
+        )
+        assert [e["ph"] for e in events] == ["X", "i", "i"]
+
+    def test_records_without_start_are_skipped(self):
+        rec = self.record()
+        del rec["start_s"]
+        assert request_chrome_events(
+            [rec], pid=1, epoch=self.EPOCH
+        ) == []
+
+    def test_combined_export_is_schema_valid_and_joined(self):
+        # Deliberately out-of-order inputs: the export must sort by ts so
+        # every per-track sequence is monotonic.
+        events = chrome_trace_events(
+            [self.flush_event(batch_id=5, begin_s=53.0),
+             self.flush_event(batch_id=6, begin_s=51.0)],
+            [self.record(batch_id=5, start_s=50.9)],
+            pid=7, epoch=self.EPOCH, instance="g1",
+        )
+        count = validate_chrome_events(events)
+        assert count == len(events)
+        assert events[0]["ph"] == "M"
+        assert events[0]["args"]["name"] == "g1"
+        starts = [e for e in events if e["ph"] == "s"]
+        finishes = [e for e in events if e["ph"] == "f"]
+        assert [e["id"] for e in starts] == [5]
+        # Flow identity is (cat, name, id): start and finish share the
+        # instance-scoped category so two instances' batch #5 never join.
+        assert {e["cat"] for e in starts} == {flow_cat("g1")}
+        assert any(f["id"] == 5 and f["cat"] == flow_cat("g1")
+                   for f in finishes)
+
+    def test_validator_rejects_bad_events(self):
+        ok = {"name": "x", "ph": "i", "s": "t", "ts": 1.0, "pid": 1,
+              "tid": 1, "args": {}}
+        with pytest.raises(ValueError, match="missing 'ph'"):
+            validate_chrome_events([{k: v for k, v in ok.items()
+                                     if k != "ph"}])
+        with pytest.raises(ValueError, match="unknown phase"):
+            validate_chrome_events([{**ok, "ph": "Q"}])
+        with pytest.raises(ValueError, match="missing dur"):
+            validate_chrome_events([{**ok, "ph": "X"}])
+        with pytest.raises(ValueError, match="missing id"):
+            validate_chrome_events([{**ok, "ph": "s"}])
+        with pytest.raises(ValueError, match="not monotonic"):
+            validate_chrome_events([{**ok, "ts": 2.0}, {**ok, "ts": 1.0}])
+        # Different tracks are independent sequences.
+        assert validate_chrome_events(
+            [{**ok, "ts": 2.0}, {**ok, "ts": 1.0, "tid": 2}]
+        ) == 2
+
+    def test_recorder_export_chrome_trace_roundtrip(self):
+        rec = TimelineRecorder(enabled=True)
+        rec.record_flush(**flush_kwargs(batch_id=3))
+        doc = rec.export_chrome_trace()
+        assert set(doc) == {"traceEvents", "displayTimeUnit", "otherData"}
+        assert doc["displayTimeUnit"] == "ms"
+        assert validate_chrome_events(doc["traceEvents"]) == 2
+
+
+class TestStitchTrace:
+    """The pure stitcher: causal order comes from hop EDGES, never from
+    comparing raw clocks across instances."""
+
+    def instances(self, peer_epoch=None):
+        origin_launch = {
+            "kind": "flush", "batch_id": 4, "work_class": "latency",
+            "direction": "decrypt", "bucket_bytes": 1024, "rows": 2,
+            "bytes": 2048, "occupancy": 2, "waiters": 2, "queued_age_ms": 1.0,
+            "begin_s": 51.0, "end_s": 51.002, "queue_depths": {},
+            "trace_ids": ["t-x"],
+        }
+        peer_launch = dict(origin_launch, batch_id=4, begin_s=9.0, end_s=9.01)
+        origin_record = {
+            "name": "gateway.fetch", "trace_id": "t-x", "start_s": 50.5,
+            "duration_ms": 800.0, "error": None, "tiers": {"peer": 2},
+            "stages": [[f"{BATCH_STAGE_PREFIX}4", 100.0, None]],
+        }
+        serve_record = {
+            "name": "gateway.chunk", "trace_id": "t-x", "start_s": 8.9,
+            "duration_ms": 300.0, "error": None, "tiers": {"backend": 2},
+            "stages": [[f"{BATCH_STAGE_PREFIX}4", 50.0, None]],
+        }
+        return {
+            "g0": {"local": True, "records": [origin_record],
+                   "launches": [origin_launch],
+                   "epoch": {"wall_s": 1000.0, "mono_s": 50.0}},
+            "g1": {"local": False, "records": [serve_record],
+                   "launches": [peer_launch],
+                   "epoch": peer_epoch or {"wall_s": 2000.0, "mono_s": 8.0}},
+        }
+
+    def test_span_hops_flows_and_order(self):
+        out = stitch_trace("t-x", self.instances(), [["g2", "OSError: down"]])
+        assert out["trace_id"] == "t-x"
+        assert out["span_instances"] == ["g0", "g1"]
+        assert [e["role"] for e in out["ordered"]] == ["origin", "peer-serve"]
+        assert [e["instance"] for e in out["ordered"]] == ["g0", "g1"]
+        assert out["hop_edges"] == [
+            {"from": "g0", "to": "g1", "kind": "peer-chunk-serve"}
+        ]
+        # BOTH instances' gcm.batch:4 markers resolved against their OWN
+        # retained launches — per-process batch ids never cross-join.
+        assert len(out["flow_edges"]) == 2
+        assert {f["instance"] for f in out["flow_edges"]} == {"g0", "g1"}
+        assert all(f["batch_id"] == 4 for f in out["flow_edges"])
+        assert out["unreachable"] == [["g2", "OSError: down"]]
+        events = out["chrome_trace"]["traceEvents"]
+        assert validate_chrome_events(events) == len(events)
+        # One pid per instance, flows scoped per instance.
+        assert {e["pid"] for e in events} == {1, 2}
+        flow_cats = {e["cat"] for e in events if e["ph"] in ("s", "f")}
+        assert flow_cats == {flow_cat("g0"), flow_cat("g1")}
+
+    def test_skew_tolerance_order_ignores_clocks(self):
+        """The peer's clock says its serve happened a YEAR before the
+        origin — the hop edge still orders origin first."""
+        skewed = self.instances(
+            peer_epoch={"wall_s": 1000.0 - 365 * 86400.0, "mono_s": 8.0}
+        )
+        out = stitch_trace("t-x", skewed)
+        assert [e["instance"] for e in out["ordered"]] == ["g0", "g1"]
+        assert out["hop_edges"][0] == {
+            "from": "g0", "to": "g1", "kind": "peer-chunk-serve"
+        }
+
+    def test_missing_epoch_and_empty_members_degrade(self):
+        members = self.instances()
+        members["g1"]["epoch"] = None
+        members["g3"] = {"local": False, "records": [], "launches": [],
+                         "epoch": None}
+        out = stitch_trace("t-x", members)
+        assert out["span_instances"] == ["g0", "g1"]
+        assert out["instances"]["g3"]["launches_retained"] == 0
+        validate_chrome_events(out["chrome_trace"]["traceEvents"])
+
+    def test_serves_order_deterministically_by_duration(self):
+        members = self.instances()
+        fast = dict(members["g1"]["records"][0], duration_ms=10.0)
+        members["g1"]["records"].append(fast)
+        out = stitch_trace("t-x", members)
+        serves = [e for e in out["ordered"] if e["role"] == "peer-serve"]
+        assert [s["duration_ms"] for s in serves] == [300.0, 10.0]
+
+
+class _Router:
+    def __init__(self, peers):
+        self.peers = peers
+
+
+class TestAssembleTrace:
+    """The fetch_json seam: peer queries, 404-as-absence, failure
+    degradation to (member, reason) pairs."""
+
+    def make_telemetry(self, fetch_json, peers=None):
+        from tieredstorage_tpu.utils.flightrecorder import FlightRecorder
+
+        flight = FlightRecorder(enabled=True)
+        with flight.request("gateway.fetch", trace_id="t-1"):
+            pass
+        timeline = TimelineRecorder(enabled=True)
+        timeline.record_flush(**flush_kwargs())
+        return FleetTelemetry(
+            [], instance_id="g0",
+            router=_Router(peers if peers is not None
+                           else {"g0": None, "g1": "http://peer"}),
+            flight_recorder=flight, timeline=timeline,
+            fetch_json=fetch_json,
+        )
+
+    def test_rejects_empty_trace(self):
+        telemetry = self.make_telemetry(lambda url, path: None)
+        with pytest.raises(ValueError):
+            telemetry.assemble_trace("")
+
+    def test_local_plus_peer_stitch(self):
+        calls: list = []
+
+        def fetch_json(url, path):
+            calls.append((url, path))
+            if path.startswith("/debug/requests"):
+                return {"slowest": [{
+                    "name": "gateway.chunk", "trace_id": "t-1",
+                    "start_s": 1.0, "duration_ms": 5.0, "error": None,
+                    "tiers": {}, "stages": [],
+                }], "failed": []}
+            return {"events": [], "epoch": {"wall_s": 0.0, "mono_s": 0.0}}
+
+        out = self.make_telemetry(fetch_json).assemble_trace("t-1")
+        assert out["span_instances"] == ["g0", "g1"]
+        assert out["instances"]["g0"]["local"] is True
+        assert out["instances"]["g1"]["local"] is False
+        assert ("http://peer", "/debug/requests?trace=t-1") in calls
+        assert ("http://peer", "/debug/timeline") in calls
+
+    def test_peer_404_means_absence_not_failure(self):
+        out = self.make_telemetry(lambda url, path: None).assemble_trace("t-1")
+        assert out["span_instances"] == ["g0"]
+        assert out["instances"]["g1"]["records"] == []
+        assert out["unreachable"] == []
+
+    def test_unreachable_peer_degrades_to_member_reason_pair(self):
+        def fetch_json(url, path):
+            raise OSError("connection refused")
+
+        out = self.make_telemetry(fetch_json).assemble_trace("t-1")
+        assert out["unreachable"] == [["g1", "OSError: connection refused"]]
+        assert out["span_instances"] == ["g0"]
+
+    def test_trace_id_is_url_quoted(self):
+        paths: list = []
+
+        def fetch_json(url, path):
+            paths.append(path)
+            return None
+
+        self.make_telemetry(fetch_json).assemble_trace("a/b c")
+        assert paths == ["/debug/requests?trace=a%2Fb%20c"]
+
+    def test_disabled_local_sources_contribute_nothing(self):
+        telemetry = FleetTelemetry(
+            [], instance_id="g0", router=_Router({"g0": None}),
+            fetch_json=lambda url, path: None,
+        )
+        out = telemetry.assemble_trace("t-1")
+        assert out["instances"]["g0"]["records"] == []
+        assert out["instances"]["g0"]["launches_retained"] == 0
+
+
+class TestScrapeUnreachableReasons:
+    def test_scrape_records_member_and_reason(self):
+        def transport(url):
+            raise ConnectionError(f"refused: {url}")
+
+        telemetry = FleetTelemetry(
+            [], instance_id="g0",
+            router=_Router({"g0": None, "g1": "http://dead:1"}),
+            transport=transport,
+        )
+        scrape = telemetry.scrape()
+        assert scrape["unreachable"] == [
+            ["g1", "ConnectionError: refused: http://dead:1"]
+        ]
+        assert scrape["members"]["g1"]["reachable"] is False
+
+
+def _get(port: int, path: str):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        body = resp.read()
+        return resp.status, (json.loads(body) if resp.status == 200 else body)
+    finally:
+        conn.close()
+
+
+class TestTwoInstanceStitchOverHttp:
+    """assemble_trace over REAL gateways: a cross-instance fetch places
+    genuinely shared-traceparent records on both members' flight rings;
+    the launch evidence is injected into the owner's live timeline ring
+    (full GCM end-to-end is make load-demo's gate) and the stitcher reads
+    everything over the debug routes it ships with."""
+
+    @pytest.fixture
+    def fleet(self, tmp_path):
+        from tieredstorage_tpu.rsm import RemoteStorageManager
+        from tieredstorage_tpu.sidecar.http_gateway import SidecarHttpGateway
+
+        store = tmp_path / "store"
+        store.mkdir()
+        rsms = {}
+        for name in ("a", "b"):
+            rsm = RemoteStorageManager()
+            rsm.configure({
+                "storage.backend.class":
+                    "tieredstorage_tpu.storage.filesystem.FileSystemStorage",
+                "storage.root": str(store),
+                "chunk.size": 1024,
+                "key.prefix": "fleet/",
+                "fetch.chunk.cache.class":
+                    "tieredstorage_tpu.fetch.cache.memory.MemoryChunkCache",
+                "fetch.chunk.cache.size": -1,
+                "fleet.enabled": True,
+                "fleet.instance.id": name,
+                "fleet.vnodes": 32,
+                "tracing.enabled": True,
+                "flight.enabled": True,
+                "flight.ring.size": 16,
+                "timeline.enabled": True,
+                "timeline.ring.size": 32,
+            })
+            rsms[name] = rsm
+        gateways = {
+            n: SidecarHttpGateway(r).start() for n, r in rsms.items()
+        }
+        peers = {n: f"http://127.0.0.1:{g.port}" for n, g in gateways.items()}
+        for r in rsms.values():
+            r.set_fleet_peers(peers)
+        yield rsms, gateways
+        for g in gateways.values():
+            g.stop()
+        for r in rsms.values():
+            r.close()
+
+    def test_stitch_spans_instances_with_flow_edge(self, fleet, tmp_path):
+        from tests.test_rsm_lifecycle import (
+            SEGMENT_SIZE,
+            make_segment_data,
+            make_segment_metadata,
+        )
+        from tieredstorage_tpu.object_key import ObjectKeyFactory, Suffix
+        from tieredstorage_tpu.sidecar import shimwire
+        from tieredstorage_tpu.utils import flightrecorder
+
+        rsms, gateways = fleet
+        md = make_segment_metadata()
+        rsms["a"].copy_log_segment_data(
+            md, make_segment_data(tmp_path, with_txn=False)
+        )
+
+        # Fetch THROUGH the gateway that does NOT own the log object, so
+        # every chunk read forwards to the owner over /chunk with the SAME
+        # traceparent the origin minted — a guaranteed cross-instance hop.
+        key = ObjectKeyFactory("fleet/", False).key(md, Suffix.LOG).value
+        owner = rsms["a"].fleet_router.owner(key)
+        origin = next(n for n in rsms if n != owner)
+        body = shimwire.encode_metadata(md) + shimwire.encode_fetch_tail(
+            0, SEGMENT_SIZE - 1
+        )
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", gateways[origin].port, timeout=30
+        )
+        try:
+            conn.request("POST", "/v1/fetch", body=body)
+            resp = conn.getresponse()
+            assert resp.status == 200
+            assert len(resp.read()) == SEGMENT_SIZE
+        finally:
+            conn.close()
+
+        # Both ends archive their records just after the drain.
+        trace_id = None
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            fetches = [
+                r for r in rsms[origin].flight_recorder.slowest(8)
+                if r.name == "gateway.fetch"
+            ]
+            if fetches and any(
+                r.name == "gateway.chunk"
+                for r in rsms[owner].flight_recorder.find_all(
+                    fetches[0].trace_id
+                )
+            ):
+                trace_id = fetches[0].trace_id
+                break
+            time.sleep(0.02)
+        assert trace_id, "no shared-trace serve record on the owner"
+
+        # Inject the device-launch evidence on the SERVING member: a
+        # merged flush in its live timeline ring plus a request record
+        # carrying the matching gcm.batch marker under the same trace
+        # (full GCM end-to-end is make load-demo's gate — this pins the
+        # stitch contract over live HTTP without a jit warmup).
+        rsms[owner].timeline.record_flush(**flush_kwargs(batch_id=77))
+        with rsms[owner].flight_recorder.request(
+            "gateway.chunk", trace_id=trace_id
+        ):
+            flightrecorder.stage("gcm.batch:77")
+
+        stitched = rsms[origin].fleet_telemetry.assemble_trace(trace_id)
+        assert set(stitched["span_instances"]) == {origin, owner}
+        roles = {e["instance"]: e["role"] for e in stitched["ordered"]}
+        assert roles[origin] == "origin"
+        assert roles[owner] == "peer-serve"
+        flow = [f for f in stitched["flow_edges"] if f["batch_id"] == 77]
+        assert flow and flow[0]["instance"] == owner
+        assert {"from": origin, "to": owner, "kind": "peer-chunk-serve"} \
+            in stitched["hop_edges"]
+        assert stitched["unreachable"] == []
+        events = stitched["chrome_trace"]["traceEvents"]
+        assert validate_chrome_events(events) == len(events)
+        # Loadable end-to-end: the artifact form load-demo commits.
+        json.dumps({"traceEvents": events, "displayTimeUnit": "ms"})
+
+
+class TestTimelineExportTool:
+    def test_build_trace_pure_converter(self):
+        from tools.timeline_export import build_trace
+
+        rec = TimelineRecorder(enabled=True)
+        rec.record_flush(**flush_kwargs(batch_id=11))
+        doc = build_trace(
+            rec.status(),
+            {"slowest": [{
+                "name": "gateway.fetch", "trace_id": "t", "start_s": 9.99,
+                "duration_ms": 50.0, "error": None, "tiers": {},
+                "stages": [[f"{BATCH_STAGE_PREFIX}11", 5.0, None]],
+            }]},
+            instance="g0",
+        )
+        assert doc["otherData"] == {
+            "instance": "g0", "launches": 1, "records": 1,
+        }
+        assert validate_chrome_events(doc["traceEvents"]) > 0
+        assert any(e["ph"] == "s" for e in doc["traceEvents"])
+        assert any(e["ph"] == "f" for e in doc["traceEvents"])
+
+    def test_build_trace_rejects_invalid_payload(self):
+        from tools.timeline_export import build_trace
+
+        bad = {"events": [{"kind": "flush"}], "epoch": None}
+        with pytest.raises(KeyError):
+            build_trace(bad)
+
+
+class TestAddedWaitExemplars:
+    """ISSUE 17 satellite: per-class added-wait histograms carry the
+    waiting requests' trace ids as bucket exemplars, delivered explicitly
+    through the flush hook (the flusher thread has no ambient record)."""
+
+    @staticmethod
+    def _registered(extra=None):
+        from types import SimpleNamespace
+
+        from tieredstorage_tpu.metrics.batch_metrics import (
+            register_batch_metrics,
+        )
+        from tieredstorage_tpu.metrics.core import MetricsRegistry
+
+        registry = MetricsRegistry()
+        batcher = SimpleNamespace(**(extra or {}))
+        register_batch_metrics(registry, batcher)
+        return registry, batcher
+
+    @staticmethod
+    def _metric(registry, name):
+        (mn,) = registry.find(name)
+        return mn
+
+    def test_hook_delivers_exemplars_and_batch_id(self):
+        registry, batcher = self._registered()
+        batcher.on_flush(2, [1.0, 500.0], "latency", 42, ["t-a", "t-b"])
+
+        hist = registry.stat(
+            self._metric(registry, "batch-class-latency-added-wait-time-ms"))
+        assert hist.count == 2
+        assert {tid for _, tid, _ in hist.exemplars()} == {"t-a", "t-b"}
+        assert registry.value(
+            self._metric(registry, "batch-class-latency-last-batch-id")
+        ) == 42.0
+        # Other classes untouched: isolation holds at the metrics layer too.
+        assert registry.value(
+            self._metric(registry, "batch-class-throughput-last-batch-id")
+        ) == 0.0
+        other = registry.stat(self._metric(
+            registry, "batch-class-throughput-added-wait-time-ms"))
+        assert other.count == 0
+
+    def test_missing_trace_ids_degrade_to_plain_samples(self):
+        registry, batcher = self._registered()
+        batcher.on_flush(1, [2.0], "background", 0, [None])
+        batcher.on_flush(1, [3.0], "background", 0)
+
+        hist = registry.stat(self._metric(
+            registry, "batch-class-background-added-wait-time-ms"))
+        assert hist.count == 2
+        assert hist.exemplars() == []
+        # batch_id 0 means "no merged launch" — the gauge must not regress.
+        assert registry.value(self._metric(
+            registry, "batch-class-background-last-batch-id")) == 0.0

@@ -271,7 +271,7 @@ class TestFlushPolicy:
         # (3.5 - 1.0) s = 2500 ms.
         key = (LATENCY, True, bytes(DK.data_key), bytes(DK.aad), 1024)
         waits: list = []
-        batcher.on_flush = lambda occ, added, cls: waits.extend(added)
+        batcher.on_flush = lambda occ, added, cls, *rest: waits.extend(added)
         with batcher._cond:
             batcher._buckets[key] = [entry]
         self.clock[0] = 3.5

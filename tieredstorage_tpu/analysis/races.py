@@ -99,6 +99,11 @@ SHARED_CLASSES = {
         "one aggregator per fleet member, scraped concurrently by "
         "gateway workers serving GET /fleet/telemetry (client cache + "
         "scrape counters)",
+    "tieredstorage_tpu/metrics/timeline.py:TimelineRecorder":
+        "one event ring per RSM, fed by the batcher's flusher daemon on "
+        "every merged launch and read by gateway workers serving "
+        "GET /debug/timeline and by metrics-scrape gauge suppliers "
+        "(ring deque + recorded/evicted/launch/expired counters)",
 }
 
 #: Executor dispatch method names whose first argument runs on a pool thread.

@@ -531,6 +531,26 @@ def _base_def() -> ConfigDef:
             "plus the N most recent failed ones.",
     ))
     d.define(ConfigKey(
+        "timeline.enabled", "bool", default=False, importance="medium",
+        doc="Arm the device-scheduler timeline ring (metrics/timeline.py): "
+            "every merged GCM launch records its scheduler context (work "
+            "class, bucket shape, rows/bytes, waiter count, queued age, "
+            "launch begin/end, occupancy, per-class queue depths, and the "
+            "waiting requests' flight-recorder trace ids), served as "
+            "Chrome-trace/Perfetto JSON on GET /debug/timeline with flow "
+            "edges joining flight records to the launches that served "
+            "them (the gcm.batch:<id> stage markers). Disabled is "
+            "zero-work.",
+    ))
+    d.define(ConfigKey(
+        "timeline.ring.size", "int", default=512,
+        validator=in_range(1, 65536), importance="low",
+        doc="Scheduler events retained by the timeline ring, strict FIFO "
+            "with explicit eviction accounting (recency matters here, not "
+            "extremes — the flight recorder keeps the slowest, the "
+            "timeline keeps the latest).",
+    ))
+    d.define(ConfigKey(
         "slo.enabled", "bool", default=False, importance="medium",
         doc="Run the SLO engine (metrics/slo.py): declarative objectives "
             "over the existing latency histograms and counters (fetch "
@@ -926,6 +946,14 @@ class RemoteStorageManagerConfig:
     @property
     def flight_ring_size(self) -> int:
         return self._values["flight.ring.size"]
+
+    @property
+    def timeline_enabled(self) -> bool:
+        return self._values["timeline.enabled"]
+
+    @property
+    def timeline_ring_size(self) -> int:
+        return self._values["timeline.ring.size"]
 
     @property
     def slo_enabled(self) -> bool:

@@ -185,6 +185,18 @@ def _collect_batch() -> dict[str, list[str]]:
     return _group_names(registry)
 
 
+def _collect_timeline() -> dict[str, list[str]]:
+    from tieredstorage_tpu.metrics.core import MetricsRegistry
+    from tieredstorage_tpu.metrics.timeline import (
+        TimelineRecorder,
+        register_timeline_metrics,
+    )
+
+    registry = MetricsRegistry()
+    register_timeline_metrics(registry, TimelineRecorder())
+    return _group_names(registry)
+
+
 def _collect_backends() -> dict[str, list[str]]:
     from tieredstorage_tpu.storage.azure.metrics import AzureMetricCollector
     from tieredstorage_tpu.storage.gcs.metrics import GcsMetricCollector
@@ -254,6 +266,7 @@ def generate() -> str:
         ("RemoteStorageManager metrics", _collect_rsm()),
         ("Cache and thread-pool metrics", _collect_caches()),
         ("Cross-request GCM batching metrics", _collect_batch()),
+        ("Device-scheduler timeline metrics", _collect_timeline()),
         ("Resilience metrics", _collect_resilience()),
         ("Replication metrics", _collect_replication()),
         ("Fleet metrics", _collect_fleet()),

@@ -314,14 +314,19 @@ class ChunkCache(ChunkManager, Generic[T], abc.ABC):
                 )
             else:
                 # The pool worker loads on behalf of THIS request: re-bind
-                # its flight record across the hop (the request thread
-                # blocks right below) so the lower tiers' outcomes land on
-                # it. The prefetch branch (deadline=None, already on a pool
-                # worker) deliberately carries no record — it outlives the
-                # request that triggered it.
+                # its flight record AND trace context across the hop (the
+                # request thread blocks right below) so the lower tiers'
+                # outcomes land on it and a peer-cache forward carries the
+                # request's traceparent — the fleet stitcher joins the
+                # owner's /chunk serve records on it. The prefetch branch
+                # (deadline=None, already on a pool worker) deliberately
+                # carries neither — it outlives the request that
+                # triggered it.
                 record = flight.current_record()
+                traceparent = self.tracer.current_traceparent()
                 task = self._executor.submit(
-                    self._load_owned_bound, record, objects_key, manifest, own
+                    self._load_owned_bound, record, traceparent,
+                    objects_key, manifest, own,
                 )
                 try:
                     futures.update(
@@ -333,8 +338,8 @@ class ChunkCache(ChunkManager, Generic[T], abc.ABC):
                     ) from None
         return futures
 
-    def _load_owned_bound(self, record, objects_key, manifest, own):
-        with flight.bound(record):
+    def _load_owned_bound(self, record, traceparent, objects_key, manifest, own):
+        with flight.bound(record), self.tracer.continue_trace(traceparent):
             return self._load_owned(objects_key, manifest, own)
 
     def _load_owned(

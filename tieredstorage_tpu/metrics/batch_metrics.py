@@ -18,7 +18,11 @@ flight records' ``gcm.class:<cls>`` stage markers) instead of guessing.
 
 The batcher stays metrics-free: its ``on_flush`` hook is pointed at the
 histograms here, mirroring how the chunk manager's ``on_fetch`` feeds the
-latency histograms (fetch/chunk_manager.py).
+latency histograms (fetch/chunk_manager.py). Each class additionally gets
+its own added-wait histogram whose bucket exemplars are the waiting
+requests' flight-recorder trace ids (ISSUE 17) — captured at enqueue and
+delivered through the hook, because the flusher thread has no ambient
+record of its own.
 """
 
 from __future__ import annotations
@@ -102,9 +106,42 @@ def register_batch_metrics(registry: MetricsRegistry, batcher) -> None:
         ),
     ])
 
-    def on_flush(occ: int, added_wait_ms: list, work_class: str) -> None:
+    # Per-class added-wait histograms WITH exemplars (ISSUE 17): the flush
+    # runs on the flusher thread (no ambient flight record), so each
+    # window's trace id — captured at enqueue on ITS request thread — is
+    # recorded explicitly. A burning batch-wait investigation reads the hot
+    # bucket's exemplar, resolves it via GET /debug/requests?trace=<id>,
+    # and the record's gcm.batch:<id> stage names the concrete launch.
+    class_wait: dict[str, Histogram] = {}
+    last_batch_id: dict[str, int] = {cls: 0 for cls in WORK_CLASSES}
+    for cls in WORK_CLASSES:
+        hist = Histogram()
+        registry.sensor(f"gcm-batch.added-wait.{cls}").ensure_stats(
+            lambda c=cls, h=hist: [(
+                MetricName.of(
+                    f"batch-class-{c}-added-wait-time-ms", BATCH_METRIC_GROUP,
+                    f"Per-window queue wait for the {c} class (ms, "
+                    "log-scale buckets); bucket exemplars carry the waiting "
+                    "request's flight-recorder trace id",
+                ),
+                h,
+            )]
+        )
+        class_wait[cls] = hist
+        gauge(f"batch-class-{cls}-last-batch-id",
+              lambda c=cls: float(last_batch_id[c]),
+              f"Id of the most recent merged {cls}-class launch (joins the "
+              "flight records' gcm.batch:<id> stage markers)")
+
+    def on_flush(occ: int, added_wait_ms: list, work_class: str,
+                 batch_id: int = 0, trace_ids=()) -> None:
         occupancy.record(float(occ))
-        for ms in added_wait_ms:
+        hist = class_wait[work_class]
+        trace_ids = list(trace_ids) or [None] * len(added_wait_ms)
+        for ms, trace_id in zip(added_wait_ms, trace_ids):
             added_wait.record(float(ms))
+            hist.record(float(ms), trace_id=trace_id)
+        if batch_id:
+            last_batch_id[work_class] = batch_id
 
     batcher.on_flush = on_flush

@@ -110,9 +110,15 @@ class Histogram(Stat):
         self._exemplars: dict[int, tuple[str, float]] = {}
         self._lock = new_lock("core.Histogram._lock")
 
-    def record(self, value: float, now: float) -> None:
+    def record(self, value: float, now: float = 0.0,
+               trace_id: Optional[str] = None) -> None:
+        """Record one observation. ``trace_id`` overrides the ambient
+        flight-recorder trace id as the bucket's exemplar — for recording
+        threads that act on ANOTHER request's behalf (the batcher's flusher
+        delivering per-window added-wait values captured at enqueue)."""
         idx = bisect.bisect_left(self._bounds, value)
-        trace_id = flightrecorder.current_trace_id()
+        if trace_id is None:
+            trace_id = flightrecorder.current_trace_id()
         with self._lock:
             self._counts[idx] += 1
             self._sum += value

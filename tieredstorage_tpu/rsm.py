@@ -90,6 +90,7 @@ from tieredstorage_tpu.utils.deadline import (
     ensure_deadline,
 )
 from tieredstorage_tpu.utils import flightrecorder as flight
+from tieredstorage_tpu.metrics.timeline import NOOP_TIMELINE, TimelineRecorder
 from tieredstorage_tpu.utils.flightrecorder import NOOP_RECORDER, FlightRecorder
 from tieredstorage_tpu.utils.ratelimit import RateLimitedStream, TokenBucket
 from tieredstorage_tpu.utils.tracing import NOOP_TRACER, Tracer
@@ -162,6 +163,9 @@ class RemoteStorageManager:
         #: Per-request flight recorder (`flight.enabled`); gateway + RSM
         #: entries open records, the fetch tiers enrich them.
         self.flight_recorder: FlightRecorder = NOOP_RECORDER
+        #: Device-scheduler timeline ring (`timeline.enabled`): merged-launch
+        #: attribution served on GET /debug/timeline (metrics/timeline.py).
+        self.timeline: TimelineRecorder = NOOP_TIMELINE
         #: SLO engine (`slo.enabled`): burn rates + verdicts on GET /slo.
         self._slo = None
         #: Fleet-wide telemetry aggregator (fleet mode).
@@ -206,6 +210,14 @@ class RemoteStorageManager:
         backend.configure(config.transform_configs())
         backend.tracer = self.tracer
         self._transform_backend = backend
+
+        self.timeline = TimelineRecorder(
+            enabled=config.timeline_enabled,
+            ring_size=config.timeline_ring_size,
+        )
+        batcher = getattr(backend, "batcher", None)
+        if batcher is not None:
+            batcher.timeline = self.timeline
 
         self._object_key_factory = ObjectKeyFactory(config.key_prefix, config.key_prefix_mask)
 
@@ -646,12 +658,34 @@ class RemoteStorageManager:
             raise RemoteStorageException("SLO engine is not enabled")
         return {"enabled": True, **self._slo.evaluate()}
 
-    def flight_status(self, *, limit: Optional[int] = None) -> dict:
+    def flight_status(
+        self,
+        *,
+        limit: Optional[int] = None,
+        trace: Optional[str] = None,
+        slowest: Optional[int] = None,
+    ) -> dict:
         """Payload for the gateway's GET /debug/requests: slowest-first
-        retained flight records plus the failure ring."""
+        retained flight records plus the failure ring. ``trace`` filters to
+        one trace id's records and raises not-found (the gateway's 404)
+        when nothing retained carries it; ``slowest`` returns just the N
+        slowest completed records."""
         if not self.flight_recorder.enabled:
             raise RemoteStorageException("flight recorder is not enabled")
-        return self.flight_recorder.dump(limit=limit)
+        if trace is not None and not self.flight_recorder.find_all(trace):
+            raise RemoteResourceNotFoundException(
+                f"no retained flight record for trace {trace!r}"
+            )
+        return self.flight_recorder.dump(
+            limit=limit, trace=trace, slowest=slowest
+        )
+
+    def timeline_status(self) -> dict:
+        """Payload for the gateway's GET /debug/timeline: the scheduler
+        ring's counters, epoch pin, and retained events."""
+        if not self.timeline.enabled:
+            raise RemoteStorageException("timeline recorder is not enabled")
+        return self.timeline.status()
 
     def _wire_fleet_telemetry(self, config: RemoteStorageManagerConfig) -> None:
         """Fleet-wide telemetry (fleet/telemetry.py): this member serves
@@ -667,6 +701,8 @@ class RemoteStorageManager:
             router=self.fleet_router,
             ping=self.fleet_ping,
             timeout_s=config.fleet_forward_timeout_ms / 1000.0,
+            flight_recorder=self.flight_recorder,
+            timeline=self.timeline,
         )
 
     @property
@@ -880,6 +916,11 @@ class RemoteStorageManager:
             )
 
             register_batch_metrics(registry, batcher)
+        from tieredstorage_tpu.metrics.timeline import (
+            register_timeline_metrics,
+        )
+
+        register_timeline_metrics(registry, self.timeline)
 
     def _build_chunk_manager(self, backend) -> ChunkManager:
         factory = ChunkManagerFactory()

@@ -49,12 +49,20 @@ The observability plane (ISSUE 14) adds three read-only routes:
 - ``GET /slo`` — the SLO engine's verdicts (``slo.enabled``): per-spec
   compliance, error-budget remaining, and two-window burn rates computed
   from the live latency histograms; 404 while the engine is disabled.
-- ``GET /debug/requests[?n=K]`` — the flight recorder's retained evidence
-  (``flight.enabled``): the K slowest and the failed requests with
-  per-tier chunk counts, hedge/failover activity, GCM window accounting,
-  and deadline budget at each stage; 404 while disabled, 400 on a bad
-  ``n``. Every POST request and peer-chunk serve records through the
-  recorder, covering the streamed response drain.
+- ``GET /debug/requests[?n=K|?slowest=K|?trace=<id>]`` — the flight
+  recorder's retained evidence (``flight.enabled``): the K slowest and
+  the failed requests with per-tier chunk counts, hedge/failover
+  activity, GCM window accounting, and deadline budget at each stage;
+  ``trace`` filters to one trace id's records (404 when none retained —
+  the fleet stitcher's per-member query), ``slowest`` returns just the K
+  slowest completed records; 404 while disabled, 400 on a bad count.
+  Every POST request and peer-chunk serve records through the recorder,
+  covering the streamed response drain.
+- ``GET /debug/timeline`` (ISSUE 17) — the device-scheduler timeline ring
+  (``timeline.enabled``): every merged GCM launch's scheduler context
+  (work class, bucket shape, occupancy, queue depths, waiter trace ids)
+  plus the clock-epoch pin the fleet stitcher uses to land peers on one
+  Perfetto time axis; 404 while disabled.
 - ``GET /fleet/telemetry[?aggregate=1]`` — this member's metric samples
   (fleet mode), or with ``aggregate=1`` the whole membership view merged
   into one fleet-wide scrape (sum/max/histogram-merge per stat).
@@ -254,6 +262,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._slo()
         elif parts.path in ("/debug/requests", "/v1/debug/requests"):
             self._debug_requests(parts.query)
+        elif parts.path in ("/debug/timeline", "/v1/debug/timeline"):
+            self._debug_timeline()
         elif self.path in ("/scrub", "/v1/scrub"):
             # Integrity-scrubber status: scheduler state, cumulative
             # counters, and the last pass summary ({"enabled": false} when
@@ -349,7 +359,10 @@ class _Handler(BaseHTTPRequestHandler):
     def _debug_requests(self, query: str) -> None:
         """Flight-recorder evidence dump (utils/flightrecorder.py): the
         slowest and the failed requests with tier/hedge/failover/GCM
-        accounting. ``?n=K`` bounds both lists; 400 on a malformed K, 404
+        accounting. ``?n=K`` bounds both lists, ``?slowest=K`` returns
+        just the K slowest completed records, ``?trace=<id>`` filters to
+        one trace's records (404 when nothing retained carries it — the
+        fleet stitcher's per-member query); 400 on a malformed count, 404
         while ``flight.enabled`` is off."""
         import json
 
@@ -360,15 +373,46 @@ class _Handler(BaseHTTPRequestHandler):
         # keep_blank_values: an explicit empty ?n= is a malformed request
         # (400), not an absent parameter.
         params = parse_qs(query, keep_blank_values=True, strict_parsing=False)
-        limit = None
-        if "n" in params:
-            raw = params["n"][0]
+
+        def count_of(name: str):
+            if name not in params:
+                return None
+            raw = params[name][0]
             # Strict ASCII-digit grammar (the Content-Length precedent).
             if not raw or not all(c in "0123456789" for c in raw) or int(raw) < 1:
-                self._reply(400, b"expected ?n=<positive integer>")
-                return
-            limit = int(raw)
-        status = self.rsm.flight_status(limit=limit)
+                raise ValueError(f"expected ?{name}=<positive integer>")
+            return int(raw)
+
+        try:
+            limit = count_of("n")
+            slowest = count_of("slowest")
+            trace = params["trace"][0] if "trace" in params else None
+            if trace is not None and not trace:
+                raise ValueError("expected ?trace=<trace id>")
+            status = self.rsm.flight_status(
+                limit=limit, trace=trace, slowest=slowest
+            )
+        except Exception as exc:  # noqa: BLE001 — boundary translation
+            self._fail(exc)
+            return
+        self._reply(200, json.dumps(status, indent=1).encode("utf-8"))
+
+    def _debug_timeline(self) -> None:
+        """Device-scheduler timeline ring (metrics/timeline.py): merged
+        launches with full scheduler context, the clock-epoch pin, and
+        counters. 404 while ``timeline.enabled`` is off — an absent ring
+        must read as "not armed", never as "the device was idle"."""
+        import json
+
+        timeline = getattr(self.rsm, "timeline", None)
+        if timeline is None or not timeline.enabled:
+            self._reply(404, b"timeline recorder disabled")
+            return
+        try:
+            status = self.rsm.timeline_status()
+        except Exception as exc:  # noqa: BLE001 — boundary translation
+            self._fail(exc)
+            return
         self._reply(200, json.dumps(status, indent=1).encode("utf-8"))
 
     def _fleet_telemetry(self, query: str) -> None:

@@ -86,6 +86,7 @@ from tieredstorage_tpu.transform.scheduler import (
     flush_priority,
     validate_work_class,
 )
+from tieredstorage_tpu.utils import flightrecorder
 from tieredstorage_tpu.utils.locks import new_condition, note_mutation
 
 
@@ -128,6 +129,11 @@ class _PendingWindow:
     batch_id: int = 0
     occupancy: int = 0
     added_wait_ms: float = 0.0
+    #: Flight-recorder trace id captured at enqueue ON THE REQUEST THREAD
+    #: (the flusher has no ambient record) — the timeline ring and the
+    #: per-class added-wait exemplars resolve a launch back to the
+    #: concrete requests that rode it.
+    trace_id: Optional[str] = None
 
 
 class _EncryptHandle:
@@ -173,10 +179,15 @@ class WindowBatcher:
     #: spurious wait timeout) is what reports deadline expiry.
     WAIT_GRACE_S = 60.0
 
-    #: Optional flush hook ``(occupancy, added_wait_ms_list, work_class)``
-    #: — the batch-metrics group (metrics/batch_metrics.py) points it at
-    #: the occupancy/added-wait histograms and the per-class counters.
+    #: Optional flush hook ``(occupancy, added_wait_ms_list, work_class,
+    #: batch_id, trace_ids)`` — the batch-metrics group
+    #: (metrics/batch_metrics.py) points it at the occupancy/added-wait
+    #: histograms; the per-entry trace ids become histogram exemplars.
     on_flush: Optional[Callable] = None
+    #: Optional device-scheduler timeline ring (metrics/timeline.py,
+    #: ``timeline.enabled``): every merged flush and expiry drop records
+    #: its full scheduler context for the Perfetto export.
+    timeline = None
 
     def __init__(
         self,
@@ -444,6 +455,7 @@ class WindowBatcher:
             deadline_at=None if remaining is None else now + remaining,
             work_class=work_class,
             decrypt=decrypt,
+            trace_id=flightrecorder.current_trace_id(),
         )
         key = (
             work_class,
@@ -673,6 +685,9 @@ class WindowBatcher:
             with self._cond:
                 self.expired_windows += expired
                 note_mutation("batcher.WindowBatcher.expired_windows")
+            tl = self.timeline
+            if tl is not None:
+                tl.record_expired(work_class, expired, now)
         if not live:
             return
 
@@ -771,6 +786,29 @@ class WindowBatcher:
         with self._cond:
             self.class_added_wait_ms[work_class] += sum(added_waits)
             note_mutation("batcher.WindowBatcher.class_added_wait_ms")
+        tl = self.timeline
+        if tl is not None:
+            # Outside _cond by design: the timeline ring has its own lock
+            # and class_queued() re-takes _cond for the depth snapshot.
+            tl.record_flush(
+                batch_id=batch_id,
+                work_class=work_class,
+                decrypt=decrypt,
+                bucket_bytes=key[4],
+                rows=rows,
+                n_bytes=sum(e.n_bytes for e in live),
+                occupancy=occupancy,
+                queued_age_ms=max(
+                    0.0, (t0 - min(e.enqueued_at for e in live)) * 1000.0
+                ),
+                begin_s=t0,
+                end_s=t0 + launch_s,
+                queue_depths=self.class_queued(),
+                trace_ids=[e.trace_id for e in live],
+            )
         hook = self.on_flush
         if hook is not None:
-            hook(occupancy, added_waits, work_class)
+            hook(
+                occupancy, added_waits, work_class,
+                batch_id, [e.trace_id for e in live],
+            )

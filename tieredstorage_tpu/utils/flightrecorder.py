@@ -94,6 +94,10 @@ class RequestRecord:
         out = {
             "name": self.name,
             "trace_id": self.trace_id,
+            #: perf_counter at entry — CLOCK_MONOTONIC on Linux, the same
+            #: clock the device-scheduler timeline stamps, so an exported
+            #: record lands on the launch slices' time axis (ISSUE 17).
+            "start_s": round(self.start_s, 6),
             "duration_ms": round(self.duration_ms, 3),
             "error": self.error,
             "deadline_entry_ms": self.deadline_entry_ms,
@@ -266,16 +270,28 @@ class FlightRecorder:
 
     def find(self, trace_id: str) -> Optional[RequestRecord]:
         """Resolve an exemplar/breach trace id to its retained record."""
+        matches = self.find_all(trace_id)
+        return matches[0] if matches else None
+
+    def find_all(self, trace_id: str) -> list[RequestRecord]:
+        """EVERY retained record carrying ``trace_id`` (slow ring first,
+        then failed), deduplicated. One trace id can own several records on
+        one instance — a gateway request plus the peer ``/chunk`` serves it
+        triggered land in the same recorder when the instances share a
+        process — and the fleet stitcher wants all of them."""
         if not trace_id:
-            return None
+            return []
+        out: list[RequestRecord] = []
         with self._lock:
             for _, _, record in self._slow:
                 if record.trace_id == trace_id:
-                    return record
+                    out.append(record)
             for record in self._failed:
-                if record.trace_id == trace_id:
-                    return record
-        return None
+                if record.trace_id == trace_id and not any(
+                    r is record for r in out
+                ):
+                    out.append(record)
+        return out
 
     @property
     def ring_occupancy(self) -> int:
@@ -305,9 +321,39 @@ class FlightRecorder:
             ],
         }
 
-    def dump(self, *, limit: Optional[int] = None) -> dict:
+    def dump(
+        self,
+        *,
+        limit: Optional[int] = None,
+        trace: Optional[str] = None,
+        slowest: Optional[int] = None,
+    ) -> dict:
         """The GET /debug/requests payload: slowest-first retained records
-        plus the failure ring."""
+        plus the failure ring.
+
+        Filters (ISSUE 17, exclusive of each other by the gateway's
+        grammar but composable here): ``trace`` keeps only records carrying
+        that trace id (both rings — the fleet stitcher's per-member query);
+        ``slowest`` returns just the N slowest completed records with an
+        empty failure list (the exemplar-selection query)."""
+        if trace is not None:
+            matches = [r.to_dict() for r in self.find_all(trace)]
+            return {
+                "enabled": self.enabled,
+                "requests_seen": self.requests_seen,
+                "requests_failed": self.requests_failed,
+                "trace": trace,
+                "slowest": matches,
+                "failed": [],
+            }
+        if slowest is not None:
+            return {
+                "enabled": self.enabled,
+                "requests_seen": self.requests_seen,
+                "requests_failed": self.requests_failed,
+                "slowest": [r.to_dict() for r in self.slowest(slowest)],
+                "failed": [],
+            }
         slow = self.slowest(limit)
         failed = self.failures()
         if limit is not None:

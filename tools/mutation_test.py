@@ -103,6 +103,12 @@ DEFAULT_TARGETS = [
         "tieredstorage_tpu/transform/scheduler.py",
         ["tests/test_device_scheduler.py"],
     ),
+    # ISSUE 17: the timeline ring's pure logic — eviction accounting, the
+    # epoch pin arithmetic, Chrome-event phase/track construction, the
+    # flow-join against gcm.batch:<id> markers, and the export validator.
+    # An operator flip silently drops launches, dangles flow arrows, or
+    # lets a non-loadable trace claim it was validated.
+    ("tieredstorage_tpu/metrics/timeline.py", ["tests/test_timeline.py"]),
 ]
 
 _CMP_SWAP = {

@@ -1,0 +1,116 @@
+"""Export a live gateway's device-scheduler timeline as a Chrome trace.
+
+Fetches ``GET /debug/timeline`` (the scheduler's merged-launch event ring)
+from a running sidecar gateway — plus, when ``--trace`` is given, the
+matching flight records from ``GET /debug/requests?trace=<id>`` — and
+writes Chrome trace-event JSON: one track per work class, one slice per
+merged GCM launch, flow arrows joining each request's flight record to
+the launches that served it (the ``gcm.batch:<id>`` stage markers).
+
+Open the output in https://ui.perfetto.dev or ``chrome://tracing``.
+
+    python tools/timeline_export.py --url http://127.0.0.1:8090 \
+        --trace 4bf92f3577b34da6a3ce929d0e0e4736 -o artifacts/timeline.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import urllib.error
+import urllib.parse
+import urllib.request
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO_ROOT))
+
+from tieredstorage_tpu.metrics.timeline import (  # noqa: E402
+    chrome_trace_events,
+    validate_chrome_events,
+)
+
+
+def build_trace(
+    timeline_payload: dict,
+    requests_payload: dict | None = None,
+    *,
+    instance: str = "gateway",
+) -> dict:
+    """Pure converter: debug-route payloads in, Chrome trace JSON out.
+
+    ``timeline_payload`` is the ``/debug/timeline`` body (``events`` +
+    ``epoch``); ``requests_payload`` is an optional ``/debug/requests``
+    body whose ``slowest`` records get their own track with flow arrows
+    into the launches that served them. Raises ValueError if the result
+    would not load in Perfetto (schema-checked, not hoped)."""
+    events = timeline_payload.get("events", [])
+    epoch = timeline_payload.get("epoch") or {"wall_s": 0.0, "mono_s": 0.0}
+    records = (requests_payload or {}).get("slowest", [])
+    trace_events = chrome_trace_events(
+        events, records, pid=1, epoch=epoch, instance=instance,
+    )
+    validate_chrome_events(trace_events)
+    return {
+        "traceEvents": trace_events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "instance": instance,
+            "launches": sum(1 for e in events if e.get("kind") == "flush"),
+            "records": len(records),
+        },
+    }
+
+
+def _get_json(base: str, path: str) -> dict | None:
+    url = base.rstrip("/") + path
+    try:
+        with urllib.request.urlopen(url, timeout=10.0) as resp:  # noqa: S310
+            return json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            return None
+        raise
+
+
+def run(url: str, trace: str | None, out_path: pathlib.Path) -> int:
+    timeline = _get_json(url, "/debug/timeline")
+    if timeline is None:
+        print(f"FAIL: {url}/debug/timeline returned 404 — is "
+              "timeline.enabled=true on the gateway's RSM?", file=sys.stderr)
+        return 1
+    requests_payload = None
+    if trace:
+        requests_payload = _get_json(
+            url, "/debug/requests?trace=" + urllib.parse.quote(trace, safe=""))
+        if requests_payload is None:
+            print(f"FAIL: no retained flight record for trace {trace!r}",
+                  file=sys.stderr)
+            return 1
+    doc = build_trace(timeline, requests_payload, instance=url)
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    out_path.write_text(json.dumps(doc, indent=1, sort_keys=True))
+    other = doc["otherData"]
+    print(f"wrote {out_path} ({len(doc['traceEvents'])} events, "
+          f"{other['launches']} launches, {other['records']} records) — "
+          "open in https://ui.perfetto.dev")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--url", default="http://127.0.0.1:8090",
+                        help="gateway base URL (default %(default)s)")
+    parser.add_argument("--trace", default=None,
+                        help="flight-recorder trace id to overlay as a "
+                             "request track with launch flow arrows")
+    parser.add_argument("-o", "--out", type=pathlib.Path,
+                        default=pathlib.Path("artifacts/timeline.json"),
+                        help="output path (default %(default)s)")
+    args = parser.parse_args(argv)
+    return run(args.url, args.trace, args.out)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
