@@ -158,6 +158,16 @@ hot-demo:
 # background-work-class scrub verification racing the same device queue —
 # the work-class scheduler must keep the fetch SLO verdict ok while scrub
 # throughput stays > 0 (fetch p99 with/without active scrub is recorded).
+# ISSUE 18 added the predictive-readahead A/B: a cold massed sequential
+# replay (concurrent consumers each replaying a chain of encrypted
+# segments front to back, NO warm pass) with the ReadaheadManager tier on
+# vs the identical chain without it — readahead must win BOTH replay p99
+# and total GCM launches (speculative windows merge foreground windows
+# into fewer ranged GETs + batched decrypts), hold a cold hit rate >= 90%,
+# keep wasted speculative bytes within readahead.misprediction.max.ratio
+# by the readahead-misprediction SLO spec's own verdict, continue across
+# every segment boundary, and leave attributable readahead.window flight
+# records.
 # Writes artifacts/load_report.json + artifacts/BENCH_LOAD.json (the
 # committed BENCH_LOAD_r01.json trajectory point) and re-validates both.
 load-demo:
@@ -190,7 +200,7 @@ lint: analyze
 # /root/reference/build.gradle:24): flips operators in core pure-logic
 # modules and requires the owning suites to notice.
 mutation:
-	$(PYTHON) tools/mutation_test.py --budget 135
+	$(PYTHON) tools/mutation_test.py --budget 150
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -357,6 +357,109 @@ def bench_hot_fetch(
     }
 
 
+def bench_readahead_replay(
+    chunks: list[bytes], dk, *, ra_window: int = 4
+) -> dict:
+    """Predictive sequential readahead (ISSUE 18): the same cold sequential
+    replay measured with the `ReadaheadManager` tier on vs off. The
+    foreground reads chunk-at-a-time (the worst reactive shape); the
+    readahead arm speculates `ra_window`-chunk windows ahead through the
+    SAME chain, so the on-arm should show fewer (merged) GCM dispatches
+    and a lower per-read p99 once the stream promotes. Recorded as
+    trajectory keys — the `make load-demo` A/B is the hard gate."""
+    import io as _io
+
+    from tieredstorage_tpu.fetch.cache.memory import MemoryChunkCache
+    from tieredstorage_tpu.fetch.chunk_manager import DefaultChunkManager
+    from tieredstorage_tpu.fetch.readahead import ReadaheadManager
+    from tieredstorage_tpu.manifest.chunk_index import FixedSizeChunkIndex
+    from tieredstorage_tpu.manifest.encryption_metadata import (
+        SegmentEncryptionMetadataV1,
+    )
+    from tieredstorage_tpu.manifest.segment_indexes import (
+        IndexType,
+        SegmentIndexesV1Builder,
+    )
+    from tieredstorage_tpu.manifest.segment_manifest import SegmentManifestV1
+    from tieredstorage_tpu.ops import gcm as gcm_ops
+    from tieredstorage_tpu.storage.core import ObjectKey
+    from tieredstorage_tpu.transform.api import TransformOptions
+    from tieredstorage_tpu.transform.tpu import TpuTransformBackend
+
+    chunk_bytes = len(chunks[0])
+    n_chunks = len(chunks)
+    backend = TpuTransformBackend()
+    ivs = [i.to_bytes(4, "big") * 3 for i in range(1, n_chunks + 1)]
+    blob = b"".join(
+        backend.transform(chunks, TransformOptions(encryption=dk, ivs=ivs))
+    )
+
+    class _Fetcher:
+        def fetch(self, key, r):
+            return _io.BytesIO(blob[r.from_position : r.to_position + 1])
+
+    index = FixedSizeChunkIndex(
+        original_chunk_size=chunk_bytes,
+        original_file_size=chunk_bytes * n_chunks,
+        transformed_chunk_size=chunk_bytes + 28,
+        final_transformed_chunk_size=chunk_bytes + 28,
+    )
+    builder = SegmentIndexesV1Builder()
+    for t in (IndexType.OFFSET, IndexType.TIMESTAMP,
+              IndexType.PRODUCER_SNAPSHOT, IndexType.LEADER_EPOCH):
+        builder.add(t, 0)
+    manifest = SegmentManifestV1(
+        chunk_index=index, segment_indexes=builder.build(), compression=False,
+        encryption=SegmentEncryptionMetadataV1(dk.data_key, dk.aad),
+        remote_log_segment_metadata=None,
+    )
+    key = ObjectKey("bench/topic/0/00000000000000000000-bench.log")
+
+    def cold_replay(readahead_on: bool):
+        cache = MemoryChunkCache(DefaultChunkManager(_Fetcher(), backend))
+        cache.configure({
+            "size": chunk_bytes * n_chunks, "prefetch.max.size": 0,
+        })
+        tier = (
+            ReadaheadManager(cache, window_chunks=ra_window)
+            if readahead_on else cache
+        )
+        before = gcm_ops.device_dispatches()
+        lat_s: list[float] = []
+        try:
+            for cid in range(n_chunks):
+                t0 = time.perf_counter()
+                got = tier.get_chunks(key, manifest, [cid])
+                lat_s.append(time.perf_counter() - t0)
+                assert got[0] == chunks[cid]
+            if readahead_on:
+                # Drain in-flight speculation before counting dispatches.
+                tier._executor.shutdown(wait=True)
+            dispatches = gcm_ops.device_dispatches() - before
+            manager = tier if readahead_on else None
+            return lat_s, dispatches, manager
+        finally:
+            if readahead_on:
+                tier._executor.shutdown(wait=True)
+            cache.close()
+
+    lat_off, dispatches_off, _ = cold_replay(False)
+    lat_on, dispatches_on, manager = cold_replay(True)
+    p99 = lambda xs: float(np.percentile(np.array(xs) * 1000.0, 99))  # noqa: E731
+    return {
+        "readahead_on_p99_ms": round(p99(lat_on), 3),
+        "readahead_off_p99_ms": round(p99(lat_off), 3),
+        "readahead_on_gcm_launches": dispatches_on,
+        "readahead_off_gcm_launches": dispatches_off,
+        "readahead_launches": manager.windows_launched,
+        "readahead_occupancy": round(
+            manager.chunks_speculated / max(1, manager.windows_launched), 3
+        ),
+        "readahead_hit_rate": round(manager.hit_rate, 4),
+        "readahead_wasted_ratio": round(manager.misprediction_ratio, 4),
+    }
+
+
 def measure_compile_cost(dk, chunk_bytes: int, window: int) -> dict:
     """First-trace compile cost of the fused packed window program at the
     bench shape (ISSUE 13: the full-GCM XLA graph once cost a 33-minute
@@ -786,6 +889,28 @@ def run_bench() -> dict:
     except Exception as exc:
         extras["hot_error"] = f"{type(exc).__name__}: {exc}"
         _err(f"[bench] hot-tier bench failed: {extras['hot_error']}")
+
+    # 1c2. PREDICTIVE READAHEAD (ISSUE 18): the cold sequential replay with
+    # the readahead tier on vs off — merged-launch and p99 trajectory keys
+    # (BENCH_READAHEAD); the load-demo A/B is the hard gate. Guarded: a
+    # readahead failure must not cost the already-measured numbers.
+    try:
+        ra_chunks = chunks if platform == "tpu" else chunks[: min(8, n_chunks)]
+        extras.update(bench_readahead_replay(ra_chunks, dk))
+        _err(
+            f"[bench] BENCH_READAHEAD replay: "
+            f"p99 on={extras['readahead_on_p99_ms']}ms "
+            f"off={extras['readahead_off_p99_ms']}ms, GCM launches "
+            f"on={extras['readahead_on_gcm_launches']} "
+            f"off={extras['readahead_off_gcm_launches']}, "
+            f"launches={extras['readahead_launches']} "
+            f"occ={extras['readahead_occupancy']}, "
+            f"hit_rate={extras['readahead_hit_rate']}, "
+            f"wasted_ratio={extras['readahead_wasted_ratio']}"
+        )
+    except Exception as exc:
+        extras["readahead_error"] = f"{type(exc).__name__}: {exc}"
+        _err(f"[bench] readahead bench failed: {extras['readahead_error']}")
 
     # 1d. CROSS-REQUEST BATCHING (ISSUE 15): concurrent-stream decrypt
     # through the WindowBatcher vs the unbatched control. Guarded the same

@@ -1,0 +1,581 @@
+"""Predictive sequential readahead (ISSUE 18): detector, budget, evidence.
+
+Three layers of coverage:
+
+- Fake-clock detector unit tests against a recording stub delegate with an
+  inline (synchronous) speculation executor: the promotion/demotion matrix,
+  retry tolerance, budget exhaustion, misprediction strike-out + waste
+  accounting, the ratio self-throttle, cross-segment continuation, stream
+  LRU eviction, and failure back-out — all deterministic.
+- Integration over the REAL fetch chain (TpuTransformBackend + encrypted
+  blob + MemoryChunkCache): byte parity readahead-on vs off, every range
+  fetched (and therefore decrypted) at most once, speculative work carrying
+  background class + speculative scope + a synthetic flight record.
+- A deterministic pre-admit race: a foreground read arriving while the
+  speculative window's fetch+detransform is still in flight JOINS the chunk
+  cache's single-flight decode — never a second fetch, never a second
+  decrypt.
+- The keyed single-flight manifest lookahead (satellite of the same ISSUE):
+  dedupe, join, failed-flight retry-through-cache.
+"""
+
+from __future__ import annotations
+
+import io
+import random
+import threading
+import time
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from tieredstorage_tpu.fetch.cache.memory import MemoryChunkCache  # noqa: E402
+from tieredstorage_tpu.fetch.chunk_manager import (  # noqa: E402
+    ChunkManager,
+    DefaultChunkManager,
+)
+from tieredstorage_tpu.fetch.manifest_cache import (  # noqa: E402
+    ManifestLookahead,
+    MemorySegmentManifestCache,
+)
+from tieredstorage_tpu.fetch.readahead import (  # noqa: E402
+    IDLE,
+    READAHEAD,
+    ReadaheadManager,
+)
+from tieredstorage_tpu.manifest.chunk_index import FixedSizeChunkIndex  # noqa: E402
+from tieredstorage_tpu.manifest.encryption_metadata import (  # noqa: E402
+    SegmentEncryptionMetadataV1,
+)
+from tieredstorage_tpu.manifest.segment_indexes import (  # noqa: E402
+    IndexType,
+    SegmentIndexesV1Builder,
+)
+from tieredstorage_tpu.manifest.segment_manifest import SegmentManifestV1  # noqa: E402
+from tieredstorage_tpu.security.aes import AesEncryptionProvider  # noqa: E402
+from tieredstorage_tpu.storage.core import ObjectKey  # noqa: E402
+from tieredstorage_tpu.transform.api import TransformOptions  # noqa: E402
+from tieredstorage_tpu.transform.scheduler import (  # noqa: E402
+    BACKGROUND,
+    current_work_class,
+    is_speculative,
+    speculative_scope,
+)
+from tieredstorage_tpu.transform.tpu import TpuTransformBackend  # noqa: E402
+from tieredstorage_tpu.utils import flightrecorder as flight  # noqa: E402
+from tieredstorage_tpu.utils.flightrecorder import FlightRecorder  # noqa: E402
+
+CHUNK = 4 << 10
+N_CHUNKS = 16
+WINDOW = 4
+KEY = ObjectKey("ra/topic-ra/0/00000000000000000000-seg.log")
+KEY2 = ObjectKey("ra/topic-ra/0/00000000000000000016-seg.log")
+
+
+def stream_of(manager: ReadaheadManager, key: ObjectKey = KEY):
+    return manager._streams[key.value.rsplit("/", 1)[-1]]
+
+
+def make_manifest(n_chunks: int = N_CHUNKS, encryption=None) -> SegmentManifestV1:
+    index = FixedSizeChunkIndex(
+        original_chunk_size=CHUNK, original_file_size=CHUNK * n_chunks,
+        transformed_chunk_size=CHUNK + 28, final_transformed_chunk_size=CHUNK + 28,
+    )
+    builder = SegmentIndexesV1Builder()
+    for t in (IndexType.OFFSET, IndexType.TIMESTAMP,
+              IndexType.PRODUCER_SNAPSHOT, IndexType.LEADER_EPOCH):
+        builder.add(t, 0)
+    return SegmentManifestV1(
+        chunk_index=index, segment_indexes=builder.build(), compression=False,
+        encryption=encryption, remote_log_segment_metadata=None,
+    )
+
+
+class RecordingDelegate(ChunkManager):
+    """Stub lowest tier: zero-filled plaintext, records every call's ids +
+    ambient work class / speculative flag / flight-record identity."""
+
+    def __init__(self, fail: bool = False) -> None:
+        self.calls: list[dict] = []
+        self.fail = fail
+        self._lock = threading.Lock()
+
+    def get_chunk(self, objects_key, manifest, chunk_id):
+        return io.BytesIO(self.get_chunks(objects_key, manifest, [chunk_id])[0])
+
+    def get_chunks(self, objects_key, manifest, chunk_ids):
+        record = flight.current_record()
+        with self._lock:
+            self.calls.append({
+                "key": objects_key.value,
+                "ids": list(chunk_ids),
+                "work_class": current_work_class(),
+                "speculative": is_speculative(),
+                "flight_name": record.name if record is not None else None,
+            })
+        if self.fail and is_speculative():
+            raise RuntimeError("injected speculation failure")
+        index = manifest.chunk_index
+        return [bytes(index._chunk_at(cid).original_size) for cid in chunk_ids]
+
+    def speculative_calls(self) -> list[dict]:
+        with self._lock:
+            return [c for c in self.calls if c["speculative"]]
+
+
+class InlineExecutor:
+    """Run submits synchronously — deterministic speculation in unit tests."""
+
+    def submit(self, fn, *args, **kwargs):
+        fn(*args, **kwargs)
+
+    def shutdown(self, wait=True, cancel_futures=False):
+        pass
+
+
+def make_manager(delegate, *, inline: bool = True, **kwargs) -> ReadaheadManager:
+    manager = ReadaheadManager(delegate, **kwargs)
+    if inline:
+        manager._executor.shutdown(wait=True)
+        manager._executor = InlineExecutor()
+    return manager
+
+
+def read_windows(manager, manifest, lo, hi, key=KEY, window=WINDOW):
+    for start in range(lo, hi, window):
+        manager.get_chunks(
+            key, manifest, list(range(start, min(start + window, hi)))
+        )
+
+
+def wait_until(predicate, timeout_s: float = 30.0) -> None:
+    deadline = time.monotonic() + timeout_s
+    while not predicate():
+        assert time.monotonic() < deadline, "condition not reached in time"
+        time.sleep(0.005)
+
+
+class TestDetector:
+    """Promotion/demotion state machine (fake clock, inline speculation)."""
+
+    def test_promotes_after_consecutive_sequential_reads(self):
+        delegate = RecordingDelegate()
+        manager = make_manager(delegate, window_chunks=WINDOW)
+        manifest = make_manifest()
+        manager.get_chunks(KEY, manifest, [0, 1, 2, 3])
+        manager.get_chunks(KEY, manifest, [4, 5, 6, 7])
+        assert manager.promotions == 0  # one sequential pair is coincidence
+        assert delegate.speculative_calls() == []
+        manager.get_chunks(KEY, manifest, [8, 9, 10, 11])
+        assert manager.promotions == 1
+        # The promoted stream speculated the NEXT window past the frontier.
+        spec = delegate.speculative_calls()
+        assert [c["ids"] for c in spec] == [[12, 13, 14, 15]]
+        assert manager.windows_launched == 1
+        assert manager.chunks_speculated == WINDOW
+        manager.close()
+
+    def test_random_reads_never_promote(self):
+        delegate = RecordingDelegate()
+        manager = make_manager(delegate, window_chunks=WINDOW)
+        manifest = make_manifest()
+        for start in (8, 0, 12, 4, 8):
+            manager.get_chunks(KEY, manifest, list(range(start, start + WINDOW)))
+        assert manager.promotions == 0
+        assert delegate.speculative_calls() == []
+        manager.close()
+
+    def test_window_reread_is_neither_run_nor_strike(self):
+        delegate = RecordingDelegate()
+        manager = make_manager(delegate, window_chunks=WINDOW)
+        manifest = make_manifest()
+        manager.get_chunks(KEY, manifest, [0, 1, 2, 3])
+        manager.get_chunks(KEY, manifest, [4, 5, 6, 7])
+        runs_before = stream_of(manager).runs
+        # Broker retry of the SAME window: idempotent, not a seek.
+        manager.get_chunks(KEY, manifest, [4, 5, 6, 7])
+        stream = stream_of(manager)
+        assert stream.runs == runs_before
+        assert manager.strikes == 0
+        assert stream.expected_next == 8
+        manager.close()
+
+    def test_strikeout_demotes_and_wastes_outstanding(self):
+        delegate = RecordingDelegate()
+        manager = make_manager(delegate, window_chunks=WINDOW, max_strikes=2)
+        manifest = make_manifest()
+        read_windows(manager, manifest, 0, 12)  # promote; speculates [12..15]
+        assert manager.promotions == 1
+        # Two non-sequential seeks BACKWARD: strike out.
+        manager.get_chunks(KEY, manifest, [0, 1, 2, 3])
+        assert manager.strikes == 1
+        assert manager.demotions == 0
+        manager.get_chunks(KEY, manifest, [8, 9, 10, 11])
+        assert manager.strikes == 2
+        assert manager.demotions == 1
+        assert stream_of(manager).state == IDLE
+        # The completed-but-unused speculation is charged as waste.
+        assert manager.wasted_bytes == WINDOW * CHUNK
+        assert manager.misprediction_ratio == 1.0
+        assert manager.outstanding_chunks == 0
+        manager.close()
+
+    def test_one_seek_survives_multi_strike_hysteresis(self):
+        delegate = RecordingDelegate()
+        manager = make_manager(delegate, window_chunks=WINDOW, max_strikes=2)
+        manifest = make_manifest(n_chunks=64)
+        read_windows(manager, manifest, 0, 12)
+        assert manager.promotions == 1
+        manager.get_chunks(KEY, manifest, [40, 41, 42, 43])  # one seek
+        assert stream_of(manager).state == READAHEAD  # still promoted
+        assert manager.strikes == 1 and manager.demotions == 0
+        manager.close()
+
+    def test_skipped_predictions_charge_waste_without_demotion(self):
+        delegate = RecordingDelegate()
+        manager = make_manager(delegate, window_chunks=WINDOW, max_strikes=2)
+        manifest = make_manifest(n_chunks=64)
+        read_windows(manager, manifest, 0, 12)  # speculated [12..15]
+        # The consumer jumps PAST the prediction: superseded, not consumed.
+        manager.get_chunks(KEY, manifest, [40, 41, 42, 43])
+        assert manager.wasted_bytes == WINDOW * CHUNK
+        assert manager.used_chunks == 0
+        assert manager.outstanding_chunks == 0
+        manager.close()
+
+    def test_consumption_accounting_and_pre_admit_age(self):
+        clock = [100.0]
+        delegate = RecordingDelegate()
+        manager = make_manager(
+            delegate, window_chunks=WINDOW, time_source=lambda: clock[0]
+        )
+        manifest = make_manifest()
+        read_windows(manager, manifest, 0, 12)  # speculates [12..15] inline
+        assert manager.inflight_bytes == 0  # completed launches release budget
+        clock[0] += 0.25
+        manager.get_chunks(KEY, manifest, [12, 13, 14, 15])
+        assert manager.used_chunks == WINDOW
+        assert manager.used_bytes == WINDOW * CHUNK
+        assert manager.hit_rate == 1.0
+        assert manager.wasted_bytes == 0
+        assert manager.pre_admit_age_samples == WINDOW
+        assert manager.mean_pre_admit_age_ms == pytest.approx(250.0)
+        manager.close()
+
+    def test_streams_lru_eviction(self):
+        delegate = RecordingDelegate()
+        manager = make_manager(delegate, streams_max=2)
+        manifest = make_manifest()
+        for i in range(4):
+            key = ObjectKey(f"ra/topic-ra/0/{i:020d}-seg.log")
+            manager.get_chunks(key, manifest, [0, 1, 2, 3])
+        assert manager.tracked_streams == 2
+        assert manager.stream_evictions == 2
+        manager.close()
+
+
+class TestBudget:
+    def test_budget_exhaustion_defers_launches(self):
+        delegate = RecordingDelegate()
+        # Budget below one window: every launch is deferred.
+        manager = make_manager(
+            delegate, window_chunks=WINDOW, budget_bytes=CHUNK * WINDOW - 1
+        )
+        manifest = make_manifest()
+        read_windows(manager, manifest, 0, 16)
+        assert delegate.speculative_calls() == []
+        assert manager.windows_launched == 0
+        assert manager.budget_deferrals > 0
+        manager.close()
+
+    def test_zero_budget_disables_speculation_keeps_detector(self):
+        delegate = RecordingDelegate()
+        manager = make_manager(delegate, window_chunks=WINDOW, budget_bytes=0)
+        manifest = make_manifest()
+        read_windows(manager, manifest, 0, 16)
+        assert manager.promotions == 1
+        assert delegate.speculative_calls() == []
+        assert manager.budget_deferrals == 0  # skipped, not deferred
+        manager.close()
+
+    def test_misprediction_ratio_self_throttle(self):
+        delegate = RecordingDelegate()
+        manager = make_manager(
+            delegate, window_chunks=WINDOW, max_strikes=2,
+            misprediction_max_ratio=0.2,
+        )
+        manifest = make_manifest(n_chunks=64)
+        read_windows(manager, manifest, 0, 12)  # promote; speculate [12..15]
+        manager.get_chunks(KEY, manifest, [40, 41, 42, 43])  # waste them
+        manager.get_chunks(KEY, manifest, [20, 21, 22, 23])  # strike out
+        assert manager.misprediction_ratio > 0.2
+        launched_before = manager.windows_launched
+        # Re-promote: the throttle must suppress launches while over bound.
+        read_windows(manager, manifest, 24, 36)
+        assert manager.windows_launched == launched_before
+        assert manager.ratio_throttles > 0
+        manager.close()
+
+    def test_speculation_failure_backs_out_accounting(self):
+        delegate = RecordingDelegate(fail=True)
+        manager = make_manager(delegate, window_chunks=WINDOW)
+        manifest = make_manifest()
+        read_windows(manager, manifest, 0, 12)
+        assert manager.speculation_failures == 1
+        # Never decrypted: not waste — the failed window leaves the books.
+        assert manager.bytes_speculated == 0
+        assert manager.inflight_bytes == 0
+        assert manager.wasted_bytes == 0
+        assert manager.outstanding_chunks == 0
+        manager.close()
+
+
+class TestCrossSegment:
+    def test_continuation_into_next_segment(self):
+        delegate = RecordingDelegate()
+        manager = make_manager(delegate, window_chunks=WINDOW)
+        manifest = make_manifest()
+        next_manifest = make_manifest()
+        resolved: list = []
+
+        def resolver(key):
+            resolved.append(key.value)
+            if key.value == KEY.value:
+                return KEY2, lambda: next_manifest
+            return None
+
+        manager.next_segment_resolver = resolver
+        read_windows(manager, manifest, 0, 16)
+        # Frontier crossed the segment end: the NEXT segment's first window
+        # was speculated and its stream pre-promoted.
+        assert resolved == [KEY.value]
+        assert manager.cross_segment_continuations == 1
+        spec_keys = [(c["key"], c["ids"]) for c in delegate.speculative_calls()]
+        assert (KEY2.value, [0, 1, 2, 3]) in spec_keys
+        assert stream_of(manager, KEY2).state == READAHEAD
+        # The consumer crossing the boundary consumes the pre-admitted rows.
+        used_before = manager.used_chunks
+        manager.get_chunks(KEY2, next_manifest, [0, 1, 2, 3])
+        assert manager.used_chunks == used_before + WINDOW
+        manager.close()
+
+    def test_log_head_has_no_continuation(self):
+        delegate = RecordingDelegate()
+        manager = make_manager(delegate, window_chunks=WINDOW)
+        manager.next_segment_resolver = lambda key: None
+        manifest = make_manifest()
+        read_windows(manager, manifest, 0, 16)
+        assert manager.cross_segment_continuations == 0
+        manager.close()
+
+
+class TestEvidence:
+    def test_speculation_runs_background_class_with_synthetic_record(self):
+        """Speculative launches run on the pool under BACKGROUND class +
+        speculative scope, bound to a fresh synthetic flight record that
+        carries the ORIGINATING stream's trace id."""
+        delegate = RecordingDelegate()
+        manager = make_manager(delegate, inline=False, window_chunks=WINDOW)
+        manager.flight_recorder = FlightRecorder(enabled=True, ring_size=16)
+        manifest = make_manifest()
+        try:
+            with manager.flight_recorder.request("test.replay",
+                                                 trace_id="t-123"):
+                read_windows(manager, manifest, 0, 12)
+            wait_until(lambda: manager.windows_launched == 1
+                       and manager.inflight_bytes == 0)
+            spec = delegate.speculative_calls()
+            assert len(spec) == 1
+            assert spec[0]["work_class"] == BACKGROUND
+            assert spec[0]["flight_name"] == "readahead.window"
+            # The synthetic record is attributable: find_all on the
+            # originating trace id returns BOTH the foreground request and
+            # the readahead window it spawned.
+            names = {r.name for r in manager.flight_recorder.find_all("t-123")}
+            assert names == {"test.replay", "readahead.window"}
+            # Foreground calls are NOT tagged speculative.
+            assert all(not c["speculative"] for c in delegate.calls
+                       if c["work_class"] is None)
+        finally:
+            manager.close()
+
+    def test_speculative_scope_nesting_restores(self):
+        assert not is_speculative()
+        with speculative_scope():
+            assert is_speculative()
+            with speculative_scope():
+                assert is_speculative()
+            assert is_speculative()
+        assert not is_speculative()
+
+
+class TestManifestLookahead:
+    def test_single_flight_dedupe_and_join(self):
+        cache = MemorySegmentManifestCache()
+        cache.configure({})
+        lookahead = ManifestLookahead(cache)
+        manifest = make_manifest()
+        gate = threading.Event()
+        loads: list[int] = []
+
+        def loader():
+            assert gate.wait(timeout=30)
+            loads.append(1)
+            return manifest
+
+        key = ObjectKey("ra/topic-ra/0/00000000000000000000-seg.manifest")
+        try:
+            lookahead.prefetch(key, loader)
+            lookahead.prefetch(key, loader)  # no-op while in flight
+            assert lookahead.launches == 1
+            gate.set()
+            got = lookahead.get(key, loader, timeout=30)
+            assert got is manifest
+            assert loads == [1]  # joined or cache-hit — never a second load
+        finally:
+            lookahead.close()
+            cache.close()
+
+    def test_failed_flight_retries_through_cache(self):
+        cache = MemorySegmentManifestCache()
+        cache.configure({})
+        lookahead = ManifestLookahead(cache)
+        manifest = make_manifest()
+        key = ObjectKey("ra/topic-ra/0/00000000000000000016-seg.manifest")
+
+        def failing_loader():
+            raise RuntimeError("manifest fetch failed")
+
+        try:
+            lookahead.prefetch(key, failing_loader)
+            wait_until(lambda: lookahead.failures == 1)
+            # The failed flight was dropped: a later get loads cleanly.
+            got = lookahead.get(key, lambda: manifest, timeout=30)
+            assert got is manifest
+        finally:
+            lookahead.close()
+            cache.close()
+
+
+# --------------------------------------------------------------- integration
+class CountingFetcher:
+    """ObjectFetcher over the transformed blob, counting ranged reads; an
+    optional gate stalls SPECULATIVE fetches until released."""
+
+    def __init__(self, blob: bytes) -> None:
+        self._blob = blob
+        self.reads = 0
+        self.ranges: list[tuple[int, int]] = []
+        self.gate: threading.Event | None = None
+        self.gate_reached = threading.Event()
+        self._lock = threading.Lock()
+
+    def fetch(self, key, r):
+        gate = self.gate
+        if gate is not None and is_speculative():
+            self.gate_reached.set()
+            assert gate.wait(timeout=30)
+        with self._lock:
+            self.reads += 1
+            self.ranges.append((r.from_position, r.to_position))
+        return io.BytesIO(self._blob[r.from_position: r.to_position + 1])
+
+
+def build_chain(*, readahead: bool, inline: bool = True):
+    rng = random.Random(7)
+    chunks = [
+        bytes(rng.getrandbits(8) for _ in range(CHUNK)) for _ in range(N_CHUNKS)
+    ]
+    dk = AesEncryptionProvider.create_data_key_and_aad()
+    backend = TpuTransformBackend()
+    ivs = [i.to_bytes(4, "big") * 3 for i in range(1, N_CHUNKS + 1)]
+    blob = b"".join(
+        backend.transform(chunks, TransformOptions(encryption=dk, ivs=ivs))
+    )
+    fetcher = CountingFetcher(blob)
+    manifest = make_manifest(
+        encryption=SegmentEncryptionMetadataV1(dk.data_key, dk.aad)
+    )
+    default = DefaultChunkManager(fetcher, backend)
+    cache = MemoryChunkCache(default)
+    cache.configure({"size": CHUNK * N_CHUNKS, "prefetch.max.size": 0})
+    if not readahead:
+        return chunks, manifest, cache, cache, fetcher
+    manager = make_manager(cache, inline=inline, window_chunks=WINDOW)
+    return chunks, manifest, manager, cache, fetcher
+
+
+class TestIntegration:
+    def test_byte_parity_readahead_on_vs_off(self):
+        results = {}
+        for mode in (False, True):
+            chunks, manifest, tier, cache, fetcher = build_chain(readahead=mode)
+            try:
+                got = []
+                for lo in range(0, N_CHUNKS, WINDOW):
+                    got.extend(
+                        tier.get_chunks(KEY, manifest,
+                                        list(range(lo, lo + WINDOW)))
+                    )
+                results[mode] = got
+                assert got == chunks
+            finally:
+                tier.close()
+        assert results[False] == results[True]
+
+    def test_every_range_fetched_at_most_once(self):
+        chunks, manifest, tier, cache, fetcher = build_chain(readahead=True)
+        try:
+            for lo in range(0, N_CHUNKS, WINDOW):
+                got = tier.get_chunks(KEY, manifest,
+                                      list(range(lo, lo + WINDOW)))
+                assert got == chunks[lo: lo + WINDOW]
+            # Speculation pre-admits through the SAME cache: no range is
+            # ever fetched twice (never double-fetch, never double-decrypt).
+            assert len(fetcher.ranges) == len(set(fetcher.ranges))
+            # The promoted tail of the replay was served from pre-admitted
+            # plaintext: used chunks show up in the accounting.
+            assert tier.used_chunks > 0
+            assert tier.wasted_bytes == 0
+        finally:
+            tier.close()
+
+    def test_foreground_read_joins_inflight_speculation(self):
+        """The pre-admit race: a foreground read arriving while the
+        speculative window is mid-fetch JOINS the chunk cache's
+        single-flight decode — never a second fetch, never a second
+        decrypt."""
+        chunks, manifest, tier, cache, fetcher = build_chain(
+            readahead=True, inline=False
+        )
+        gate = threading.Event()
+        fetcher.gate = gate
+        try:
+            # Promote: the 3rd window read launches speculation of [12..15]
+            # on the real pool, which stalls inside the gated fetch.
+            for lo in range(0, 12, WINDOW):
+                tier.get_chunks(KEY, manifest, list(range(lo, lo + WINDOW)))
+            assert fetcher.gate_reached.wait(timeout=30)
+            joins_before = cache.inflight_joins
+            # Foreground read of the stalled window from another thread: it
+            # must block as a JOINER on the in-flight speculative loads.
+            result: list = []
+            reader = threading.Thread(
+                target=lambda: result.extend(
+                    tier.get_chunks(KEY, manifest, [12, 13, 14, 15])
+                )
+            )
+            reader.start()
+            wait_until(lambda: cache.inflight_joins > joins_before)
+            gate.set()  # release the speculative fetch; both sides resolve
+            reader.join(timeout=60)
+            assert not reader.is_alive()
+            assert result == chunks[12:16]
+            # One fetch per range, storm or not: the foreground read did
+            # not re-fetch (and therefore did not re-decrypt) the window.
+            assert len(fetcher.ranges) == len(set(fetcher.ranges))
+            assert cache.inflight_joins > joins_before
+        finally:
+            gate.set()
+            tier.close()

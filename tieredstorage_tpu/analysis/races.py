@@ -104,6 +104,16 @@ SHARED_CLASSES = {
         "every merged launch and read by gateway workers serving "
         "GET /debug/timeline and by metrics-scrape gauge suppliers "
         "(ring deque + recorded/evicted/launch/expired counters)",
+    "tieredstorage_tpu/fetch/readahead.py:ReadaheadManager":
+        "one readahead tier per RSM: every gateway worker's foreground "
+        "read advances the detector + consumes pre-admitted entries while "
+        "the tier's own speculation pool resolves completed/failed "
+        "launches and metrics-scrape gauge suppliers read the counters "
+        "(stream LRU, speculated-entry map, budget + waste accounting)",
+    "tieredstorage_tpu/fetch/manifest_cache.py:ManifestLookahead":
+        "one lookahead per RSM: readahead's speculation pool launches "
+        "manifest prefetch flights while gateway workers join or race "
+        "them on segment-boundary crossings (flight table + counters)",
 }
 
 #: Executor dispatch method names whose first argument runs on a pool thread.

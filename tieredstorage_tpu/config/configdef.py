@@ -23,7 +23,7 @@ NO_DEFAULT = object()
 @dataclasses.dataclass
 class ConfigKey:
     name: str
-    type: str  # "string" | "int" | "long" | "bool" | "class" | "list" | "password"
+    type: str  # "string" | "int" | "long" | "double" | "bool" | "class" | "list" | "password"
     default: Any = NO_DEFAULT
     validator: Optional[Callable[[str, Any], None]] = None
     importance: str = "medium"
@@ -118,6 +118,10 @@ def _coerce(key: ConfigKey, value: Any) -> Any:
             if isinstance(value, bool):
                 raise ValueError
             return int(value)
+        if t == "double":
+            if isinstance(value, bool):
+                raise ValueError
+            return float(value)
         if t == "bool":
             if isinstance(value, bool):
                 return value

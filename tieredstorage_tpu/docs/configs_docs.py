@@ -103,6 +103,19 @@ def generate() -> str:
         "",
     ])
     out.append(render_config_def(device_hot._definition()))
+    out += _section("ReadaheadConfig")
+    from tieredstorage_tpu.fetch import readahead
+
+    out.extend([
+        "The predictive sequential-readahead tier (speculate future",
+        "windows, pre-admit verified plaintext): top-level keys read by",
+        "the ChunkManagerFactory. The tier wraps the fetch chain outermost",
+        "and is disabled unless ``readahead.enabled`` is true; see",
+        "``docs/readahead.rst`` for the detector state machine and budget",
+        "math.",
+        "",
+    ])
+    out.append(render_config_def(readahead._definition()))
     out += _section("SegmentManifestCacheConfig (prefix: fetch.manifest.cache.)")
     out.append(
         render_config_def(

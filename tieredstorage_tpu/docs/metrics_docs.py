@@ -172,6 +172,20 @@ def _collect_caches() -> dict[str, list[str]]:
     from tieredstorage_tpu.metrics.cache_metrics import register_hot_cache_metrics
 
     register_hot_cache_metrics(registry, DeviceHotCache(None))
+
+    from tieredstorage_tpu.fetch.manifest_cache import ManifestLookahead
+    from tieredstorage_tpu.fetch.readahead import ReadaheadManager
+    from tieredstorage_tpu.metrics.cache_metrics import (
+        register_manifest_lookahead_metrics,
+        register_readahead_metrics,
+    )
+
+    readahead = ReadaheadManager(None)
+    register_readahead_metrics(registry, readahead)
+    readahead.close()
+    lookahead = ManifestLookahead(None)
+    register_manifest_lookahead_metrics(registry, lookahead)
+    lookahead.close()
     return _group_names(registry)
 
 

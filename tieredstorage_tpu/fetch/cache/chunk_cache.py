@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import abc
 import concurrent.futures
+import contextlib
 import dataclasses
 import io
 import logging
@@ -28,6 +29,12 @@ from tieredstorage_tpu.config.cache_config import ChunkCacheConfig
 from tieredstorage_tpu.fetch.chunk_manager import ChunkManager
 from tieredstorage_tpu.manifest.segment_manifest import SegmentManifestV1
 from tieredstorage_tpu.storage.core import ObjectKey
+from tieredstorage_tpu.transform.scheduler import (
+    current_work_class,
+    is_speculative,
+    speculative_scope,
+    work_class_scope,
+)
 from tieredstorage_tpu.utils import flightrecorder as flight
 from tieredstorage_tpu.utils.caching import LoadingCache, RemovalCause
 from tieredstorage_tpu.utils.deadline import check_deadline, remaining_s
@@ -69,6 +76,10 @@ class ChunkCache(ChunkManager, Generic[T], abc.ABC):
     #: Optional latency hook `(elapsed_ms)` per window read; the RSM wires it
     #: to Metrics.record_cache_get.
     on_get = None
+    #: Synthetic-record source for pool-side prefetch loads; the RSM wires
+    #: its configured FlightRecorder so prefetch windows appear on
+    #: /debug/requests and as attributable timeline flows instead of gaps.
+    flight_recorder = flight.NOOP_RECORDER
 
     def __init__(self, delegate: ChunkManager) -> None:
         self._delegate = delegate
@@ -314,19 +325,24 @@ class ChunkCache(ChunkManager, Generic[T], abc.ABC):
                 )
             else:
                 # The pool worker loads on behalf of THIS request: re-bind
-                # its flight record AND trace context across the hop (the
-                # request thread blocks right below) so the lower tiers'
-                # outcomes land on it and a peer-cache forward carries the
-                # request's traceparent — the fleet stitcher joins the
-                # owner's /chunk serve records on it. The prefetch branch
-                # (deadline=None, already on a pool worker) deliberately
-                # carries neither — it outlives the request that
-                # triggered it.
+                # its flight record, trace context, work class, and
+                # speculative flag across the hop (the request thread blocks
+                # right below) so the lower tiers' outcomes land on it, a
+                # peer-cache forward carries the request's traceparent — the
+                # fleet stitcher joins the owner's /chunk serve records on
+                # it — and a readahead window's decrypt keeps its BACKGROUND
+                # admission class + speculative-ledger label instead of
+                # silently escalating to latency class on the pool thread.
+                # The prefetch branch (deadline=None, already on a pool
+                # worker) deliberately carries none of these — it outlives
+                # the request that triggered it.
                 record = flight.current_record()
                 traceparent = self.tracer.current_traceparent()
+                work_class = current_work_class()
+                speculative = is_speculative()
                 task = self._executor.submit(
-                    self._load_owned_bound, record, traceparent,
-                    objects_key, manifest, own,
+                    self._load_owned_bound, record, traceparent, work_class,
+                    speculative, objects_key, manifest, own,
                 )
                 try:
                     futures.update(
@@ -338,8 +354,17 @@ class ChunkCache(ChunkManager, Generic[T], abc.ABC):
                     ) from None
         return futures
 
-    def _load_owned_bound(self, record, traceparent, objects_key, manifest, own):
-        with flight.bound(record), self.tracer.continue_trace(traceparent):
+    def _load_owned_bound(
+        self, record, traceparent, work_class, speculative,
+        objects_key, manifest, own,
+    ):
+        with contextlib.ExitStack() as stack:
+            stack.enter_context(flight.bound(record))
+            stack.enter_context(self.tracer.continue_trace(traceparent))
+            if work_class is not None:
+                stack.enter_context(work_class_scope(work_class))
+            if speculative:
+                stack.enter_context(speculative_scope())
             return self._load_owned(objects_key, manifest, own)
 
     def _load_owned(
@@ -409,10 +434,16 @@ class ChunkCache(ChunkManager, Generic[T], abc.ABC):
             return
         # Fire-and-forget: one batched load covers the whole prefetch window
         # (deadline=None — already on a pool worker, fetch runs inline there).
-        self._executor.submit(self._prefetch_window, objects_key, manifest, ids)
+        # The originating request's trace id rides along so the pool-side
+        # load's synthetic flight record is attributable to its stream.
+        self._executor.submit(
+            self._prefetch_window, objects_key, manifest, ids,
+            flight.current_trace_id() or "",
+        )
 
     def _prefetch_window(
-        self, objects_key: ObjectKey, manifest: SegmentManifestV1, ids: Sequence[int]
+        self, objects_key: ObjectKey, manifest: SegmentManifestV1,
+        ids: Sequence[int], origin_trace_id: str = "",
     ) -> None:
         """Isolation boundary: a failed prefetch is counted, never raised —
         and the LoadingCache drops failed loads, so the entries stay clean
@@ -428,13 +459,24 @@ class ChunkCache(ChunkManager, Generic[T], abc.ABC):
         try:
             # Prefetch runs on a pool worker: its spans are roots of their own
             # trace (the requesting thread's context is deliberately not
-            # captured — the prefetch outlives the request).
+            # captured — the prefetch outlives the request). But the work is
+            # NOT anonymous: it opens a synthetic flight record stamped with
+            # the originating stream's trace id, so /debug/timeline and
+            # assemble_trace show prefetch flows joined to their stream.
             window = self._config.prefetch_window_chunks or len(ids)
-            with self.tracer.span("cache.prefetch", chunks=len(ids)):
-                for i in range(0, len(ids), max(1, window)):
-                    self._populate_window(
-                        objects_key, manifest, ids[i : i + max(1, window)], None
-                    )
+            with self.flight_recorder.request(
+                "cache.prefetch", trace_id=origin_trace_id
+            ):
+                flight.note("prefetch.chunks", len(ids))
+                flight.stage(
+                    f"prefetch.segment:{objects_key.value.rsplit('/', 1)[-1]}"
+                )
+                with self.tracer.span("cache.prefetch", chunks=len(ids)):
+                    for i in range(0, len(ids), max(1, window)):
+                        self._populate_window(
+                            objects_key, manifest, ids[i : i + max(1, window)],
+                            None,
+                        )
         except Exception:
             self.prefetch_failures += 1
             self.tracer.event("cache.prefetch_failure", chunks=len(ids))

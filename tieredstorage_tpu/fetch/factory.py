@@ -7,9 +7,14 @@ subclass-of-ChunkCache validated, no cache when unset).
 
 Extended TPU-first with the device hot-window tier (ISSUE 12): when
 ``cache.device.bytes`` > 0 a `DeviceHotCache` is inserted between the chunk
-cache and the fleet peer tier, so the chain reads::
+cache and the fleet peer tier; with ``readahead.enabled`` (ISSUE 18) the
+predictive readahead tier wraps OUTERMOST — it must see the raw foreground
+read stream (cache hits included) to detect sequentiality, and its
+speculative loads go through the full tier stack below so pre-admission IS
+a cache population. The full chain reads::
 
-    ChunkCache -> DeviceHotCache -> [PeerChunkCache] -> DefaultChunkManager
+    [ReadaheadManager] -> ChunkCache -> DeviceHotCache -> [PeerChunkCache]
+                       -> DefaultChunkManager
 """
 
 from __future__ import annotations
@@ -23,10 +28,12 @@ from tieredstorage_tpu.config.configdef import (
     subset_with_prefix,
 )
 from tieredstorage_tpu.config.rsm_config import FETCH_CHUNK_CACHE_PREFIX
+from tieredstorage_tpu.fetch import readahead as readahead_mod
 from tieredstorage_tpu.fetch.cache import device_hot
 from tieredstorage_tpu.fetch.cache.chunk_cache import ChunkCache
 from tieredstorage_tpu.fetch.cache.device_hot import DeviceHotCache
 from tieredstorage_tpu.fetch.chunk_manager import ChunkManager, DefaultChunkManager
+from tieredstorage_tpu.fetch.readahead import ReadaheadManager
 from tieredstorage_tpu.storage.core import ObjectFetcher
 from tieredstorage_tpu.transform.api import TransformBackend
 
@@ -42,6 +49,8 @@ class ChunkManagerFactoryConfig:
                 "no chunk caching.",
         ))
         for key in device_hot._definition().keys.values():
+            d.define(key)
+        for key in readahead_mod._definition().keys.values():
             d.define(key)
         self._values = d.parse(props)
         self._props = dict(props)
@@ -63,6 +72,26 @@ class ChunkManagerFactoryConfig:
     def device_sketch_width(self) -> int:
         return self._values["cache.device.sketch.width"]
 
+    @property
+    def readahead_enabled(self) -> bool:
+        return self._values["readahead.enabled"]
+
+    @property
+    def readahead_window_chunks(self) -> int:
+        return self._values["readahead.window.chunks"]
+
+    @property
+    def readahead_streams_max(self) -> int:
+        return self._values["readahead.streams.max"]
+
+    @property
+    def readahead_budget_bytes(self) -> int:
+        return self._values["readahead.budget.bytes"]
+
+    @property
+    def readahead_misprediction_max_ratio(self) -> float:
+        return self._values["readahead.misprediction.max.ratio"]
+
     def chunk_cache_configs(self) -> dict[str, Any]:
         # The stray "class" key the strip produces is ignored by the cache's
         # ConfigDef (undefined keys are skipped by parse).
@@ -76,6 +105,11 @@ class ChunkManagerFactory:
         #: when `cache.device.bytes` is 0) — the RSM wires its tracer and
         #: hot-cache-metrics gauges through this handle.
         self.device_hot_cache: Optional[DeviceHotCache] = None
+        #: The readahead tier built by the last `init_chunk_manager` call
+        #: (None unless ``readahead.enabled``) — the RSM wires its tracer,
+        #: flight recorder, next-segment resolver, metrics gauges and the
+        #: misprediction SLO spec through this handle.
+        self.readahead_manager: Optional[ReadaheadManager] = None
 
     def configure(self, configs: Mapping[str, Any]) -> None:
         self._config = ChunkManagerFactoryConfig(configs)
@@ -107,8 +141,24 @@ class ChunkManagerFactory:
             )
             inner = self.device_hot_cache
         cache_class = self._config.chunk_cache_class
-        if cache_class is None:
-            return inner
-        cache: ChunkCache = cache_class(inner)
-        cache.configure(self._config.chunk_cache_configs())
-        return cache
+        if cache_class is not None:
+            cache: ChunkCache = cache_class(inner)
+            cache.configure(self._config.chunk_cache_configs())
+            inner = cache
+        self.readahead_manager = None
+        if self._config.readahead_enabled:
+            # Outermost: the detector must observe every foreground read
+            # (including the ones the cache below will serve as hits), and
+            # its speculation goes through the whole chain so verified
+            # plaintext lands in the cache tiers before the consumer asks.
+            self.readahead_manager = ReadaheadManager(
+                inner,
+                window_chunks=self._config.readahead_window_chunks,
+                streams_max=self._config.readahead_streams_max,
+                budget_bytes=self._config.readahead_budget_bytes,
+                misprediction_max_ratio=(
+                    self._config.readahead_misprediction_max_ratio
+                ),
+            )
+            inner = self.readahead_manager
+        return inner

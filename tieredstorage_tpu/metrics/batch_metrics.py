@@ -67,6 +67,15 @@ def register_batch_metrics(registry: MetricsRegistry, batcher) -> None:
           "the failing launch's one work class)")
     gauge("batch-mean-occupancy", lambda: float(batcher.mean_occupancy),
           "Coalesced windows per merged launch since start")
+    gauge("batch-speculative-windows-total",
+          lambda: float(batcher.speculative_windows),
+          "Windows submitted under a speculative scope (readahead bets, "
+          "not demanded data)")
+    gauge("batch-speculative-bytes-total",
+          lambda: float(batcher.speculative_bytes),
+          "Payload bytes submitted under a speculative scope — paired "
+          "with the readahead wasted-bytes ratio, separates prediction "
+          "load from demanded background work")
 
     # Per-work-class gauges: the scheduler's isolation surface. Late-bound
     # per class via default args so each closure reads ITS class.

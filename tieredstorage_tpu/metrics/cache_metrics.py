@@ -21,6 +21,7 @@ from tieredstorage_tpu.utils.caching import CacheStats, RemovalCause
 CACHE_METRIC_GROUP = "cache-metrics"
 THREAD_POOL_METRIC_GROUP = "thread-pool-metrics"
 HOT_CACHE_METRIC_GROUP = "hot-cache-metrics"
+READAHEAD_METRIC_GROUP = "readahead-metrics"
 
 
 def register_cache_metrics(
@@ -101,6 +102,93 @@ def register_hot_cache_metrics(registry: MetricsRegistry, hot_cache) -> None:
           "Device-buffer bytes resident (HBM share of the budget)")
     gauge("hot-cache-budget-bytes", lambda: float(hot_cache.budget_bytes),
           "Configured cache.device.bytes budget")
+
+
+def register_readahead_metrics(registry: MetricsRegistry, readahead) -> None:
+    """Publish the predictive readahead tier's counters as supplier gauges
+    (group ``readahead-metrics``; fetch/readahead.py)."""
+
+    def gauge(name: str, supplier, description: str = "") -> None:
+        registry.add_gauge(
+            MetricName.of(name, READAHEAD_METRIC_GROUP, description), supplier
+        )
+
+    gauge("readahead-promotions-total", lambda: float(readahead.promotions),
+          "Streams promoted to readahead state by the sequential detector")
+    gauge("readahead-demotions-total", lambda: float(readahead.demotions),
+          "Promoted streams demoted after striking out on mispredictions")
+    gauge("readahead-strikes-total", lambda: float(readahead.strikes),
+          "Non-sequential jumps observed on promoted streams")
+    gauge("readahead-stream-evictions-total",
+          lambda: float(readahead.stream_evictions),
+          "Detector streams evicted past readahead.streams.max (LRU)")
+    gauge("readahead-streams-tracked", lambda: float(readahead.tracked_streams),
+          "Per-segment streams currently tracked by the detector")
+    gauge("readahead-windows-launched-total",
+          lambda: float(readahead.windows_launched),
+          "Speculative window launches admitted past the byte budget")
+    gauge("readahead-chunks-speculated-total",
+          lambda: float(readahead.chunks_speculated),
+          "Chunks speculated ahead of their stream's frontier")
+    gauge("readahead-bytes-speculated-total",
+          lambda: float(readahead.bytes_speculated),
+          "Original-side bytes speculated (the wasted-ratio denominator)")
+    gauge("readahead-inflight-bytes", lambda: float(readahead.inflight_bytes),
+          "Speculated bytes currently in flight against "
+          "readahead.budget.bytes")
+    gauge("readahead-budget-bytes", lambda: float(readahead.budget_bytes),
+          "Configured readahead.budget.bytes hard speculation budget")
+    gauge("readahead-used-chunks-total", lambda: float(readahead.used_chunks),
+          "Speculated chunks later consumed by a foreground read")
+    gauge("readahead-used-bytes-total", lambda: float(readahead.used_bytes),
+          "Speculated bytes later consumed by a foreground read")
+    gauge("readahead-wasted-bytes-total", lambda: float(readahead.wasted_bytes),
+          "Speculated-and-decrypted bytes the stream never consumed "
+          "(demotion, eviction, or the consumer skipping past)")
+    gauge("readahead-hit-rate", lambda: float(readahead.hit_rate),
+          "used chunks / speculated chunks since start")
+    gauge("readahead-misprediction-ratio",
+          lambda: float(readahead.misprediction_ratio),
+          "wasted bytes / speculated bytes — bounded by "
+          "readahead.misprediction.max.ratio (the SLO objective)")
+    gauge("readahead-mean-pre-admit-age-ms",
+          lambda: float(readahead.mean_pre_admit_age_ms),
+          "Mean age (ms) of pre-admitted plaintext between speculation "
+          "completing and its first foreground use")
+    gauge("readahead-budget-deferrals-total",
+          lambda: float(readahead.budget_deferrals),
+          "Speculative launches deferred because the in-flight budget was "
+          "exhausted")
+    gauge("readahead-ratio-throttles-total",
+          lambda: float(readahead.ratio_throttles),
+          "Launches suppressed by the misprediction-ratio self-throttle")
+    gauge("readahead-cross-segment-continuations-total",
+          lambda: float(readahead.cross_segment_continuations),
+          "Readahead pipelines continued into the NEXT segment via the "
+          "next-segment resolver")
+    gauge("readahead-speculation-failures-total",
+          lambda: float(readahead.speculation_failures),
+          "Speculative window loads that failed (counted, never raised)")
+
+
+def register_manifest_lookahead_metrics(
+    registry: MetricsRegistry, lookahead
+) -> None:
+    """Publish the manifest lookahead's single-flight counters (group
+    ``cache-metrics``, tagged cache=manifest-lookahead)."""
+    tags = {"cache": "manifest-lookahead"}
+
+    def gauge(name: str, supplier, description: str = "") -> None:
+        registry.add_gauge(
+            MetricName.of(name, CACHE_METRIC_GROUP, description, tags), supplier
+        )
+
+    gauge("lookahead-launches-total", lambda: float(lookahead.launches),
+          "Manifest prefetch flights launched (one per key in flight)")
+    gauge("lookahead-joins-total", lambda: float(lookahead.joins),
+          "Foreground manifest gets that joined an in-flight prefetch")
+    gauge("lookahead-failures-total", lambda: float(lookahead.failures),
+          "Prefetch flights that failed (dropped; gets retry the loader)")
 
 
 class DiskCacheMetrics:

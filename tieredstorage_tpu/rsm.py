@@ -34,7 +34,11 @@ from tieredstorage_tpu.fetch.chunk_manager import ChunkManager, DefaultChunkMana
 from tieredstorage_tpu.fetch.factory import ChunkManagerFactory
 from tieredstorage_tpu.fetch.enumeration import FetchChunkEnumeration
 from tieredstorage_tpu.fetch.index_cache import MemorySegmentIndexesCache
-from tieredstorage_tpu.fetch.manifest_cache import MemorySegmentManifestCache
+from tieredstorage_tpu.fetch.manifest_cache import (
+    ManifestLookahead,
+    MemorySegmentManifestCache,
+)
+from tieredstorage_tpu.fetch.readahead import ReadaheadManager
 from tieredstorage_tpu.kafka_records import InvalidRecordBatchException, segment_looks_compressed
 from tieredstorage_tpu.manifest.encryption_metadata import SegmentEncryptionMetadataV1
 from tieredstorage_tpu.manifest.segment_indexes import IndexType, SegmentIndexesV1Builder
@@ -147,7 +151,14 @@ class RemoteStorageManager:
         #: Device hot-window tier (`cache.device.bytes`): retained decrypt
         #: windows served without further GCM dispatches.
         self._device_hot = None
+        #: Predictive readahead tier (`readahead.enabled`): sequential
+        #: streams get future windows speculated as background-class work.
+        self._readahead: Optional[ReadaheadManager] = None
         self._manifest_cache: Optional[MemorySegmentManifestCache] = None
+        #: Keyed single-flight manifest prefetch over the manifest cache:
+        #: segment-boundary crossings join an in-flight resolution instead
+        #: of stalling on a cold fetch+parse.
+        self._manifest_lookahead: Optional[ManifestLookahead] = None
         self._indexes_cache: Optional[MemorySegmentIndexesCache] = None
         self._metrics = None
         self._breaker: Optional[CircuitBreaker] = None
@@ -236,6 +247,7 @@ class RemoteStorageManager:
 
         self._manifest_cache = MemorySegmentManifestCache()
         self._manifest_cache.configure(config.fetch_manifest_cache_configs())
+        self._manifest_lookahead = ManifestLookahead(self._manifest_cache)
         self._indexes_cache = MemorySegmentIndexesCache()
         self._indexes_cache.configure(config.fetch_indexes_cache_configs())
         self._register_cache_metrics()
@@ -474,7 +486,7 @@ class RemoteStorageManager:
         manifest_key = ObjectKey(f"{base}.{Suffix.MANIFEST.value}")
         with ensure_deadline(self.default_deadline_s):
             check_deadline("fleet chunk serve")
-            manifest = self._manifest_cache.get(
+            manifest = self._manifest_lookahead.get(
                 manifest_key, lambda: self._fetch_manifest_by_key(manifest_key)
             )
             if last >= manifest.chunk_index.chunk_count:
@@ -620,10 +632,7 @@ class RemoteStorageManager:
                 ),
             ))
         floor = config.slo_cache_hit_floor_percent
-        chunk_cache = (
-            self._chunk_manager
-            if isinstance(self._chunk_manager, ChunkCache) else None
-        )
+        chunk_cache = self._chunk_cache_tier(self._chunk_manager)
         if floor > 0 and chunk_cache is not None:
             stats = chunk_cache.stats
             specs.append(SloSpec(
@@ -633,6 +642,24 @@ class RemoteStorageManager:
                 source=RatioSource(
                     good=lambda: float(stats.hits),
                     total=lambda: float(stats.hits + stats.misses),
+                ),
+            ))
+        if self._readahead is not None:
+            readahead = self._readahead
+            bound = readahead.misprediction_max_ratio
+            specs.append(SloSpec(
+                name="readahead-misprediction",
+                description=(
+                    "speculated decrypt bytes later consumed by the stream "
+                    f"(wasted bytes bounded at {bound:.0%} — "
+                    "readahead.misprediction.max.ratio)"
+                ),
+                objective=1.0 - bound,
+                source=RatioSource(
+                    good=lambda: float(
+                        readahead.bytes_speculated - readahead.wasted_bytes
+                    ),
+                    total=lambda: float(readahead.bytes_speculated),
                 ),
             ))
         self._slo = SloEngine(
@@ -808,11 +835,18 @@ class RemoteStorageManager:
         if inner is not None:
             inner.tracer = self.tracer
             inner.on_fetch = self._metrics.record_chunk_fetch
-        if isinstance(cm, ChunkCache):
-            cm.tracer = self.tracer
-            cm.on_get = self._metrics.record_cache_get
+        cache = self._chunk_cache_tier(cm)
+        if cache is not None:
+            cache.tracer = self.tracer
+            cache.on_get = self._metrics.record_cache_get
+            # Pool-side prefetch loads open synthetic flight records
+            # (attributable background flows on /debug/timeline).
+            cache.flight_recorder = self.flight_recorder
         if self._device_hot is not None:
             self._device_hot.tracer = self.tracer
+        if self._readahead is not None:
+            self._readahead.tracer = self.tracer
+            self._readahead.flight_recorder = self.flight_recorder
 
     def _wrap_storage_resilience(
         self, config: RemoteStorageManagerConfig, storage: StorageBackend
@@ -856,9 +890,7 @@ class RemoteStorageManager:
         return storage
 
     def _register_resilience_metrics(self) -> None:
-        chunk_cache = (
-            self._chunk_manager if isinstance(self._chunk_manager, ChunkCache) else None
-        )
+        chunk_cache = self._chunk_cache_tier(self._chunk_manager)
         register_resilience_metrics(
             self._metrics.registry,
             breaker=self._breaker,
@@ -889,8 +921,8 @@ class RemoteStorageManager:
             size_supplier=lambda: self._indexes_cache.size,
             weight_supplier=lambda: self._indexes_cache.total_weight,
         )
-        chunk_cache = self._chunk_manager
-        if hasattr(chunk_cache, "stats"):
+        chunk_cache = self._chunk_cache_tier(self._chunk_manager)
+        if chunk_cache is not None and hasattr(chunk_cache, "stats"):
             register_cache_metrics(
                 registry, "chunk-cache", chunk_cache.stats,
                 size_supplier=lambda: chunk_cache.size,
@@ -909,6 +941,20 @@ class RemoteStorageManager:
             )
 
             register_hot_cache_metrics(registry, self._device_hot)
+        if self._readahead is not None:
+            from tieredstorage_tpu.metrics.cache_metrics import (
+                register_readahead_metrics,
+            )
+
+            register_readahead_metrics(registry, self._readahead)
+        if self._manifest_lookahead is not None:
+            from tieredstorage_tpu.metrics.cache_metrics import (
+                register_manifest_lookahead_metrics,
+            )
+
+            register_manifest_lookahead_metrics(
+                registry, self._manifest_lookahead
+            )
         batcher = getattr(self._transform_backend, "batcher", None)
         if batcher is not None:
             from tieredstorage_tpu.metrics.batch_metrics import (
@@ -943,7 +989,51 @@ class RemoteStorageManager:
 
         manager = factory.init_chunk_manager(self._storage, backend, wrapper)
         self._device_hot = factory.device_hot_cache
+        self._readahead = factory.readahead_manager
         return manager
+
+    @staticmethod
+    def _chunk_cache_tier(cm) -> Optional[ChunkCache]:
+        """The ChunkCache tier of the fetch chain, seen through the optional
+        readahead wrapper (which sits OUTERMOST so its detector observes
+        cache hits too)."""
+        if isinstance(cm, ReadaheadManager):
+            cm = cm._delegate
+        return cm if isinstance(cm, ChunkCache) else None
+
+    @property
+    def readahead_manager(self) -> Optional[ReadaheadManager]:
+        """The readahead tier (None unless ``readahead.enabled``)."""
+        return self._readahead
+
+    @property
+    def manifest_lookahead(self) -> Optional[ManifestLookahead]:
+        return self._manifest_lookahead
+
+    def set_segment_successor(self, successor) -> None:
+        """Teach the readahead tier segment replay order: ``successor`` maps
+        a segment's ``ObjectKey`` to the NEXT segment's key (or None at the
+        log head). Segment ordering is broker-side knowledge (base offsets),
+        so the embedding harness/broker wires it; the resolved manifest
+        loads ride the keyed single-flight manifest lookahead, so N streams
+        crossing one boundary resolve the next manifest once."""
+        if self._readahead is None:
+            raise RemoteStorageException("readahead is not enabled")
+        lookahead = self._manifest_lookahead
+
+        def resolver(key: ObjectKey):
+            next_key = successor(key)
+            if next_key is None:
+                return None
+            manifest_key = ObjectKey(
+                f"{next_key.value.rsplit('.', 1)[0]}.{Suffix.MANIFEST.value}"
+            )
+            loader = lambda: self._fetch_manifest_by_key(manifest_key)
+            # Start resolving immediately; the returned thunk joins it.
+            lookahead.prefetch(manifest_key, loader)
+            return next_key, lambda: lookahead.get(manifest_key, loader)
+
+        self._readahead.next_segment_resolver = resolver
 
     @staticmethod
     def _innermost_chunk_manager(cm) -> Optional[DefaultChunkManager]:
@@ -1199,7 +1289,10 @@ class RemoteStorageManager:
         # cache's loader pool (the storage GET itself runs on that pool and
         # records its own storage.fetch_manifest root span).
         with self.tracer.span("rsm.fetch_manifest", key=key.value):
-            return self._manifest_cache.get(
+            # Through the lookahead: a boundary crossing whose manifest a
+            # readahead continuation already started resolving JOINS that
+            # flight instead of stalling on a second fetch+parse.
+            return self._manifest_lookahead.get(
                 key, lambda: self._fetch_manifest_by_key(key)
             )
 
@@ -1395,6 +1488,8 @@ class RemoteStorageManager:
             self._chunk_manager.close()
         if self._peer_cache is not None:
             self._peer_cache.close()
+        if self._manifest_lookahead is not None:
+            self._manifest_lookahead.close()
         if self._manifest_cache is not None:
             self._manifest_cache.close()
         if self._indexes_cache is not None:

@@ -84,6 +84,7 @@ from tieredstorage_tpu.transform.scheduler import (
     class_max_age_ms,
     current_work_class,
     flush_priority,
+    is_speculative,
     validate_work_class,
 )
 from tieredstorage_tpu.utils import flightrecorder
@@ -256,6 +257,12 @@ class WindowBatcher:
         self.class_flushed_windows = {cls: 0 for cls in WORK_CLASSES}
         self.class_launches = {cls: 0 for cls in WORK_CLASSES}
         self.class_added_wait_ms = {cls: 0.0 for cls in WORK_CLASSES}
+        #: Speculative-rows ledger: windows/bytes submitted under a
+        #: ``speculative_scope`` (readahead bets). Kept separate from the
+        #: class counters so background occupancy from *prediction* is
+        #: distinguishable from demanded background work (scrub).
+        self.speculative_windows = 0
+        self.speculative_bytes = 0
 
     # --------------------------------------------------------------- lifecycle
     def start(self) -> "WindowBatcher":
@@ -353,6 +360,11 @@ class WindowBatcher:
                 raise BatcherStoppedError("WindowBatcher is stopped")
             self.windows_submitted += 1
             note_mutation("batcher.WindowBatcher.windows_submitted")
+            if is_speculative():
+                self.speculative_windows += 1
+                note_mutation("batcher.WindowBatcher.speculative_windows")
+                self.speculative_bytes += sum(sizes)
+                note_mutation("batcher.WindowBatcher.speculative_bytes")
             # Background work never takes the inline fast path: admission
             # and the starvation watchdog govern every background launch.
             fast = (
@@ -406,6 +418,11 @@ class WindowBatcher:
                 raise BatcherStoppedError("WindowBatcher is stopped")
             self.windows_submitted += 1
             note_mutation("batcher.WindowBatcher.windows_submitted")
+            if is_speculative():
+                self.speculative_windows += 1
+                note_mutation("batcher.WindowBatcher.speculative_windows")
+                self.speculative_bytes += sum(len(c) for c in chunks)
+                note_mutation("batcher.WindowBatcher.speculative_bytes")
             fast = (
                 work_class != BACKGROUND
                 and not self._buckets

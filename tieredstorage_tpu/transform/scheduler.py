@@ -87,6 +87,29 @@ def work_class_scope(work_class: str) -> Iterator[str]:
         _tls.work_class = prev
 
 
+def is_speculative() -> bool:
+    """True when this thread is inside a ``speculative_scope`` — the work
+    it submits is a readahead *bet*, not demanded data. The batcher reads
+    this at submit time to keep a separate speculative-rows ledger, so
+    background occupancy from prediction is attributable in metrics."""
+    return bool(getattr(_tls, "speculative", False))
+
+
+@contextmanager
+def speculative_scope() -> Iterator[None]:
+    """Tag every GCM submit on this thread as speculative (nestable,
+    same save/restore discipline as ``work_class_scope``). Readahead
+    wraps its window loads in ``work_class_scope(BACKGROUND)`` +
+    ``speculative_scope()``: the former decides *when* the device runs
+    the work, the latter only *labels* it for accounting."""
+    prev = is_speculative()
+    _tls.speculative = True
+    try:
+        yield
+    finally:
+        _tls.speculative = prev
+
+
 def class_max_age_ms(
     work_class: str, wait_ms: float, background_max_age_ms: float
 ) -> float:

@@ -204,8 +204,8 @@ class LoadingCache(Generic[K, V]):
 
     # --------------------------------------------------------------- internal
     def _evict_over_weight_locked(self, keep: K) -> list[tuple[K, Any, RemovalCause]]:
-        if self._max_weight is None:
-            return []
+        if self._max_weight is None or self._total_weight <= self._max_weight:
+            return []  # under weight: skip the O(n) key-list materialization
         evicted: list[tuple[K, Any, RemovalCause]] = []
         for key in list(self._entries):
             if self._total_weight <= self._max_weight:
@@ -230,11 +230,21 @@ class LoadingCache(Generic[K, V]):
         if self._expire is None:
             return []
         deadline = self._now() - self._expire
-        stale = [
-            key
-            for key, entry in self._entries.items()
-            if entry.future.done() and entry.last_access < deadline
-        ]
+        # `_entries` is recency-ordered (insertion stamps `last_access`,
+        # every read refreshes it via move_to_end, and nothing else mutates
+        # the stamp), so `last_access` is nondecreasing along the dict:
+        # stop at the first fresh entry instead of scanning the whole
+        # table. Without the early break this scan is O(entries) on EVERY
+        # get — under a cold sequential replay that pre-admits tens of
+        # thousands of chunks (fetch/readahead.py) it was the dominant
+        # per-read cost, serialized under `_lock`. In-flight loads (future
+        # not done) are skipped, not expired, exactly as before.
+        stale = []
+        for key, entry in self._entries.items():
+            if entry.last_access >= deadline:
+                break
+            if entry.future.done():
+                stale.append(key)
         expired = []
         for key in stale:
             entry = self._entries.pop(key)

@@ -82,6 +82,23 @@ ISSUE 17 made the run itself observable as ONE fleet-stitched timeline:
     batcher directly (``drive_exemplar_launch``) — same machinery, no
     RSA key-wrap.
 
+ISSUE 18 added the predictive-readahead proof to the same gate:
+
+11. **Readahead A/B** — a cold massed sequential replay (``RA_CONSUMERS``
+    concurrent consumers, each replaying its own chain of
+    ``RA_SEGMENTS_PER_CONSUMER`` encrypted segments front to back, NO
+    warm pass) runs once with the ``ReadaheadManager`` tier on and once
+    with the identical chain without it. The readahead run must win on
+    BOTH replay p99 and total GCM device launches (speculative
+    ``RA_SPEC_WINDOW``-chunk background windows merge foreground windows
+    into fewer ranged GETs and fewer batched decrypts), hold a cold
+    steady-state hit rate >= ``RA_HIT_RATE_FLOOR``, keep wasted
+    speculative decrypt bytes within ``readahead.misprediction.max.ratio``
+    as judged by the ``readahead-misprediction`` SLO spec's own verdict
+    (the exact RatioSource the rsm wires), continue across every segment
+    boundary, and leave attributable synthetic ``readahead.window``
+    flight records in the ring.
+
 Writes ``artifacts/load_report.json`` (re-read + re-validated) and the
 bench-trajectory point ``BENCH_LOAD_r01.json`` (throughput, p50/p99,
 shed %, failover count, cache-tier hit %, probe occupancy + GiB/s) so
@@ -194,6 +211,51 @@ TIMELINE_FETCHERS = 12
 #: when no SLO breach nominated one (the overload phase leaves slow
 #: UNencrypted records that span instances but carry no launch evidence).
 TIMELINE_CANDIDATES = 128
+
+#: Readahead A/B phase (ISSUE 18): concurrent consumers each replay their
+#: OWN chain of segments front to back — the pure sequential cold-replay
+#: shape the readahead tier exists for — once with the tier on and once
+#: with the identical chain without it. Foreground reads are small
+#: windows; the speculation window is larger so one background launch
+#: merges several foreground windows into one ranged GET + one batched
+#: decrypt.
+#: Sized to the host, not to the fleet: concurrent consumer threads
+#: beyond the core count only inflate every dispatch (GIL + scheduler
+#: thrash) without adding device pressure — the launch-merging and
+#: latency-hiding effects under test are per-stream, not per-thread.
+RA_CONSUMERS = 4
+#: Chains are LONG on purpose: promotion hysteresis makes the first
+#: 3 reads of every chain reactive, and p99 over the whole replay must
+#: measure the steady state, not the warm-up (12 promotion reads out of
+#: 3072 keeps the cold block strictly under the 1% tail).
+RA_SEGMENTS_PER_CONSUMER = 96
+#: Chunks small enough that per-dispatch overhead dominates the decrypt:
+#: that is the regime where merging foreground windows into one
+#: speculative launch actually buys device time (a 16-row window costs
+#: ~2x a 4-row one, not 4x), mirroring the many-small-chunks shape of
+#: index/timestamp fetches.
+RA_CHUNK = 1024
+RA_CHUNKS_PER_SEGMENT = 32
+RA_FG_WINDOW = 4           # chunks per foreground consumer read
+RA_SPEC_WINDOW = 16        # readahead.window.chunks (4x merge factor)
+RA_BUDGET_BYTES = 16 * 1024 * 1024
+RA_HIT_RATE_FLOOR = 0.9
+#: Modeled object-store RTT per ranged GET, identical in both modes: the
+#: reactive chain pays it serially on every cold window read; readahead
+#: overlaps it with serving and amortizes it across merged windows.
+RA_FETCH_LATENCY_S = 0.015
+#: Modeled per-read record apply/deserialize cost, identical in both
+#: modes and OUTSIDE the read-latency timer. This is the slack
+#: speculation hides behind: a consumer that applies records for ~40ms
+#: between window reads gives an in-flight background launch (RTT +
+#: batched decrypt, submitted 4+ reads = ~160ms ahead of first use)
+#: time to land before the stream reaches it, so steady-state reads are
+#: cache hits. The reactive chain pays the full fetch+decrypt serially
+#: on EVERY read no matter how long the consumer spends applying —
+#: overlap, not raw device speed, is the effect under test (a tight-loop
+#: consumer with zero apply time would give prefetch nothing to overlap
+#: and measure only dispatch contention).
+RA_CONSUME_MS = 40.0
 
 
 def segment_payload(i: int) -> bytes:
@@ -909,6 +971,334 @@ def capacity_probe(streams: int) -> dict:
     return probe
 
 
+# ------------------------------------------- readahead A/B phase (ISSUE 18)
+class _LatencyFetcher:
+    """ObjectFetcher over in-memory transformed blobs with a modeled
+    object-store RTT per ranged GET (identical in both A/B modes)."""
+
+    def __init__(self) -> None:
+        self.blobs: dict[str, bytes] = {}
+        self.reads = 0
+        self._lock = threading.Lock()
+
+    def fetch(self, key, r):
+        import io
+
+        with self._lock:
+            self.reads += 1
+        time.sleep(RA_FETCH_LATENCY_S)
+        blob = self.blobs[key.value]
+        return io.BytesIO(blob[r.from_position : r.to_position + 1])
+
+
+def readahead_ab_phase() -> dict:
+    """Cold massed sequential replay, readahead ON vs OFF over identical
+    stores (ISSUE 18 acceptance): RA_CONSUMERS concurrent consumers each
+    replay a chain of RA_SEGMENTS_PER_CONSUMER segments front to back in
+    RA_FG_WINDOW-chunk reads, with NO warm pass. The readahead run must
+    win on BOTH replay p99 and total GCM launches (speculative
+    RA_SPEC_WINDOW-chunk windows merge foreground windows into fewer
+    ranged GETs and fewer batched decrypts), keep the cold steady-state
+    hit rate >= RA_HIT_RATE_FLOOR, keep wasted speculative decrypt bytes
+    within readahead.misprediction.max.ratio, and the
+    readahead-misprediction SLO spec (the exact RatioSource the rsm
+    wires) must verdict ok with real samples. Launch visibility:
+    the flight recorder must retain synthetic ``readahead.window``
+    records from the background launches."""
+    import numpy as np
+
+    from tieredstorage_tpu.fetch.cache.memory import MemoryChunkCache
+    from tieredstorage_tpu.fetch.chunk_manager import DefaultChunkManager
+    from tieredstorage_tpu.fetch.readahead import ReadaheadManager
+    from tieredstorage_tpu.manifest.chunk_index import FixedSizeChunkIndex
+    from tieredstorage_tpu.manifest.encryption_metadata import (
+        SegmentEncryptionMetadataV1,
+    )
+    from tieredstorage_tpu.manifest.segment_indexes import (
+        IndexType,
+        SegmentIndexesV1Builder,
+    )
+    from tieredstorage_tpu.manifest.segment_manifest import SegmentManifestV1
+    from tieredstorage_tpu.metrics.slo import RatioSource, SloEngine, SloSpec
+    from tieredstorage_tpu.ops import gcm as gcm_ops
+    from tieredstorage_tpu.security.aes import AesEncryptionProvider
+    from tieredstorage_tpu.storage.core import ObjectKey
+    from tieredstorage_tpu.transform.api import TransformOptions
+    from tieredstorage_tpu.transform.tpu import TpuTransformBackend
+    from tieredstorage_tpu.utils.flightrecorder import FlightRecorder
+
+    # ---- build the store ONCE (shared by both modes: same bytes, same
+    # keys, same manifests — the only variable is the readahead tier).
+    npr = np.random.default_rng(SEED ^ 0x5EA)
+    build_backend = TpuTransformBackend()
+    index = FixedSizeChunkIndex(
+        original_chunk_size=RA_CHUNK,
+        original_file_size=RA_CHUNK * RA_CHUNKS_PER_SEGMENT,
+        transformed_chunk_size=RA_CHUNK + 28,
+        final_transformed_chunk_size=RA_CHUNK + 28,
+    )
+    index_builder = SegmentIndexesV1Builder()
+    for t in (IndexType.OFFSET, IndexType.TIMESTAMP,
+              IndexType.PRODUCER_SNAPSHOT, IndexType.LEADER_EPOCH):
+        index_builder.add(t, 0)
+    indexes = index_builder.build()
+    blobs: dict[str, bytes] = {}
+    manifests: dict[str, SegmentManifestV1] = {}
+    plaintext: dict[str, list[bytes]] = {}
+    chains: list[list[ObjectKey]] = []
+    for c in range(RA_CONSUMERS):
+        # One encrypted blob per CONSUMER, shared by every segment of its
+        # chain: the fetch chain is keyed by object key end to end, so
+        # byte-uniqueness across a chain's segments buys nothing but
+        # encrypt time at build (chunk-count-proportional — the dominant
+        # phase cost on a small host).
+        raw = npr.integers(
+            0, 256, RA_CHUNK * RA_CHUNKS_PER_SEGMENT, np.uint8
+        ).tobytes()
+        chunks = [
+            raw[i * RA_CHUNK : (i + 1) * RA_CHUNK]
+            for i in range(RA_CHUNKS_PER_SEGMENT)
+        ]
+        dk = AesEncryptionProvider.create_data_key_and_aad()
+        ivs = [
+            np.uint32(c * 100_000 + i + 1).tobytes().ljust(12, b"\x2a")
+            for i in range(RA_CHUNKS_PER_SEGMENT)
+        ]
+        blob = b"".join(build_backend.transform(
+            chunks, TransformOptions(encryption=dk, ivs=ivs)
+        ))
+        manifest = SegmentManifestV1(
+            chunk_index=index, segment_indexes=indexes,
+            compression=False,
+            encryption=SegmentEncryptionMetadataV1(dk.data_key, dk.aad),
+            remote_log_segment_metadata=None,
+        )
+        chain = []
+        for s in range(RA_SEGMENTS_PER_CONSUMER):
+            # Consumer id in the FILE name: the readahead stream key is
+            # the segment file name, so chains must not collide.
+            key = ObjectKey(
+                f"ra/topic-ra/{c}/{c:04d}-{s:020d}-seg.log"
+            )
+            blobs[key.value] = blob
+            manifests[key.value] = manifest
+            plaintext[key.value] = chunks
+            chain.append(key)
+        chains.append(chain)
+    build_backend.close()
+    successor = {
+        chain[i].value: chain[i + 1]
+        for chain in chains for i in range(len(chain) - 1)
+    }
+
+    def run_mode(readahead: bool) -> dict:
+        backend = TpuTransformBackend()
+        # Warm the jit program cache for the two decrypt shapes this
+        # phase launches (foreground and speculative windows) — compile
+        # cost is a deployment concern, same reasoning as the probe.
+        warm_dk = AesEncryptionProvider.create_data_key_and_aad()
+        ctx = gcm_ops.make_context(warm_dk.data_key, warm_dk.aad, RA_CHUNK)
+        for rows in sorted({RA_FG_WINDOW, RA_SPEC_WINDOW}):
+            warm = np.zeros((rows, RA_CHUNK + 16), np.uint8)
+            staged = backend._stage_packed(warm, False)
+            np.asarray(backend._launch_packed(ctx, staged, False, decrypt=True))
+        backend.reset_dispatch_stats()
+
+        fetcher = _LatencyFetcher()
+        fetcher.blobs.update(blobs)
+        cache = MemoryChunkCache(DefaultChunkManager(fetcher, backend))
+        # Roomy cache (never evicts within the phase): readahead
+        # pre-admits verified plaintext through it, and the OFF control
+        # replays every chunk exactly once anyway — cold either way.
+        cache.configure({
+            "size": RA_CHUNK * RA_CHUNKS_PER_SEGMENT
+            * RA_SEGMENTS_PER_CONSUMER * RA_CONSUMERS * 2,
+            "prefetch.max.size": 0,
+        })
+        recorder = FlightRecorder(enabled=True, ring_size=64)
+        tier = cache
+        manager = None
+        engine = None
+        if readahead:
+            manager = ReadaheadManager(
+                cache,
+                window_chunks=RA_SPEC_WINDOW,
+                streams_max=RA_CONSUMERS * RA_SEGMENTS_PER_CONSUMER * 2,
+                budget_bytes=RA_BUDGET_BYTES,
+                # Pool sized to the host, not the stream count: steady
+                # state keeps well under one launch in flight per
+                # consumer (2 windows per RA_CONSUME_MS*8 segment
+                # period), and every EXTRA thread spinning in a device
+                # dispatch multiplies the per-launch floor for all of
+                # them — more slots here make speculation slower, not
+                # faster. One slot per consumer also absorbs the
+                # promotion burst (first in-segment window + first
+                # continuation land together).
+                max_workers=RA_CONSUMERS,
+            )
+            manager.flight_recorder = recorder
+            manager.next_segment_resolver = lambda key: (
+                (successor[key.value],
+                 lambda k=successor[key.value]: manifests[k.value])
+                if key.value in successor else None
+            )
+            tier = manager
+            # The exact SLO spec the rsm wires for the tier
+            # (readahead-misprediction): good bytes ratio objective is
+            # 1 - readahead.misprediction.max.ratio.
+            bound = manager.misprediction_max_ratio
+            engine = SloEngine(
+                [SloSpec(
+                    name="readahead-misprediction",
+                    description=(
+                        "speculated decrypt bytes later consumed by the "
+                        f"stream (wasted bounded at {bound:.0%})"
+                    ),
+                    objective=1.0 - bound,
+                    source=RatioSource(
+                        good=lambda: float(
+                            manager.bytes_speculated - manager.wasted_bytes
+                        ),
+                        total=lambda: float(manager.bytes_speculated),
+                    ),
+                )],
+                short_window_s=1.0,
+                long_window_s=4.0,
+            )
+
+        errors: list = []
+        latencies_ms: list[float] = []
+        started = threading.Barrier(RA_CONSUMERS)
+
+        def consumer(c: int) -> None:
+            try:
+                started.wait(timeout=60)
+            except threading.BrokenBarrierError:
+                pass
+            for si, key in enumerate(chains[c]):
+                manifest = manifests[key.value]
+                chunks = plaintext[key.value]
+                for lo in range(0, RA_CHUNKS_PER_SEGMENT, RA_FG_WINDOW):
+                    ids = list(range(lo, lo + RA_FG_WINDOW))
+                    t0 = time.monotonic()
+                    with recorder.request(
+                        "replay.fetch", trace_id=f"ra-{c}-{si}-{lo}"
+                    ):
+                        got = tier.get_chunks(key, manifest, ids)
+                    latencies_ms.append((time.monotonic() - t0) * 1000.0)
+                    if got != chunks[lo : lo + RA_FG_WINDOW]:
+                        errors.append((c, si, lo))
+                    # Modeled record-apply time between reads (untimed,
+                    # both modes): the overlap window speculation fills.
+                    time.sleep(RA_CONSUME_MS / 1000.0)
+
+        ticking = threading.Event()
+
+        def ticker() -> None:
+            while not ticking.wait(0.25):
+                engine.evaluate()
+
+        tick_thread = None
+        if engine is not None:
+            tick_thread = threading.Thread(target=ticker, daemon=True)
+            tick_thread.start()
+        threads = [
+            threading.Thread(target=consumer, args=(c,), name=f"ra-{c}")
+            for c in range(RA_CONSUMERS)
+        ]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        elapsed_s = time.monotonic() - t0
+        if manager is not None:
+            # Drain in-flight speculation before counting device launches.
+            manager.close()
+        else:
+            cache.close()
+        if tick_thread is not None:
+            ticking.set()
+            tick_thread.join(timeout=10)
+        assert errors == [], f"byte diffs from replay streams {errors[:5]}"
+        stats = backend.dispatch_stats
+        sorted_lat = sorted(latencies_ms)
+        total_reads = (
+            RA_CONSUMERS * RA_SEGMENTS_PER_CONSUMER
+            * (RA_CHUNKS_PER_SEGMENT // RA_FG_WINDOW)
+        )
+        assert len(latencies_ms) == total_reads, len(latencies_ms)
+        mode = {
+            "streams": RA_CONSUMERS,
+            "reads": total_reads,
+            "elapsed_s": round(elapsed_s, 2),
+            "replay_p50_ms": round(percentile(sorted_lat, 0.50), 3),
+            "replay_p99_ms": round(percentile(sorted_lat, 0.99), 3),
+            "gcm_launches": stats.dispatches,
+            "decrypt_windows": stats.windows,
+            "ranged_gets": fetcher.reads,
+        }
+        if manager is not None:
+            ring = recorder.slowest() + recorder.failures()
+            verdicts = engine.evaluate()
+            spec = verdicts["specs"]["readahead-misprediction"]
+            mode.update({
+                "windows_launched": manager.windows_launched,
+                "chunks_speculated": manager.chunks_speculated,
+                "hit_rate": round(manager.hit_rate, 4),
+                "misprediction_ratio": round(manager.misprediction_ratio, 4),
+                "misprediction_max_ratio": manager.misprediction_max_ratio,
+                "wasted_bytes": manager.wasted_bytes,
+                "budget_deferrals": manager.budget_deferrals,
+                "ratio_throttles": manager.ratio_throttles,
+                "cross_segment_continuations": (
+                    manager.cross_segment_continuations
+                ),
+                "mean_pre_admit_age_ms": round(
+                    manager.mean_pre_admit_age_ms, 2
+                ),
+                "slo_ok": verdicts["ok"],
+                "slo_samples": spec["samples"],
+                "slo_compliance": spec["compliance"],
+                "flight_readahead_window_records": sum(
+                    1 for rec in ring if rec.name == "readahead.window"
+                ),
+            })
+        backend.close()
+        return mode
+
+    on = run_mode(readahead=True)
+    off = run_mode(readahead=False)
+    ab = {"readahead_on": on, "readahead_off": off}
+    # ISSUE 18 acceptance gates: readahead must WIN on both latency and
+    # total device launches in the same run over identical stores...
+    assert on["replay_p99_ms"] < off["replay_p99_ms"], (on, off)
+    assert on["gcm_launches"] < off["gcm_launches"], (on, off)
+    assert on["ranged_gets"] < off["ranged_gets"], (on, off)
+    # ...with a cold steady-state hit rate above the floor (NO warm pass
+    # happened: every consumed chunk was speculated before first use)...
+    assert on["windows_launched"] > 0, on
+    assert on["hit_rate"] >= RA_HIT_RATE_FLOOR, on
+    # ...wasted speculative decrypt bytes within the configured bound,
+    # judged by the SLO engine's own verdict over the live ratio...
+    assert on["misprediction_ratio"] <= on["misprediction_max_ratio"], on
+    assert on["slo_ok"], on
+    assert on["slo_samples"] > 0, "readahead SLO judged with no samples"
+    # ...chains continued across every segment boundary, and the
+    # launches are attributable (synthetic readahead.window records).
+    assert on["cross_segment_continuations"] == (
+        RA_CONSUMERS * (RA_SEGMENTS_PER_CONSUMER - 1)
+    ), on
+    assert on["flight_readahead_window_records"] > 0, on
+    ab["p99_speedup"] = round(
+        off["replay_p99_ms"] / max(on["replay_p99_ms"], 1e-9), 2
+    )
+    ab["launch_reduction"] = round(
+        1.0 - on["gcm_launches"] / max(off["gcm_launches"], 1), 4
+    )
+    return ab
+
+
 # ------------------------------------------- fleet-stitched timeline phase
 def assert_disabled_timeline_zero_work() -> bool:
     """``timeline.enabled=false`` must be ZERO work on the flush path (the
@@ -1563,6 +1953,13 @@ def run(out_path: pathlib.Path, bench_path: pathlib.Path) -> int:
         # batcher lock sites also feed the witness verdict below).
         report["capacity_probe"] = capacity_probe(PROBE_STREAMS)
 
+        # -------------------------------------------- readahead A/B (ISSUE 18)
+        # Cold massed sequential replay with the predictive-readahead tier
+        # on vs off over identical stores: on must win BOTH replay p99 and
+        # total GCM launches, with the hit-rate / misprediction / SLO
+        # gates asserted inside the phase.
+        report["readahead_ab"] = readahead_ab_phase()
+
         # ------------------------------------------------- witness verdict
         from tieredstorage_tpu.analysis import races
         from tieredstorage_tpu.utils.locks import witness, witness_enabled
@@ -1633,13 +2030,33 @@ def run(out_path: pathlib.Path, bench_path: pathlib.Path) -> int:
         "probe_scrub_verify_mibs": (
             report["capacity_probe"]["isolation"]["scrub_verify_mibs_during_storm"]
         ),
+        "readahead_on_p99_ms": (
+            report["readahead_ab"]["readahead_on"]["replay_p99_ms"]
+        ),
+        "readahead_off_p99_ms": (
+            report["readahead_ab"]["readahead_off"]["replay_p99_ms"]
+        ),
+        "readahead_on_gcm_launches": (
+            report["readahead_ab"]["readahead_on"]["gcm_launches"]
+        ),
+        "readahead_off_gcm_launches": (
+            report["readahead_ab"]["readahead_off"]["gcm_launches"]
+        ),
+        "readahead_hit_rate": (
+            report["readahead_ab"]["readahead_on"]["hit_rate"]
+        ),
+        "readahead_launch_reduction": (
+            report["readahead_ab"]["launch_reduction"]
+        ),
         "workload": (
             f"{WORKERS} closed-loop workers x {REQUESTS_PER_WORKER} zipf({ZIPF_EXPONENT}) "
             f"fetches + {PRODUCED_SEGMENTS} produces over a 3-instance fleet / "
             f"2-replica store; replica AND instance killed mid-run; then an "
             f"admission-saturating overload burst + recovery, and a "
             f"{PROBE_STREAMS}-stream consumer-replay capacity probe with "
-            f"cross-request GCM batching on vs off"
+            f"cross-request GCM batching on vs off, and a "
+            f"{RA_CONSUMERS}-consumer cold sequential-replay A/B with the "
+            f"predictive readahead tier on vs off"
         ),
         "note": (
             "CPU-fallback trajectory point (BENCH_LOAD r01): gates are the "
@@ -1682,6 +2099,22 @@ def run(out_path: pathlib.Path, bench_path: pathlib.Path) -> int:
     assert probe["batched_with_scrub"]["scrub"]["chunks_verified"] > 0
     assert probe["batched_with_scrub"]["scrub"]["byte_errors"] == 0
     assert probe["batched_with_scrub"]["scrub"]["background_windows_flushed"] > 0
+    ab = parsed["readahead_ab"]
+    assert (
+        ab["readahead_on"]["replay_p99_ms"]
+        < ab["readahead_off"]["replay_p99_ms"]
+    )
+    assert (
+        ab["readahead_on"]["gcm_launches"]
+        < ab["readahead_off"]["gcm_launches"]
+    )
+    assert ab["readahead_on"]["hit_rate"] >= RA_HIT_RATE_FLOOR
+    assert (
+        ab["readahead_on"]["misprediction_ratio"]
+        <= ab["readahead_on"]["misprediction_max_ratio"]
+    )
+    assert ab["readahead_on"]["slo_ok"]
+    assert ab["readahead_on"]["flight_readahead_window_records"] > 0
     scrub_chaos = parsed["scrub_under_chaos"]
     assert all(
         v["chunks_verified_total"] > v["chunks_verified_at_chaos"]

@@ -109,6 +109,12 @@ DEFAULT_TARGETS = [
     # An operator flip silently drops launches, dangles flow arrows, or
     # lets a non-loadable trace claim it was validated.
     ("tieredstorage_tpu/metrics/timeline.py", ["tests/test_timeline.py"]),
+    # ISSUE 18: the readahead tier's detector state machine, budget
+    # admission, and waste accounting are pure host logic; an operator flip
+    # silently stops promoting streams, speculates past the byte budget,
+    # or under-counts wasted decrypt bytes (breaking the misprediction
+    # bound the SLO spec and the load-demo gate both judge against).
+    ("tieredstorage_tpu/fetch/readahead.py", ["tests/test_readahead.py"]),
 ]
 
 _CMP_SWAP = {
