@@ -1,7 +1,7 @@
 # Developer entry points (counterpart of /root/reference/Makefile).
 PYTHON ?= python
 
-.PHONY: test test-e2e chaos bench demo trace-demo scrub-demo tail-demo failover-demo fleet-demo fleet-soak transform-demo multichip-demo hot-demo load-demo docs docker lint analyze mutation clean
+.PHONY: test test-e2e chaos chaos-matrix bench demo trace-demo scrub-demo tail-demo failover-demo fleet-demo fleet-soak transform-demo multichip-demo hot-demo load-demo docs docker lint analyze mutation clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q --ignore=tests/e2e
@@ -19,6 +19,21 @@ test-e2e:
 # session on any recorded violation).
 chaos:
 	TSTPU_LOCK_WITNESS=1 $(PYTHON) -m pytest tests/ -q -m chaos
+
+# Unified failure-policy chaos matrix (ISSUE 19): sweeps every FaultPlane
+# kind (error/latency/partial/flaky; partial on data sites only) across
+# every guarded I/O seam — storage read/write, peer forward, gossip probe,
+# merged GCM device launch — with real component harnesses, and gates each
+# cell on the policy invariants: zero byte corruption (torn reads surface
+# as clean refusals, never wrong bytes), retry amplification within the
+# policy cap per the process ledger, breakers opening under sustained
+# faults + fast-failing while open + re-closing behind the heal (fake-clock
+# drill plus the live peer/gossip boards), deadline-scoped ops returning
+# within a hard wall bound (shed, not hang), and per-cell SLO verdicts ok
+# with real samples after recovery traffic refills the burned budget.
+# Deterministic for a given --seed; writes + re-validates the report.
+chaos-matrix:
+	$(PYTHON) tools/chaos_matrix.py --out artifacts/chaos_matrix_report.json
 
 bench:
 	$(PYTHON) bench.py
@@ -200,7 +215,7 @@ lint: analyze
 # /root/reference/build.gradle:24): flips operators in core pure-logic
 # modules and requires the owning suites to notice.
 mutation:
-	$(PYTHON) tools/mutation_test.py --budget 150
+	$(PYTHON) tools/mutation_test.py --budget 170
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} +

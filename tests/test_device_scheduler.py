@@ -712,3 +712,102 @@ class TestConfigWiring:
         assert value("batch-class-background-added-wait-ms-total") >= 0.0
         assert value("batch-class-latency-flushed-windows-total") == 0.0
         backend.close()
+
+
+class TestLaunchRetry:
+    """Unified failure policy (ISSUE 19): the merged flush launches through
+    the shared retry driver at the ``device.launch`` seam — a transient
+    device fault is absorbed by the bounded re-dispatch (each attempt
+    re-stages from the host-side packed buffer, so retries are
+    replay-safe), and waiters fail only after the configured cap."""
+
+    def test_transient_stage_fault_absorbed_by_retry(self):
+        from tieredstorage_tpu.storage.core import StorageBackendException
+
+        backend = TpuTransformBackend()
+        batcher = WindowBatcher(
+            backend, wait_ms=50, launch_attempts=2, launch_backoff_s=0.0
+        )
+        release = park_fast_path(batcher)
+        plain, wire = make_window(130, [640])
+        job = scoped_submit(batcher, wire, None)
+        wait_queued(batcher, 1)
+        real_stage = backend._stage_packed
+        boom = [1]
+
+        def flaky_stage(packed, varlen):
+            if boom[0]:
+                boom[0] -= 1
+                raise StorageBackendException("transient device hiccup")
+            return real_stage(packed, varlen)
+
+        backend._stage_packed = flaky_stage
+        try:
+            assert batcher.flush_now() == 1
+        finally:
+            backend._stage_packed = real_stage
+        release()
+        job[0].join(timeout=30)
+        assert job[1][1] is None and job[1][0] == plain
+        assert batcher.launch_retries == 1
+        assert batcher.launch_failures == 0
+        assert batcher.launches == 1
+        backend.close()
+
+    def test_fault_plane_flaky_launch_recovers(self):
+        """The ``device.launch`` injection point drives the same retry:
+        a flaky=1 rule errors the first launch attempt, the re-dispatch
+        lands, and the waiter still gets its exact plaintext."""
+        from tieredstorage_tpu.utils import faults
+
+        backend = TpuTransformBackend()
+        batcher = WindowBatcher(
+            backend, wait_ms=50, launch_attempts=2, launch_backoff_s=0.0
+        )
+        release = park_fast_path(batcher)
+        plain, wire = make_window(131, [512, 300])
+        job = scoped_submit(batcher, wire, None)
+        wait_queued(batcher, 1)
+        plane = faults.FaultPlane.parse("device.launch:flaky=1")
+        prior = faults.install(plane)
+        try:
+            assert batcher.flush_now() == 1
+        finally:
+            faults.install(prior)
+        release()
+        job[0].join(timeout=30)
+        assert job[1][1] is None and job[1][0] == plain
+        assert batcher.launch_retries == 1
+        assert batcher.launch_failures == 0
+        assert plane.snapshot()["fired"] == {"device.launch:flaky": 1}
+        backend.close()
+
+    def test_waiters_fail_after_retry_cap_then_recover_on_heal(self):
+        from tieredstorage_tpu.utils import faults
+        from tieredstorage_tpu.utils.faults import FaultInjectedError
+
+        backend = TpuTransformBackend()
+        batcher = WindowBatcher(
+            backend, wait_ms=50, launch_attempts=2, launch_backoff_s=0.0
+        )
+        release = park_fast_path(batcher)
+        plain, wire = make_window(132, [640])
+        job = scoped_submit(batcher, wire, None)
+        wait_queued(batcher, 1)
+        prior = faults.install(faults.FaultPlane.parse("device.launch:error"))
+        try:
+            assert batcher.flush_now() == 1  # the flush ran; its launch died
+        finally:
+            faults.install(prior)
+        job[0].join(timeout=30)
+        assert isinstance(job[1][1], FaultInjectedError)
+        assert batcher.launch_retries == 1  # the cap allowed ONE re-dispatch
+        assert batcher.launch_failures == 1
+        # Healed device: a fresh submit round-trips cleanly.
+        job2 = scoped_submit(batcher, wire, None)
+        wait_queued(batcher, 1)
+        assert batcher.flush_now() == 1
+        release()
+        job2[0].join(timeout=30)
+        assert job2[1][1] is None and job2[1][0] == plain
+        backend.close()

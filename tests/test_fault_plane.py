@@ -1,0 +1,285 @@
+"""Unified failure-policy plane, half 2 (ISSUE 19): the FaultPlane.
+
+Pins utils/faults.py: the rule grammar (site:kind[=arg][@trigger][~match])
+parse/reject matrix, per-site call-counter triggers, seeded-RNG determinism
+(same seed + call sequence → identical injection schedule), the
+flaky-then-heal window, latency via an injected sleeper, partial payload
+truncation through :func:`mutate`, key matching, install/restore semantics,
+``TSTPU_FAULTS`` env arming, and the disabled zero-work contract (the
+module-level ``fire`` is one None check — proven with a poisoned-lock
+plane that is installed, exercised, then uninstalled).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tieredstorage_tpu.storage.core import StorageBackendException
+from tieredstorage_tpu.utils import faults
+from tieredstorage_tpu.utils.faults import (
+    DATA_SITES,
+    ENV_FLAG,
+    SEED_ENV,
+    SITES,
+    FaultInjectedError,
+    FaultPlane,
+    FaultPoint,
+)
+
+
+@pytest.fixture(autouse=True)
+def _pristine_plane():
+    """Every test starts and ends with NO plane installed."""
+    prior = faults.install(None)
+    yield
+    faults.install(prior)
+
+
+class TestRuleGrammar:
+    def test_minimal_rule(self):
+        rule = FaultPoint.parse("storage.read:error")
+        assert rule.site == "storage.read" and rule.kind == "error"
+        assert rule.arg is None and rule.match is None
+
+    def test_full_rule_round_trips_through_spec(self):
+        for text in [
+            "storage.read:error",
+            "storage.write:latency=25",
+            "storage.read:latency=10..250",
+            "peer.forward:partial=7@3",
+            "gossip.probe:error@every=2",
+            "device.launch:flaky=4@from=2",
+            "storage.read:error@p=0.5",
+            "peer.forward:error~owner-b",
+            "*:latency=5",
+        ]:
+            assert FaultPoint.parse(text).spec() == text
+
+    def test_whitespace_tolerated(self):
+        rule = FaultPoint.parse("  storage.read : latency = 10..20 @ every=3 ")
+        assert rule.arg == 10 and rule.arg_hi == 20 and rule.every == 3
+
+    @pytest.mark.parametrize("bad", [
+        "bogus.site:error",          # unknown site
+        "storage.read:explode",      # unknown kind
+        "gossip.probe:partial",      # partial on a non-data site
+        "device.launch:partial=4",
+        "storage.read:error@wat=1",  # unknown trigger
+        "storage.read:error@0",      # nth must be >= 1
+        "storage.read:error@every=0",
+        "storage.read:error@from=0",
+        "storage.read:error@p=1.5",  # probability out of [0, 1]
+        "storage.read:error=1..5",   # range arg on a non-latency kind
+        "storage.read:latency=20..10",  # hi < lo
+        "not a rule at all",
+        "",
+    ])
+    def test_rejects(self, bad):
+        with pytest.raises(ValueError):
+            FaultPoint.parse(bad)
+
+    def test_partial_allowed_on_every_data_site_and_wildcard(self):
+        for site in DATA_SITES + ("*",):
+            assert FaultPoint.parse(f"{site}:partial=3").kind == "partial"
+
+    def test_plane_parse_splits_on_semicolons_and_commas(self):
+        plane = FaultPlane.parse(
+            "storage.read:error@2; peer.forward:latency=5, gossip.probe:error"
+        )
+        assert [r.site for r in plane.rules] == [
+            "storage.read", "peer.forward", "gossip.probe",
+        ]
+
+    def test_plane_parse_accepts_none_sequence_and_empty(self):
+        assert FaultPlane.parse(None).rules == []
+        assert FaultPlane.parse("").rules == []
+        plane = FaultPlane.parse(["storage.read:error", "storage.write:error"])
+        assert len(plane.rules) == 2
+
+
+class TestTriggers:
+    def fires_at(self, spec, calls=8, site="storage.read", seed=0):
+        plane = FaultPlane.parse(spec, seed=seed, sleeper=lambda s: None)
+        fired = []
+        for n in range(1, calls + 1):
+            try:
+                plane.fire(site, f"key-{n}")
+            except FaultInjectedError:
+                fired.append(n)
+        return fired, plane
+
+    def test_nth_fires_exactly_once(self):
+        fired, _ = self.fires_at("storage.read:error@3")
+        assert fired == [3]
+
+    def test_every_fires_on_multiples(self):
+        fired, _ = self.fires_at("storage.read:error@every=3", calls=9)
+        assert fired == [3, 6, 9]
+
+    def test_from_fires_from_nth_onwards(self):
+        fired, _ = self.fires_at("storage.read:error@from=5")
+        assert fired == [5, 6, 7, 8]
+
+    def test_call_counters_are_per_site(self):
+        plane = FaultPlane.parse("storage.read:error@2")
+        plane.fire("storage.write", "k")  # does not advance storage.read
+        plane.fire("storage.read", "k")
+        with pytest.raises(FaultInjectedError):
+            plane.fire("storage.read", "k")
+        assert plane.calls("storage.read") == 2
+        assert plane.calls("storage.write") == 1
+
+    def test_probability_schedule_is_seed_deterministic(self):
+        a, _ = self.fires_at("storage.read:error@p=0.4", calls=60, seed=7)
+        b, _ = self.fires_at("storage.read:error@p=0.4", calls=60, seed=7)
+        assert a == b and 0 < len(a) < 60
+
+    def test_flaky_errors_then_heals(self):
+        fired, plane = self.fires_at("storage.read:flaky=3", calls=8)
+        assert fired == [1, 2, 3]
+        assert plane.snapshot()["fired"] == {"storage.read:flaky": 3}
+
+    def test_flaky_default_window_is_ten(self):
+        fired, _ = self.fires_at("storage.read:flaky", calls=12)
+        assert fired == list(range(1, 11))
+
+    def test_explicit_trigger_gates_the_flaky_window(self):
+        fired, _ = self.fires_at("storage.read:flaky=6@every=2", calls=10)
+        assert fired == [2, 4, 6]  # even calls only, and none past the heal
+
+
+class TestKindsAndMatching:
+    def test_error_is_a_storage_backend_exception_with_context(self):
+        plane = FaultPlane.parse("peer.forward:error")
+        with pytest.raises(FaultInjectedError) as err:
+            plane.fire("peer.forward", "http://owner-b")
+        assert isinstance(err.value, StorageBackendException)
+        assert err.value.site == "peer.forward"
+        assert err.value.key == "http://owner-b"
+        assert err.value.rule == "peer.forward:error"
+
+    def test_latency_sleeps_outside_the_lock_via_injected_sleeper(self):
+        slept: list[float] = []
+        plane = FaultPlane.parse(
+            "storage.read:latency=40", sleeper=slept.append
+        )
+        plane.fire("storage.read", "k")
+        assert slept == [pytest.approx(0.040)]
+
+    def test_latency_default_is_ten_ms(self):
+        slept: list[float] = []
+        plane = FaultPlane.parse("storage.read:latency", sleeper=slept.append)
+        plane.fire("storage.read", "k")
+        assert slept == [pytest.approx(0.010)]
+
+    def test_latency_range_draws_within_bounds_deterministically(self):
+        def draws(seed):
+            slept: list[float] = []
+            plane = FaultPlane.parse(
+                "storage.read:latency=10..250", seed=seed,
+                sleeper=slept.append,
+            )
+            for _ in range(20):
+                plane.fire("storage.read", "k")
+            return slept
+
+        first = draws(3)
+        assert all(0.010 <= s <= 0.250 for s in first)
+        assert first == draws(3)
+        assert len(set(first)) > 1  # actually drawing, not a constant
+
+    def test_partial_returns_data_rules_and_mutate_truncates(self):
+        plane = FaultPlane.parse("storage.read:partial=3")
+        rules = plane.fire("storage.read", "k")
+        assert len(rules) == 1
+        assert FaultPlane.mutate(b"abcdef", rules) == b"abc"
+
+    def test_partial_default_keeps_half(self):
+        plane = FaultPlane.parse("peer.forward:partial")
+        rules = plane.fire("peer.forward", "k")
+        assert FaultPlane.mutate(b"abcdef", rules) == b"abc"
+
+    def test_partial_never_grows_the_payload(self):
+        plane = FaultPlane.parse("storage.read:partial=99")
+        rules = plane.fire("storage.read", "k")
+        assert FaultPlane.mutate(b"abc", rules) == b"abc"
+
+    def test_match_gates_on_key_substring(self):
+        plane = FaultPlane.parse("storage.read:error~segment-7")
+        plane.fire("storage.read", "chaos/segment-3.log")  # no match: clean
+        with pytest.raises(FaultInjectedError):
+            plane.fire("storage.read", "chaos/segment-7.log")
+
+    def test_wildcard_site_fires_everywhere(self):
+        plane = FaultPlane.parse("*:error")
+        for site in SITES:
+            with pytest.raises(FaultInjectedError):
+                plane.fire(site, "k")
+
+    def test_snapshot_shape(self):
+        plane = FaultPlane.parse("storage.read:error@1")
+        with pytest.raises(FaultInjectedError):
+            plane.fire("storage.read", "k1")
+        plane.fire("storage.read", "k2")
+        snap = plane.snapshot()
+        assert snap["rules"] == ["storage.read:error@1"]
+        assert snap["calls"] == {"storage.read": 2}
+        assert snap["injections"] == 1
+        assert snap["fired"] == {"storage.read:error": 1}
+        assert plane.injections == [("storage.read", "error", "k1")]
+
+
+class _PoisonLock:
+    def __enter__(self):
+        raise AssertionError("disabled fault plane acquired a lock")
+
+    def __exit__(self, *exc):  # pragma: no cover — never entered
+        return False
+
+
+class TestModuleSeamAndArming:
+    def test_install_returns_prior_and_fire_delegates(self):
+        plane = FaultPlane.parse("storage.read:error")
+        assert faults.install(plane) is None
+        assert faults.enabled()
+        assert faults.plane() is plane
+        with pytest.raises(FaultInjectedError):
+            faults.fire("storage.read", "k")
+        assert faults.install(None) is plane
+        assert not faults.enabled()
+
+    def test_disabled_fire_is_zero_work(self):
+        """The LockWitness pattern (test_timeline.py): a plane whose lock
+        is poisoned proves the seam DOES go through the lock while
+        installed — and touches nothing at all once uninstalled."""
+        plane = FaultPlane.parse("storage.read:error")
+        plane._lock = _PoisonLock()
+        faults.install(plane)
+        with pytest.raises(AssertionError):
+            faults.fire("storage.read", "k")
+        faults.install(None)
+        assert faults.fire("storage.read", "k") is None  # one None check
+        assert faults.mutate(b"abc", None) == b"abc"
+        assert faults.mutate(b"abc", []) == b"abc"
+
+    def test_env_arming(self, monkeypatch):
+        monkeypatch.setenv(ENV_FLAG, "storage.read:error@2; gossip.probe:latency=1")
+        monkeypatch.setenv(SEED_ENV, "77")
+        faults._arm_from_env()
+        plane = faults.plane()
+        assert plane is not None
+        assert [r.spec() for r in plane.rules] == [
+            "storage.read:error@2", "gossip.probe:latency=1",
+        ]
+
+    @pytest.mark.parametrize("off", ["", "0", "false", "no"])
+    def test_env_off_values_do_not_arm(self, monkeypatch, off):
+        monkeypatch.setenv(ENV_FLAG, off)
+        faults._arm_from_env()
+        assert faults.plane() is None
+
+    def test_env_bad_seed_falls_back_to_zero(self, monkeypatch):
+        monkeypatch.setenv(ENV_FLAG, "storage.read:error")
+        monkeypatch.setenv(SEED_ENV, "not-a-number")
+        faults._arm_from_env()
+        assert faults.plane() is not None

@@ -555,3 +555,103 @@ class TestGatewayGossipRoutes:
             body=json.dumps({"from": "x"}).encode(),
         )
         assert status == 400
+
+
+class TestProbeRetryAndBreakers:
+    """Unified failure policy (ISSUE 19): the probe round trip rides the
+    shared retry driver (in-round retries with instance-seeded jitter),
+    and each member gets a per-target breaker that DEPRIORITIZES refusing
+    targets in probe selection — never silences them."""
+
+    def make_agents(self, fail, *, names=("a", "b"), probe_retries=1,
+                    breaker_threshold=2):
+        """Agents joined by an in-process transport whose attempt counter
+        feeds `fail(attempt_no) -> bool`; fake clock, no-op sleeper."""
+        clock = [0.0]
+        seeds = {n: f"http://{n}" for n in names}
+        agents = {}
+        attempts = [0]
+
+        def transport_for(src):
+            def transport(url, payload):
+                dst = url.split("//")[1]
+                attempts[0] += 1
+                if fail(attempts[0]):
+                    raise ConnectionRefusedError(f"{src}->{dst} dropped")
+                return agents[dst].on_gossip(payload)
+
+            return transport
+
+        for name in names:
+            router = FleetRouter(name, vnodes=8)
+            router.set_membership(seeds)
+            agents[name] = GossipAgent(
+                router,
+                interval_s=1.0,
+                suspect_periods=3,
+                dead_periods=60,
+                probe_retries=probe_retries,
+                breaker_threshold=breaker_threshold,
+                transport=transport_for(name),
+                time_source=lambda: clock[0],
+                sleeper=lambda s: None,
+            )
+        return clock, agents, attempts
+
+    def test_flaky_round_trip_recovers_on_in_round_retry(self):
+        """One dropped attempt that recovers on retry is a SUCCESS: no
+        probe failure, no breaker evidence, the ack lands."""
+        clock, agents, attempts = self.make_agents(lambda n: n == 1)
+        a = agents["a"]
+        clock[0] += 1.0
+        a.run_period()
+        assert attempts[0] == 2
+        assert a.retried_probes == 1
+        assert a.acks == 1 and a.probe_failures == 0
+        assert a.breakers.for_target("b").state.name == "CLOSED"
+        assert a.breakers.opened == 0
+
+    def test_breaker_accounts_per_round_and_opens_on_threshold(self):
+        """Every attempt of a round fails -> ONE breaker failure (the
+        round, not each attempt); `breaker_threshold` failed rounds open
+        the target's breaker."""
+        clock, agents, attempts = self.make_agents(lambda n: True)
+        a = agents["a"]
+        for _ in range(2):
+            clock[0] += 1.0
+            a.run_period()
+        assert a.probe_failures == 2
+        assert a.retried_probes == 2  # one in-round retry per failed round
+        assert attempts[0] == 4
+        assert a.breakers.opened == 1
+        assert a.breakers.for_target("b").refusing
+
+    def test_refusing_sole_candidate_is_still_probed(self):
+        """Breakers must never blind the failure detector: when EVERY
+        candidate is refusing, selection falls back to round-robin and the
+        probe still goes out (counted as a skip, not a silence)."""
+        clock, agents, attempts = self.make_agents(lambda n: True)
+        a = agents["a"]
+        for _ in range(3):
+            clock[0] += 1.0
+            a.run_period()
+        assert a.breakers.for_target("b").refusing
+        assert a.probes_sent == 3  # the open breaker never stopped a probe
+        assert a.probe_skips >= 1
+
+    def test_refusing_member_deprioritized_until_cooldown(self):
+        clock, agents, _ = self.make_agents(lambda n: False,
+                                            names=("a", "b", "c"))
+        a = agents["a"]
+        breaker = a.breakers.for_target("b")
+        breaker.on_failure()
+        breaker.on_failure()  # threshold 2: b is refusing
+        with a._lock:
+            picked = {a._next_probe_target_locked().name for _ in range(4)}
+        assert picked == {"c"}
+        assert a.probe_skips >= 1
+        # Cooldown (suspect_after_s) elapses: b is selectable again.
+        clock[0] += 3.0
+        with a._lock:
+            picked = {a._next_probe_target_locked().name for _ in range(4)}
+        assert "b" in picked

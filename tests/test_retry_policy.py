@@ -1,0 +1,471 @@
+"""Unified failure-policy plane, half 1 (ISSUE 19): typed retry + breakers.
+
+Pins the shared driver every I/O seam now rides (utils/retry.py): policy
+validation, classification precedence (fast-fail > healthy > neutral >
+terminal > retryable), decorrelated-jitter backoff bounds, deadline
+truncation (a doomed request sheds instead of sleeping), breaker accounting
+per outcome, the closed → open → half-open single-probe state machine on a
+fake clock, per-target BreakerBoard isolation, and the process RetryLedger
+the ``retry-metrics`` group exports. Everything runs on injected clocks,
+RNGs and sleepers — zero wall-clock sensitivity, zero optional deps.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from tieredstorage_tpu.utils.deadline import (
+    Deadline,
+    DeadlineExceededException,
+    deadline_scope,
+)
+from tieredstorage_tpu.utils.retry import (
+    BreakerBoard,
+    BreakerState,
+    CircuitBreaker,
+    CircuitOpenException,
+    Outcome,
+    RetryLedger,
+    RetryPolicy,
+    call_with_retry,
+)
+
+#: Classification fixtures: one policy with every bucket populated.
+FULL = RetryPolicy(
+    max_attempts=3,
+    base_backoff_s=0.001,
+    max_backoff_s=0.002,
+    retryable=(Exception,),
+    terminal=(ValueError,),
+    healthy=(KeyError,),
+    neutral=(TypeError,),
+)
+
+
+def _no_sleep(_s: float) -> None:
+    raise AssertionError("call_with_retry slept when it must not")
+
+
+class _RecordingBreaker:
+    """Duck-typed breaker recording which accounting hook each outcome hit."""
+
+    def __init__(self) -> None:
+        self.events: list[str] = []
+
+    def acquire(self) -> None:
+        self.events.append("acquire")
+
+    def on_success(self) -> None:
+        self.events.append("success")
+
+    def on_failure(self) -> None:
+        self.events.append("failure")
+
+    def on_neutral(self) -> None:
+        self.events.append("neutral")
+
+
+class TestRetryPolicyValidation:
+    def test_rejects_bad_knobs(self):
+        with pytest.raises(ValueError):
+            RetryPolicy(max_attempts=0)
+        with pytest.raises(ValueError):
+            RetryPolicy(base_backoff_s=-0.1)
+        with pytest.raises(ValueError):
+            RetryPolicy(base_backoff_s=1.0, max_backoff_s=0.5)
+
+    def test_single_disables_retries_only(self):
+        single = FULL.single()
+        assert single.max_attempts == 1
+        assert single.retryable == FULL.retryable
+        assert single.terminal == FULL.terminal
+        assert single.base_backoff_s == FULL.base_backoff_s
+        # Frozen: the original is untouched.
+        assert FULL.max_attempts == 3
+
+
+class TestClassificationPrecedence:
+    def test_each_bucket(self):
+        assert FULL.classify(KeyError("404")) is Outcome.HEALTHY
+        assert FULL.classify(TypeError("noise")) is Outcome.NEUTRAL
+        assert FULL.classify(ValueError("indicted")) is Outcome.TERMINAL
+        assert FULL.classify(RuntimeError("flap")) is Outcome.RETRYABLE
+
+    def test_fast_fail_beats_every_listed_bucket(self):
+        # CircuitOpenException IS a StorageBackendException (⊂ Exception,
+        # FULL's retryable), yet a nested breaker refusal must never be
+        # retried or double-accounted.
+        assert FULL.classify(CircuitOpenException("open")) is Outcome.FAST_FAIL
+
+    def test_deadline_is_always_neutral(self):
+        # Caller impatience neither proves nor indicts the target, even
+        # when the policy lists Exception as retryable.
+        exc = DeadlineExceededException("budget burned")
+        assert FULL.classify(exc) is Outcome.NEUTRAL
+
+    def test_non_exception_base_exceptions_are_hands_off(self):
+        assert FULL.classify(KeyboardInterrupt()) is Outcome.NEUTRAL
+
+    def test_unlisted_exception_is_terminal(self):
+        narrow = RetryPolicy(retryable=(ConnectionError,))
+        assert narrow.classify(RuntimeError("unknown")) is Outcome.TERMINAL
+
+    def test_terminal_beats_retryable(self):
+        both = RetryPolicy(retryable=(Exception,), terminal=(ValueError,))
+        assert both.classify(ValueError("listed twice")) is Outcome.TERMINAL
+
+
+class TestDecorrelatedJitterBackoff:
+    def test_first_delay_in_base_to_3x_base(self):
+        policy = RetryPolicy(base_backoff_s=0.1, max_backoff_s=10.0)
+        rng = random.Random(7)
+        for _ in range(200):
+            d = policy.backoff_s(None, rng)
+            assert 0.1 <= d <= 0.3
+
+    def test_next_delay_bounded_by_3x_prev_and_cap(self):
+        policy = RetryPolicy(base_backoff_s=0.1, max_backoff_s=1.0)
+        rng = random.Random(7)
+        for _ in range(200):
+            d = policy.backoff_s(0.5, rng)
+            assert 0.1 <= d <= 1.0  # uniform(0.1, 1.5) clamped by the cap
+
+    def test_seeded_rng_reproduces_the_schedule(self):
+        policy = RetryPolicy(base_backoff_s=0.01, max_backoff_s=2.0)
+        a = [policy.backoff_s(0.05, random.Random(42)) for _ in range(5)]
+        b = [policy.backoff_s(0.05, random.Random(42)) for _ in range(5)]
+        assert a == b
+
+
+class TestCallWithRetry:
+    def drive(self, fn, *, policy=None, breaker=None, retry_gate=None,
+              sleep=None):
+        """Run the driver with a PRIVATE ledger + seeded rng, return
+        (result_or_exc, ledger, slept)."""
+        led = RetryLedger()
+        slept: list[float] = []
+        try:
+            result = call_with_retry(
+                fn,
+                policy=policy if policy is not None else FULL,
+                site="test.seam",
+                breaker=breaker,
+                retry_gate=retry_gate,
+                rng=random.Random(99),
+                sleep=sleep if sleep is not None else slept.append,
+                ledger=led,
+            )
+        except BaseException as exc:  # noqa: BLE001 — asserted by tests
+            return exc, led, slept
+        return result, led, slept
+
+    def test_first_try_success_is_one_attempt(self):
+        result, led, slept = self.drive(lambda: "ok")
+        assert result == "ok"
+        assert led.value("test.seam", "attempts") == 1.0
+        assert led.value("test.seam", "retries") == 0.0
+        assert led.amplification("test.seam") == 1.0
+        assert slept == []
+
+    def test_retryable_then_success_backs_off_once(self):
+        calls = [0]
+
+        def flap():
+            calls[0] += 1
+            if calls[0] == 1:
+                raise RuntimeError("transient")
+            return "recovered"
+
+        result, led, slept = self.drive(flap)
+        assert result == "recovered"
+        assert led.value("test.seam", "attempts") == 2.0
+        assert led.value("test.seam", "retries") == 1.0
+        assert led.value("test.seam", "giveups") == 0.0
+        assert len(slept) == 1 and slept[0] > 0.0
+        assert led.value("test.seam", "backoff_ms") == pytest.approx(
+            slept[0] * 1000.0
+        )
+        assert led.amplification("test.seam") == 2.0
+
+    def test_cap_exhaustion_reraises_and_notes_giveup(self):
+        exc, led, slept = self.drive(
+            lambda: (_ for _ in ()).throw(RuntimeError("always"))
+        )
+        assert isinstance(exc, RuntimeError)
+        assert led.value("test.seam", "attempts") == FULL.max_attempts
+        assert led.value("test.seam", "retries") == FULL.max_attempts - 1
+        assert led.value("test.seam", "giveups") == 1.0
+
+    def test_terminal_never_retries(self):
+        exc, led, slept = self.drive(
+            lambda: (_ for _ in ()).throw(ValueError("indicted")), sleep=_no_sleep
+        )
+        assert isinstance(exc, ValueError)
+        assert led.value("test.seam", "attempts") == 1.0
+        assert led.value("test.seam", "giveups") == 0.0
+
+    def test_retry_gate_denial_gives_up_without_sleeping(self):
+        exc, led, slept = self.drive(
+            lambda: (_ for _ in ()).throw(RuntimeError("flap")),
+            retry_gate=lambda: False,
+            sleep=_no_sleep,
+        )
+        assert isinstance(exc, RuntimeError)
+        assert led.value("test.seam", "attempts") == 1.0
+        assert led.value("test.seam", "giveups") == 1.0
+
+    def test_deadline_truncation_sheds_instead_of_sleeping(self):
+        """An attempt is never scheduled past the ambient deadline: when
+        the next backoff cannot fit the remaining budget the ORIGINAL
+        error re-raises immediately (no sleep into certain doom)."""
+        policy = RetryPolicy(
+            max_attempts=5, base_backoff_s=5.0, max_backoff_s=5.0,
+            retryable=(RuntimeError,),
+        )
+        with deadline_scope(Deadline.after(0.05)):
+            exc, led, slept = self.drive(
+                lambda: (_ for _ in ()).throw(RuntimeError("doomed")),
+                policy=policy,
+                sleep=_no_sleep,
+            )
+        assert isinstance(exc, RuntimeError)
+        assert led.value("test.seam", "attempts") == 1.0
+        assert led.value("test.seam", "giveups") == 1.0
+
+    def test_breaker_accounting_per_outcome(self):
+        for exc, expected in [
+            (KeyError("404"), "success"),
+            (TypeError("noise"), "neutral"),
+            (CircuitOpenException("nested refusal"), "neutral"),
+            (ValueError("indicted"), "failure"),
+        ]:
+            breaker = _RecordingBreaker()
+            got, _, _ = self.drive(
+                lambda e=exc: (_ for _ in ()).throw(e),
+                breaker=breaker, sleep=_no_sleep,
+            )
+            assert got is exc
+            assert breaker.events == ["acquire", expected]
+
+    def test_success_reports_to_the_breaker(self):
+        breaker = _RecordingBreaker()
+        result, _, _ = self.drive(lambda: 42, breaker=breaker)
+        assert result == 42
+        assert breaker.events == ["acquire", "success"]
+
+    def test_retry_loop_cannot_outrun_an_opening_breaker(self):
+        """Each retry re-takes the breaker gate: the breaker opens on the
+        threshold failure and the NEXT attempt fast-fails, even though the
+        attempt cap had room left."""
+        clock = [0.0]
+        breaker = CircuitBreaker(
+            failure_threshold=2, cooldown_s=30.0, time_source=lambda: clock[0]
+        )
+        policy = RetryPolicy(
+            max_attempts=5, base_backoff_s=0.0, max_backoff_s=0.0,
+            retryable=(RuntimeError,),
+        )
+        calls = [0]
+
+        def always_fail():
+            calls[0] += 1
+            raise RuntimeError("storm")
+
+        exc, led, _ = self.drive(always_fail, policy=policy, breaker=breaker)
+        assert isinstance(exc, CircuitOpenException)
+        assert calls[0] == 2  # third attempt never reached the target
+        assert breaker.state is BreakerState.OPEN
+        assert breaker.fast_fails == 1
+
+    def test_on_retry_observer_sees_attempt_delay_and_error(self):
+        seen = []
+        calls = [0]
+
+        def flap():
+            calls[0] += 1
+            if calls[0] < 3:
+                raise RuntimeError(f"flap {calls[0]}")
+            return "done"
+
+        led = RetryLedger()
+        result = call_with_retry(
+            flap, policy=FULL, site="test.seam",
+            on_retry=lambda a, d, e: seen.append((a, d, str(e))),
+            rng=random.Random(1), sleep=lambda s: None, ledger=led,
+        )
+        assert result == "done"
+        assert [s[0] for s in seen] == [1, 2]
+        assert all(d > 0.0 for _, d, _ in seen)
+        assert [s[2] for s in seen] == ["flap 1", "flap 2"]
+
+
+class TestCircuitBreaker:
+    def make(self, threshold=3, cooldown=10.0):
+        clock = [0.0]
+        breaker = CircuitBreaker(
+            threshold, cooldown, time_source=lambda: clock[0]
+        )
+        return clock, breaker
+
+    def test_threshold_validated(self):
+        with pytest.raises(ValueError):
+            CircuitBreaker(failure_threshold=0)
+
+    def test_opens_on_consecutive_failures_only(self):
+        _, breaker = self.make(threshold=3)
+        breaker.on_failure()
+        breaker.on_failure()
+        breaker.on_success()  # resets the streak
+        breaker.on_failure()
+        breaker.on_failure()
+        assert breaker.state is BreakerState.CLOSED
+        breaker.on_failure()
+        assert breaker.state is BreakerState.OPEN
+        assert breaker.opens == 1
+
+    def test_open_fast_fails_until_cooldown(self):
+        clock, breaker = self.make(threshold=1, cooldown=10.0)
+        breaker.on_failure()
+        assert breaker.refusing
+        with pytest.raises(CircuitOpenException):
+            breaker.acquire()
+        assert breaker.fast_fails == 1
+        clock[0] += 9.9
+        with pytest.raises(CircuitOpenException):
+            breaker.acquire()
+        assert breaker.fast_fails == 2
+
+    def test_half_open_admits_exactly_one_probe(self):
+        clock, breaker = self.make(threshold=1, cooldown=10.0)
+        breaker.on_failure()
+        clock[0] += 10.0
+        breaker.acquire()  # the single half-open probe
+        assert breaker.state is BreakerState.HALF_OPEN
+        assert breaker.half_opens == 1
+        assert breaker.refusing  # probe slot taken
+        with pytest.raises(CircuitOpenException):
+            breaker.acquire()
+        breaker.on_success()
+        assert breaker.state is BreakerState.CLOSED
+        assert breaker.closes == 1
+        assert not breaker.refusing
+
+    def test_failed_probe_reopens_immediately(self):
+        clock, breaker = self.make(threshold=3, cooldown=10.0)
+        for _ in range(3):
+            breaker.on_failure()
+        clock[0] += 10.0
+        breaker.acquire()
+        breaker.on_failure()  # ONE failed probe re-opens, threshold or not
+        assert breaker.state is BreakerState.OPEN
+        assert breaker.opens == 2
+        with pytest.raises(CircuitOpenException):
+            breaker.acquire()
+
+    def test_neutral_releases_the_probe_slot_without_moving_state(self):
+        clock, breaker = self.make(threshold=1, cooldown=10.0)
+        breaker.on_failure()
+        clock[0] += 10.0
+        breaker.acquire()
+        breaker.on_neutral()  # caller impatience is not evidence
+        assert breaker.state is BreakerState.HALF_OPEN
+        breaker.acquire()  # a fresh probe is admitted
+        breaker.on_success()
+        assert breaker.state is BreakerState.CLOSED
+
+    def test_transition_observer_failures_swallowed_and_counted(self):
+        clock = [0.0]
+
+        def explode(old, new):
+            raise RuntimeError("observer fell over")
+
+        breaker = CircuitBreaker(
+            1, 10.0, time_source=lambda: clock[0], on_transition=explode
+        )
+        breaker.on_failure()  # must not raise
+        assert breaker.state is BreakerState.OPEN
+        assert breaker.observer_failures == 1
+
+    def test_state_code_matches_enum_value(self):
+        _, breaker = self.make()
+        assert breaker.state_code == BreakerState.CLOSED.value
+
+
+class TestBreakerBoard:
+    def test_targets_are_isolated(self):
+        """One bad peer must not open the breaker for the healthy rest."""
+        clock = [0.0]
+        board = BreakerBoard(
+            failure_threshold=1, cooldown_s=5.0, time_source=lambda: clock[0]
+        )
+        board.for_target("bad").on_failure()
+        board.for_target("good").on_success()
+        assert board.for_target("bad").state is BreakerState.OPEN
+        assert board.for_target("good").state is BreakerState.CLOSED
+        assert board.open_count() == 1
+        assert board.known_count() == 2
+        assert board.targets() == {
+            "bad": BreakerState.OPEN, "good": BreakerState.CLOSED,
+        }
+
+    def test_for_target_is_stable(self):
+        board = BreakerBoard()
+        assert board.for_target("x") is board.for_target("x")
+
+    def test_aggregated_transition_totals_and_observer(self):
+        clock = [0.0]
+        seen = []
+        board = BreakerBoard(
+            failure_threshold=1, cooldown_s=5.0,
+            time_source=lambda: clock[0],
+            on_transition=lambda t, old, new: seen.append((t, new)),
+        )
+        board.for_target("a").on_failure()
+        clock[0] += 5.0
+        board.for_target("a").acquire()
+        board.for_target("a").on_success()
+        assert board.opened == 1
+        assert board.half_opened == 1
+        assert board.closed == 1
+        assert seen == [
+            ("a", BreakerState.OPEN),
+            ("a", BreakerState.HALF_OPEN),
+            ("a", BreakerState.CLOSED),
+        ]
+        assert board.open_count() == 0
+
+
+class TestRetryLedger:
+    def test_counters_and_amplification(self):
+        led = RetryLedger()
+        assert led.amplification("quiet.site") == 1.0
+        for _ in range(4):
+            led.note_attempt("s")
+        led.note_retry("s", 0.25)
+        led.note_giveup("s")
+        assert led.value("s", "attempts") == 4.0
+        assert led.value("s", "retries") == 1.0
+        assert led.value("s", "giveups") == 1.0
+        assert led.value("s", "backoff_ms") == pytest.approx(250.0)
+        # 4 attempts over 3 originating calls.
+        assert led.amplification("s") == pytest.approx(4.0 / 3.0)
+
+    def test_snapshot_is_a_copy(self):
+        led = RetryLedger()
+        led.note_attempt("s")
+        snap = led.snapshot()
+        snap["s"]["attempts"] = 999.0
+        assert led.value("s", "attempts") == 1.0
+
+    def test_on_backoff_hook_gets_ms_and_failures_are_swallowed(self):
+        led = RetryLedger()
+        seen: list[float] = []
+        led.on_backoff = seen.append
+        led.note_retry("s", 0.5)
+        assert seen == [pytest.approx(500.0)]
+        led.on_backoff = lambda ms: (_ for _ in ()).throw(RuntimeError("x"))
+        led.note_retry("s", 0.5)  # must not raise
+        assert led.value("s", "retries") == 2.0
+        assert led.observer_failures == 1

@@ -114,6 +114,24 @@ SHARED_CLASSES = {
         "one lookahead per RSM: readahead's speculation pool launches "
         "manifest prefetch flights while gateway workers join or race "
         "them on segment-boundary crossings (flight table + counters)",
+    # ISSUE 19: the unified failure-policy plane is by construction the
+    # most-shared state in the process — every I/O seam on every thread
+    # reports into it.
+    "tieredstorage_tpu/utils/retry.py:CircuitBreaker":
+        "one breaker per guarded target, taken by every gateway worker, "
+        "the batcher's flusher daemon, and the gossip daemon (state "
+        "machine + transition/fast-fail counters)",
+    "tieredstorage_tpu/utils/retry.py:BreakerBoard":
+        "one per-target board per peer cache / gossip agent, keyed lazily "
+        "from every thread that forwards or probes (breaker map + "
+        "aggregated transition totals)",
+    "tieredstorage_tpu/utils/retry.py:RetryLedger":
+        "ONE process-wide accounting plane: every call_with_retry site on "
+        "every thread notes attempts/retries/give-ups into it while "
+        "metrics-scrape gauge suppliers read them",
+    "tieredstorage_tpu/utils/faults.py:FaultPlane":
+        "one installed plane reached by every armed seam concurrently "
+        "(per-site call counters, injection log, fired counters)",
 }
 
 #: Executor dispatch method names whose first argument runs on a pool thread.

@@ -84,6 +84,17 @@ _valid_fault_schedule = parseable_by(
 )
 
 
+def _parse_fault_spec(value) -> None:
+    from tieredstorage_tpu.utils.faults import FaultPlane
+
+    FaultPlane.parse(value)
+
+
+_valid_fault_spec = parseable_by(
+    _parse_fault_spec, "fault rules 'site:kind[=arg][@trigger][~match]'"
+)
+
+
 def _parse_fleet_instances(value) -> None:
     from tieredstorage_tpu.fleet.ring import parse_instances
 
@@ -308,6 +319,64 @@ def _base_def() -> ConfigDef:
         doc="Base backoff (ms) between budgeted storage retries; the actual "
             "sleep is full-jitter exponential and always fits the remaining "
             "end-to-end deadline, or the retry is abandoned.",
+    ))
+    d.define(ConfigKey(
+        "breaker.peer.failure.threshold", "int", default=1,
+        validator=in_range(1, None), importance="low",
+        doc="Consecutive failed forwards that open a peer's circuit breaker "
+            "(per-owner, fleet/peer_cache.py). The default 1 keeps the "
+            "historical mark-down-on-first-failure behavior; the breaker "
+            "re-admits a single half-open probe forward after "
+            "fleet.peer.down.cooldown.ms.",
+    ))
+    d.define(ConfigKey(
+        "breaker.gossip.failure.threshold", "int", default=2,
+        validator=in_range(1, None), importance="low",
+        doc="Consecutive failed probe ROUNDS (retries included) that open a "
+            "gossip member's breaker. Refusing members are deprioritized in "
+            "probe-target selection — never silenced: if every candidate is "
+            "refusing the agent falls back to plain round-robin so the "
+            "failure detector keeps running.",
+    ))
+    d.define(ConfigKey(
+        "retry.gossip.probe.attempts", "int", default=2,
+        validator=in_range(1, None), importance="low",
+        doc="Attempts per gossip probe round trip (including the first). "
+            "Backoff between attempts uses decorrelated jitter seeded per "
+            "instance id, so a partitioned fleet does not retry its probes "
+            "in lockstep.",
+    ))
+    d.define(ConfigKey(
+        "retry.launch.attempts", "int", default=2,
+        validator=in_range(1, None), importance="low",
+        doc="Attempts per merged GCM device launch (including the first) "
+            "before the batcher fails that class's waiters. The retry "
+            "re-stages from the host-side packed buffer (the staged device "
+            "buffer is donated and never replayed); classes never share a "
+            "launch, so a retried failure stays inside its class.",
+    ))
+    d.define(ConfigKey(
+        "retry.launch.backoff.ms", "long", default=5,
+        validator=in_range(0, None), importance="low",
+        doc="Base backoff (ms) before a merged-launch re-dispatch; the "
+            "actual sleep is decorrelated-jitter up to 4x this value.",
+    ))
+    d.define(ConfigKey(
+        "faults.spec", "list", default=[], validator=_valid_fault_spec,
+        importance="low",
+        doc="Fault-plane rules 'site:kind[=arg][@trigger][~match]' "
+            "(utils/faults.py) armed at RSM configure time — the same "
+            "grammar as the TSTPU_FAULTS env var. site in [storage.read, "
+            "storage.write, peer.forward, gossip.probe, device.launch, *]; "
+            "kind in [error, latency, partial, flaky]; trigger '@N', "
+            "'@every=K', '@from=N', '@p=P'; '~match' restricts to keys "
+            "containing the substring. Empty (the default) installs "
+            "nothing: every seam's fire() stays a single attribute read.",
+    ))
+    d.define(ConfigKey(
+        "faults.seed", "long", default=0, importance="low",
+        doc="Seed for the fault plane's probabilistic triggers and latency "
+            "ranges (deterministic for a given seed and call sequence).",
     ))
     d.define(ConfigKey(
         "admission.enabled", "bool", default=False, importance="medium",
@@ -830,6 +899,34 @@ class RemoteStorageManagerConfig:
     @property
     def retry_budget_backoff_ms(self) -> int:
         return self._values["retry.budget.backoff.ms"]
+
+    @property
+    def breaker_peer_failure_threshold(self) -> int:
+        return self._values["breaker.peer.failure.threshold"]
+
+    @property
+    def breaker_gossip_failure_threshold(self) -> int:
+        return self._values["breaker.gossip.failure.threshold"]
+
+    @property
+    def retry_gossip_probe_attempts(self) -> int:
+        return self._values["retry.gossip.probe.attempts"]
+
+    @property
+    def retry_launch_attempts(self) -> int:
+        return self._values["retry.launch.attempts"]
+
+    @property
+    def retry_launch_backoff_ms(self) -> int:
+        return self._values["retry.launch.backoff.ms"]
+
+    @property
+    def faults_spec(self) -> list[str]:
+        return self._values["faults.spec"]
+
+    @property
+    def faults_seed(self) -> int:
+        return self._values["faults.seed"]
 
     @property
     def admission_enabled(self) -> bool:

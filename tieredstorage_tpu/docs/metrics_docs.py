@@ -72,6 +72,22 @@ def _collect_resilience() -> dict[str, list[str]]:
         hedger.close()
 
 
+def _collect_retry() -> dict[str, list[str]]:
+    from tieredstorage_tpu.metrics.core import MetricsRegistry
+    from tieredstorage_tpu.metrics.retry_metrics import register_retry_metrics
+    from tieredstorage_tpu.utils.retry import BreakerBoard, CircuitBreaker, RetryLedger
+
+    registry = MetricsRegistry()
+    ledger = RetryLedger()  # throwaway: docs must not hook the process ledger
+    register_retry_metrics(
+        registry,
+        ledger=ledger,
+        breakers={"storage": CircuitBreaker()},
+        boards={"peer": BreakerBoard(), "gossip": BreakerBoard()},
+    )
+    return _group_names(registry)
+
+
 def _collect_replication() -> dict[str, list[str]]:
     from tieredstorage_tpu.metrics.core import MetricsRegistry
     from tieredstorage_tpu.metrics.rsm_metrics import register_replication_metrics
@@ -282,6 +298,7 @@ def generate() -> str:
         ("Cross-request GCM batching metrics", _collect_batch()),
         ("Device-scheduler timeline metrics", _collect_timeline()),
         ("Resilience metrics", _collect_resilience()),
+        ("Retry-policy and fault-plane metrics", _collect_retry()),
         ("Replication metrics", _collect_replication()),
         ("Fleet metrics", _collect_fleet()),
         ("Scrubber metrics", _collect_scrub()),

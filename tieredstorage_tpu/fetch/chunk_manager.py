@@ -23,7 +23,7 @@ from tieredstorage_tpu.storage.core import (
     ObjectKey,
     StorageBackendException,
 )
-from tieredstorage_tpu.utils import flightrecorder as flight
+from tieredstorage_tpu.utils import faults, flightrecorder as flight
 from tieredstorage_tpu.utils.locks import new_lock
 from tieredstorage_tpu.transform.api import DetransformOptions, TransformBackend
 from tieredstorage_tpu.utils.deadline import check_deadline
@@ -262,6 +262,10 @@ class DefaultChunkManager(ChunkManager):
         attempts."""
         if fetcher is None:
             fetcher = self._fetcher
+        # ISSUE 19 injection seam: per *attempt* (hedge attempts each count),
+        # an `error` fault propagates as a backend failure; `partial` tears
+        # the fetched bytes so the GCM tag check below must refuse them.
+        torn = faults.fire("storage.read", str(objects_key))
         if contiguous:
             # One ranged GET covering the window on the transformed side.
             whole = BytesRange.of(
@@ -269,9 +273,12 @@ class DefaultChunkManager(ChunkManager):
                 chunks[-1].transformed_position + chunks[-1].transformed_size - 1,
             )
             with fetcher.fetch(objects_key, whole) as stream:
-                return [read_exactly(stream, c.transformed_size) for c in chunks]
-        stored = []
-        for c in chunks:
-            with fetcher.fetch(objects_key, c.range()) as stream:
-                stored.append(read_exactly(stream, c.transformed_size))
+                stored = [read_exactly(stream, c.transformed_size) for c in chunks]
+        else:
+            stored = []
+            for c in chunks:
+                with fetcher.fetch(objects_key, c.range()) as stream:
+                    stored.append(read_exactly(stream, c.transformed_size))
+        if torn:
+            stored = [faults.mutate(b, torn) for b in stored]
         return stored

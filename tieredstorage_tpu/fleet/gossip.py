@@ -43,12 +43,16 @@ from __future__ import annotations
 import dataclasses
 import json
 import logging
+import random
 import threading
 import time
+import zlib
 from typing import Callable, Mapping, Optional
 
 from tieredstorage_tpu.fleet.ring import FleetRouter
+from tieredstorage_tpu.utils import faults
 from tieredstorage_tpu.utils.locks import new_lock, note_mutation
+from tieredstorage_tpu.utils.retry import BreakerBoard, RetryPolicy, call_with_retry
 from tieredstorage_tpu.utils.tracing import NOOP_TRACER
 
 log = logging.getLogger(__name__)
@@ -129,9 +133,12 @@ class GossipAgent:
         probe_timeout_s: float = 0.75,
         suspect_periods: int = 3,
         dead_periods: int = 3,
+        probe_retries: int = 1,
+        breaker_threshold: int = 2,
         tracer=NOOP_TRACER,
         transport: Optional[Callable[[str, dict], dict]] = None,
         time_source=time.monotonic,
+        sleeper: Callable[[float], None] = time.sleep,
     ) -> None:
         if interval_s <= 0:
             raise ValueError(f"gossip interval must be > 0, got {interval_s}")
@@ -144,6 +151,25 @@ class GossipAgent:
         self.tracer = tracer
         self._now = time_source
         self._transport = transport
+        self._sleep = sleeper
+        # Unified failure policy (ISSUE 19): a failed probe round trip gets
+        # `probe_retries` extra attempts through the shared driver with
+        # decorrelated jitter — seeded per instance id so a partitioned
+        # fleet does NOT retry its probes in lockstep — and each member gets
+        # a breaker (per-target board) that deprioritizes it in probe-target
+        # selection after `breaker_threshold` consecutive failed rounds.
+        self._probe_policy = RetryPolicy(
+            max_attempts=1 + max(0, probe_retries),
+            base_backoff_s=interval_s / 100.0,
+            max_backoff_s=interval_s / 10.0,
+            retryable=(Exception,),
+        )
+        self._jitter = random.Random(zlib.crc32(self.instance_id.encode("utf-8")))
+        self.breakers = BreakerBoard(
+            failure_threshold=max(1, breaker_threshold),
+            cooldown_s=self.suspect_after_s,
+            time_source=time_source,
+        )
         self._lock = new_lock("gossip.GossipAgent._lock")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -163,6 +189,10 @@ class GossipAgent:
         self.refutations = 0
         self.deltas_applied = 0
         self.period_errors = 0
+        #: Probe candidates skipped because their breaker was refusing.
+        self.probe_skips = 0
+        #: Probe round trips that needed at least one retry attempt.
+        self.retried_probes = 0
         self.seed(router.peers)
 
     # ------------------------------------------------------------- lifecycle
@@ -288,18 +318,52 @@ class GossipAgent:
             return None
         if candidates != self._probe_order:
             self._probe_order = candidates
+        # Breaker-aware selection: members whose breaker is refusing (opened
+        # by consecutive failed probe rounds, still cooling down) are
+        # DEPRIORITIZED, not silenced — skip them round-robin, but if every
+        # candidate is refusing fall back to plain round-robin so the
+        # failure detector keeps probing (breakers must never blind it).
+        # `refusing` is a non-destructive read: the half-open probe slot is
+        # only consumed by on_failure/on_success after the round completes.
+        for _ in range(len(self._probe_order)):
+            self._probe_idx = (self._probe_idx + 1) % len(self._probe_order)
+            name = self._probe_order[self._probe_idx]
+            if not self.breakers.for_target(name).refusing:
+                return self._members[name]
+            self.probe_skips += 1
+            note_mutation("gossip.GossipAgent.probe_skips")
         self._probe_idx = (self._probe_idx + 1) % len(self._probe_order)
         return self._members[self._probe_order[self._probe_idx]]
+
+    def _on_probe_retry(
+        self, attempt: int, delay_s: float, exc: BaseException
+    ) -> None:
+        with self._lock:
+            self.retried_probes += 1
+            note_mutation("gossip.GossipAgent.retried_probes")
 
     def _probe(self, target: Member, payload: dict) -> None:
         with self._lock:
             self.probes_sent += 1
             note_mutation("gossip.GossipAgent.probes_sent")
+        breaker = self.breakers.for_target(target.name)
         try:
-            response = self._exchange(target.url, payload)
+            # The shared retry driver owns the in-round retry (decorrelated
+            # jitter, instance-seeded so partitioned members desynchronize);
+            # the breaker is accounted per probe ROUND, not per attempt —
+            # one flaky round trip that recovers on retry is a success.
+            response = call_with_retry(
+                lambda: self._exchange(target.url, payload),
+                policy=self._probe_policy,
+                site="gossip.probe",
+                on_retry=self._on_probe_retry,
+                rng=self._jitter,
+                sleep=self._sleep,
+            )
         except Exception as e:
             # A failed probe is merely a missed heartbeat refresh: the
             # age-out state machine does the declaring, never one miss.
+            breaker.on_failure()
             with self._lock:
                 self.probe_failures += 1
                 note_mutation("gossip.GossipAgent.probe_failures")
@@ -308,6 +372,7 @@ class GossipAgent:
                 reason=type(e).__name__,
             )
             return
+        breaker.on_success()
         with self._lock:
             self.acks += 1
             note_mutation("gossip.GossipAgent.acks")
@@ -315,6 +380,7 @@ class GossipAgent:
 
     def _exchange(self, url: str, payload: dict) -> dict:
         """One gossip round trip; the injectable seam for tests."""
+        faults.fire("gossip.probe", url or "")
         if self._transport is not None:
             return self._transport(url, payload)
         client = self._client(url)

@@ -97,6 +97,17 @@ def register_fleet_metrics(
               "an incarnation bump")
         gauge("fleet-gossip-deltas-total", lambda: float(gossip.deltas_applied),
               "Membership delta entries merged from received views")
+        gauge(
+            "fleet-gossip-probe-skips-total",
+            lambda: float(gossip.probe_skips),
+            "Probe candidates skipped because their breaker was refusing "
+            "(deprioritized, not silenced)",
+        )
+        gauge(
+            "fleet-gossip-retried-probes-total",
+            lambda: float(gossip.retried_probes),
+            "Probe round trips that needed at least one jittered retry",
+        )
     if peer_cache is not None:
         gauge("fleet-replication-factor", lambda: float(peer_cache.replication),
               "Replica owners per segment key (ring successors tried in "

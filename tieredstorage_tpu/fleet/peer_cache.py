@@ -14,15 +14,23 @@ Layering (owner and non-owner identical):
       -> PeerChunkCache (this module: route -> forward | local)
         -> SingleFlight -> DefaultChunkManager -> remote storage
 
-Failure semantics: forwarding is an OPTIMIZATION, never a dependency. A
-forward that fails (connect/timeout/5xx) marks the peer down for
-``fleet.peer.down.cooldown.ms`` and the read falls back to the local
-backend path — byte-identical result, one extra backend read, no error. A
-404 from the owner (object unknown there) falls back the same way so the
-authoritative error comes from this instance's own storage stack. Forwards
-propagate the ambient Deadline (``x-deadline-ms``) and trace context
-(``traceparent``), and the wire is the existing shim-wire gateway (new
-``GET /chunk`` route) — no new listener, no new protocol.
+Failure semantics: forwarding is an OPTIMIZATION, never a dependency. Peer
+health is a per-owner circuit breaker (utils/retry.BreakerBoard, ISSUE 19 —
+this replaced a bespoke down-cooldown dict): a forward that fails
+(connect/timeout/5xx/torn frame) counts a breaker failure, and after
+``breaker.peer.failure.threshold`` consecutive failures (default 1) the
+owner's breaker opens for ``fleet.peer.down.cooldown.ms`` — reads skip it
+and fall back to the next owner / the local backend path, byte-identical
+result, no error. After the cooldown the breaker goes half-open and admits
+exactly ONE probing forward (concurrent readers keep falling back instead
+of stampeding a recovering peer); success closes it. A 404 from the owner
+(object unknown there) is a contract answer from a healthy peer — breaker
+success — and falls back so the authoritative error comes from this
+instance's own storage stack. Forwards propagate the ambient Deadline
+(``x-deadline-ms``) and trace context (``traceparent``), and the wire is
+the existing shim-wire gateway (``GET /chunk``) — no new listener, no new
+protocol. The ``peer.forward`` fault-injection seam (utils/faults.py)
+fires per forward attempt, before the wire.
 """
 
 from __future__ import annotations
@@ -40,7 +48,12 @@ from tieredstorage_tpu.fleet.singleflight import SingleFlight
 from tieredstorage_tpu.manifest.segment_manifest import SegmentManifestV1
 from tieredstorage_tpu.storage.core import ObjectKey
 from tieredstorage_tpu.storage.httpclient import HttpClient, HttpError, NO_RETRY
-from tieredstorage_tpu.utils import flightrecorder as flight
+from tieredstorage_tpu.utils import faults, flightrecorder as flight
+from tieredstorage_tpu.utils.retry import (
+    BreakerBoard,
+    BreakerState,
+    CircuitOpenException,
+)
 from tieredstorage_tpu.utils.deadline import DEADLINE_HEADER, current_deadline
 from tieredstorage_tpu.utils.tracing import TRACEPARENT_HEADER, NOOP_TRACER
 from tieredstorage_tpu.utils.locks import new_lock, note_mutation
@@ -94,6 +107,7 @@ class PeerChunkCache(ChunkManager):
         replication: int = 2,
         forward_timeout_s: float = 2.0,
         down_cooldown_s: float = 5.0,
+        breaker_threshold: int = 1,
         tracer=NOOP_TRACER,
         on_forward=None,
         time_source=time.monotonic,
@@ -114,9 +128,18 @@ class PeerChunkCache(ChunkManager):
         self.forward_timeout_s = forward_timeout_s
         self.down_cooldown_s = down_cooldown_s
         self._now = time_source
+        #: Per-owner breakers (the unified failure-policy plane, ISSUE 19):
+        #: threshold failures open an owner for `down_cooldown_s`, then one
+        #: half-open probe re-admits it. Opening emits the same
+        #: `fleet.peer_down` tracing event the old cooldown dict did.
+        self.breakers = BreakerBoard(
+            failure_threshold=max(1, breaker_threshold),
+            cooldown_s=down_cooldown_s,
+            time_source=time_source,
+            on_transition=self._on_breaker_transition,
+        )
         self._lock = new_lock("peer_cache.PeerChunkCache._lock")
         self._clients: dict[str, HttpClient] = {}
-        self._down_until: dict[str, float] = {}
         #: Keys this instance is currently serving AS the owner (forwarded
         #: requests pin their key so the serving path can never re-forward,
         #: even across the chunk cache's loader pool threads).
@@ -144,9 +167,7 @@ class PeerChunkCache(ChunkManager):
 
     @property
     def peers_down(self) -> int:
-        now = self._now()
-        with self._lock:
-            return sum(1 for until in self._down_until.values() if until > now)
+        return self.breakers.open_count()
 
     def close(self) -> None:
         with self._lock:
@@ -180,14 +201,11 @@ class PeerChunkCache(ChunkManager):
             return key_value in self._pinned
 
     # ---------------------------------------------------------- peer health
-    def _is_down(self, peer: str) -> bool:
-        with self._lock:
-            return self._down_until.get(peer, 0.0) > self._now()
-
-    def _mark_down(self, peer: str, reason: str) -> None:
-        with self._lock:
-            self._down_until[peer] = self._now() + self.down_cooldown_s
-        self.tracer.event("fleet.peer_down", peer=peer, reason=reason)
+    def _on_breaker_transition(
+        self, peer: str, old: BreakerState, new: BreakerState
+    ) -> None:
+        if new is BreakerState.OPEN:
+            self.tracer.event("fleet.peer_down", peer=peer, reason="breaker_open")
 
     def _client(self, peer: str, url: str) -> HttpClient:
         stale: Optional[HttpClient] = None
@@ -245,21 +263,40 @@ class PeerChunkCache(ChunkManager):
                     # live owner: the local chunk path IS the replica serve,
                     # and it warms this instance's arc copy.
                     break
-                if self._is_down(owner):
+                breaker = self.breakers.for_target(owner)
+                try:
+                    # Open = skip to the next owner; half-open admits ONE
+                    # probing forward while concurrent readers fall back.
+                    breaker.acquire()
+                except CircuitOpenException:
+                    flight.note("breaker.skipped_owners")
                     continue
-                forwarded = self._try_forward(
-                    owner, url, objects_key, chunk_ids, rank=rank
-                )
+                try:
+                    forwarded = self._try_forward(
+                        owner, url, objects_key, chunk_ids, rank=rank,
+                        breaker=breaker,
+                    )
+                except BaseException:
+                    breaker.on_neutral()  # never leak a half-open probe slot
+                    raise
                 if forwarded is not None:
                     return forwarded
         return self._delegate.get_chunks(objects_key, manifest, list(chunk_ids))
 
     def _try_forward(
         self, owner: str, url: str, objects_key: ObjectKey,
-        chunk_ids: Sequence[int], *, rank: int = 0,
+        chunk_ids: Sequence[int], *, rank: int = 0, breaker=None,
     ) -> Optional[list[bytes]]:
         """One GET /chunk against the owner; None means 'try the next owner,
-        then serve locally' (miss, peer down, torn frame) — never an error."""
+        then serve locally' (miss, peer down, torn frame) — never an error.
+        Every outcome settles `breaker`: failure/torn frame/5xx are breaker
+        failures, a served window or a 404 (healthy contract answer) is a
+        breaker success."""
+
+        def settle_failure() -> None:
+            if breaker is not None:
+                breaker.on_failure()
+
         with self._lock:
             self.forwards += 1
             note_mutation("peer_cache.PeerChunkCache.forwards")
@@ -284,24 +321,37 @@ class PeerChunkCache(ChunkManager):
             headers[DEADLINE_HEADER] = deadline.header_value()
         start = time.monotonic()
         try:
+            # ISSUE 19 injection seam: an `error` fault fails this hop like a
+            # dead transport; `partial` tears the response body below so the
+            # frame decoder must refuse it.
+            torn = faults.fire("peer.forward", f"{owner}|{objects_key.value}")
             resp = self._client(owner, url).request("GET", path, headers=headers)
-        except HttpError as e:
+        except (HttpError, faults.FaultInjectedError) as e:
             with self._lock:
                 self.forward_failures += 1
                 note_mutation("peer_cache.PeerChunkCache.forward_failures")
-            self._mark_down(owner, f"{type(e).__name__}")
+            settle_failure()
+            self.tracer.event(
+                "fleet.forward_failed", peer=owner, reason=f"{type(e).__name__}"
+            )
             return None
         elapsed_ms = (time.monotonic() - start) * 1000.0
         if resp.status == 200:
             try:
-                window = decode_chunk_frames(resp.body, expected=hi - lo + 1)
+                body = faults.mutate(resp.body, torn)
+                window = decode_chunk_frames(body, expected=hi - lo + 1)
             except ValueError as e:
                 with self._lock:
                     self.forward_failures += 1
                     note_mutation("peer_cache.PeerChunkCache.forward_failures")
-                self._mark_down(owner, str(e))
+                settle_failure()
+                self.tracer.event(
+                    "fleet.forward_failed", peer=owner, reason=str(e)
+                )
                 return None
             chunks = [window[cid - lo] for cid in chunk_ids]
+            if breaker is not None:
+                breaker.on_success()
             with self._lock:
                 self.peer_hits += 1
                 note_mutation("peer_cache.PeerChunkCache.peer_hits")
@@ -321,8 +371,10 @@ class PeerChunkCache(ChunkManager):
             return chunks
         if resp.status == 404:
             # The owner cannot serve this key (not uploaded / already
-            # deleted there): the authoritative answer comes from the local
-            # storage stack.
+            # deleted there): a contract answer from a HEALTHY peer — the
+            # authoritative answer comes from the local storage stack.
+            if breaker is not None:
+                breaker.on_success()
             with self._lock:
                 self.peer_misses += 1
                 note_mutation("peer_cache.PeerChunkCache.peer_misses")
@@ -330,5 +382,8 @@ class PeerChunkCache(ChunkManager):
         with self._lock:
             self.forward_failures += 1
             note_mutation("peer_cache.PeerChunkCache.forward_failures")
-        self._mark_down(owner, f"http {resp.status}")
+        settle_failure()
+        self.tracer.event(
+            "fleet.forward_failed", peer=owner, reason=f"http {resp.status}"
+        )
         return None

@@ -65,6 +65,10 @@ def register_batch_metrics(registry: MetricsRegistry, batcher) -> None:
           lambda: float(batcher.launch_failures),
           "Merged flushes whose launch raised (woken waiters limited to "
           "the failing launch's one work class)")
+    gauge("batch-launch-retries-total",
+          lambda: float(batcher.launch_retries),
+          "Merged flushes that needed the bounded re-dispatch "
+          "(retry.launch.attempts) before succeeding or failing")
     gauge("batch-mean-occupancy", lambda: float(batcher.mean_occupancy),
           "Coalesced windows per merged launch since start")
     gauge("batch-speculative-windows-total",

@@ -93,7 +93,7 @@ from tieredstorage_tpu.utils.deadline import (
     check_deadline,
     ensure_deadline,
 )
-from tieredstorage_tpu.utils import flightrecorder as flight
+from tieredstorage_tpu.utils import faults, flightrecorder as flight
 from tieredstorage_tpu.metrics.timeline import NOOP_TIMELINE, TimelineRecorder
 from tieredstorage_tpu.utils.flightrecorder import NOOP_RECORDER, FlightRecorder
 from tieredstorage_tpu.utils.ratelimit import RateLimitedStream, TokenBucket
@@ -229,6 +229,10 @@ class RemoteStorageManager:
         batcher = getattr(backend, "batcher", None)
         if batcher is not None:
             batcher.timeline = self.timeline
+            batcher.set_launch_retry(
+                config.retry_launch_attempts,
+                config.retry_launch_backoff_ms / 1000.0,
+            )
 
         self._object_key_factory = ObjectKeyFactory(config.key_prefix, config.key_prefix_mask)
 
@@ -363,6 +367,8 @@ class RemoteStorageManager:
                 probe_timeout_s=config.fleet_gossip_probe_timeout_ms / 1000.0,
                 suspect_periods=config.fleet_gossip_suspect_periods,
                 dead_periods=config.fleet_gossip_dead_periods,
+                probe_retries=config.retry_gossip_probe_attempts - 1,
+                breaker_threshold=config.breaker_gossip_failure_threshold,
                 tracer=self.tracer,
             )
         self._fleet_metrics = FleetMetrics(self._metrics.registry)
@@ -865,6 +871,20 @@ class RemoteStorageManager:
                 "Fault injection ENABLED with %d rule(s); storage calls will "
                 "be deliberately failed/corrupted/slowed", len(self._fault_schedule),
             )
+        if config.faults_spec:
+            # The process-wide fault plane (utils/faults.py): named injection
+            # points across EVERY I/O seam — storage read/write, peer
+            # forwards, gossip probes, device launches — not just the
+            # storage-backend decorator above. Same arming as TSTPU_FAULTS.
+            plane = faults.FaultPlane.parse(
+                config.faults_spec, seed=config.faults_seed
+            )
+            faults.install(plane)
+            log.warning(
+                "Fault plane ENABLED with %d rule(s) across the I/O seams; "
+                "calls will be deliberately failed/torn/slowed",
+                len(plane.rules),
+            )
         if config.breaker_enabled:
             self._breaker = CircuitBreaker(
                 failure_threshold=config.breaker_failure_threshold,
@@ -909,6 +929,18 @@ class RemoteStorageManager:
                 peer_cache=self._peer_cache,
                 gossip=self._gossip,
             )
+        from tieredstorage_tpu.metrics.retry_metrics import register_retry_metrics
+
+        boards = {}
+        if self._peer_cache is not None:
+            boards["peer"] = self._peer_cache.breakers
+        if self._gossip is not None:
+            boards["gossip"] = self._gossip.breakers
+        register_retry_metrics(
+            self._metrics.registry,
+            breakers={"storage": self._breaker} if self._breaker is not None else None,
+            boards=boards,
+        )
 
     def _register_cache_metrics(self) -> None:
         registry = self._metrics.registry
@@ -982,6 +1014,7 @@ class RemoteStorageManager:
                     replication=config.fleet_replication_factor,
                     forward_timeout_s=config.fleet_forward_timeout_ms / 1000.0,
                     down_cooldown_s=config.fleet_peer_down_cooldown_ms / 1000.0,
+                    breaker_threshold=config.breaker_peer_failure_threshold,
                     tracer=self.tracer,
                     on_forward=self._fleet_metrics.record_forward,
                 )
@@ -1157,6 +1190,14 @@ class RemoteStorageManager:
             encryption=data_key,
         )
 
+    def _storage_upload(self, stream: BinaryIO, key) -> int:
+        """Segment-object upload chokepoint: the ``storage.write`` injection
+        seam (utils/faults.py) sits here, before the stream is consumed, so a
+        chaos run can fail/stall writes without corrupting partially-consumed
+        uploads."""
+        faults.fire("storage.write", str(key))
+        return self._storage.upload(stream, key)
+
     def _upload_segment_log(
         self, metadata, segment_data, requires_compression, data_key,
         custom_builder, uploaded_keys,
@@ -1177,7 +1218,7 @@ class RemoteStorageManager:
             if self._rate_bucket is not None:
                 stream = RateLimitedStream(stream, self._rate_bucket)
             uploaded_keys.append(key)
-            uploaded = self._storage.upload(stream, key)
+            uploaded = self._storage_upload(stream, key)
             if span is not None:
                 span.attributes["bytes_uploaded"] = uploaded
         custom_builder.add_upload_result(Suffix.LOG, uploaded)
@@ -1240,7 +1281,7 @@ class RemoteStorageManager:
 
         key = self._object_key_factory.key(metadata, Suffix.INDEXES)
         uploaded_keys.append(key)
-        uploaded = self._storage.upload(io.BytesIO(b"".join(parts)), key)
+        uploaded = self._storage_upload(io.BytesIO(b"".join(parts)), key)
         custom_builder.add_upload_result(Suffix.INDEXES, uploaded)
         self._record_upload(metadata, Suffix.INDEXES, uploaded)
         log.debug("Uploaded indexes file for %s, size: %d", metadata, uploaded)
@@ -1269,7 +1310,7 @@ class RemoteStorageManager:
         key = self._object_key_factory.key(metadata, Suffix.MANIFEST)
         uploaded_keys.append(key)
         with self.tracer.span("rsm.upload.manifest", bytes=len(text)):
-            uploaded = self._storage.upload(io.BytesIO(text.encode("utf-8")), key)
+            uploaded = self._storage_upload(io.BytesIO(text.encode("utf-8")), key)
         custom_builder.add_upload_result(Suffix.MANIFEST, uploaded)
         self._record_upload(metadata, Suffix.MANIFEST, uploaded)
         log.debug("Uploaded segment manifest for %s, size: %d", metadata, uploaded)

@@ -10,7 +10,9 @@ backend outages two ways:
   period passes and a single half-open probe is allowed through — success
   closes the breaker, failure re-opens it. KeyNotFoundException /
   InvalidRangeException are contract responses from a healthy backend and
-  count as successes.
+  count as successes. The state machine itself lives in the unified policy
+  plane (utils/retry.py, ISSUE 19) and is re-exported here; this module
+  keeps the storage-specific wiring.
 - **Retry budget** (`retry.budget.*`): a token bucket that earns a fraction
   of a token per *successful* call and spends one whole token per retry, so
   the cluster-wide retry amplification factor is capped at
@@ -22,6 +24,11 @@ backend outages two ways:
   the bucket drains and the layer degrades to single attempts — which is
   what lets the breaker see the true failure rate and open.
 
+The retry loop itself is `utils.retry.call_with_retry` with a typed
+`RetryPolicy` — decorrelated-jitter backoff, deadline-aware scheduling, and
+ledger/flight accounting are owned there, not here (one policy layer owns
+backoff everywhere). The budget plugs in as the driver's `retry_gate`.
+
 Both are wired by the RSM behind `breaker.enabled` / `retry.budget.enabled`
 (config/rsm_config.py); state and counters are exported as gauges via
 metrics/rsm_metrics.register_resilience_metrics and transitions are recorded
@@ -30,10 +37,7 @@ as tracing events.
 
 from __future__ import annotations
 
-import enum
-import random
-import time
-from typing import BinaryIO, Callable, Mapping, Optional
+from typing import BinaryIO, Mapping, Optional
 
 from tieredstorage_tpu.storage.core import (
     BytesRange,
@@ -44,107 +48,13 @@ from tieredstorage_tpu.storage.core import (
     StorageBackendException,
 )
 from tieredstorage_tpu.utils.locks import new_lock
-from tieredstorage_tpu.utils.deadline import DeadlineExceededException, remaining_s
-
-
-class BreakerState(enum.Enum):
-    CLOSED = 0
-    HALF_OPEN = 1
-    OPEN = 2
-
-
-class CircuitOpenException(StorageBackendException):
-    """Fast-fail: the breaker is open and the call never reached the backend."""
-
-
-class CircuitBreaker:
-    def __init__(
-        self,
-        failure_threshold: int = 5,
-        cooldown_s: float = 30.0,
-        *,
-        time_source: Callable[[], float] = time.monotonic,
-        on_transition: Optional[Callable[[BreakerState, BreakerState], None]] = None,
-    ) -> None:
-        if failure_threshold < 1:
-            raise ValueError("failure_threshold must be >= 1")
-        self._threshold = failure_threshold
-        self._cooldown_s = cooldown_s
-        self._now = time_source
-        self._on_transition = on_transition
-        self._lock = new_lock("resilient.CircuitBreaker._lock")
-        self._state = BreakerState.CLOSED
-        self._consecutive_failures = 0
-        self._opened_at = 0.0
-        self._probe_in_flight = False
-        #: Cumulative counters, exported as gauges.
-        self.opens = 0
-        self.fast_fails = 0
-        #: Transition-observer callbacks that raised (swallowed-exception
-        #: checker: a failing observer must not break the breaker, but the
-        #: failure must still be countable).
-        self.observer_failures = 0
-
-    @property
-    def state(self) -> BreakerState:
-        with self._lock:
-            return self._state
-
-    @property
-    def state_code(self) -> int:
-        return self.state.value
-
-    def _transition_locked(self, new: BreakerState) -> None:
-        old, self._state = self._state, new
-        if old is not new and self._on_transition is not None:
-            try:
-                self._on_transition(old, new)
-            except Exception:  # noqa: BLE001 — observers must not break the breaker
-                self.observer_failures += 1
-
-    def acquire(self) -> None:
-        """Gate a call; raises CircuitOpenException while open."""
-        with self._lock:
-            if self._state is BreakerState.OPEN:
-                if self._now() - self._opened_at >= self._cooldown_s:
-                    self._transition_locked(BreakerState.HALF_OPEN)
-                else:
-                    self.fast_fails += 1
-                    raise CircuitOpenException(
-                        f"Circuit breaker open ({self._consecutive_failures} "
-                        "consecutive backend failures); failing fast"
-                    )
-            if self._state is BreakerState.HALF_OPEN:
-                if self._probe_in_flight:
-                    self.fast_fails += 1
-                    raise CircuitOpenException(
-                        "Circuit breaker half-open; probe already in flight"
-                    )
-                self._probe_in_flight = True
-
-    def on_success(self) -> None:
-        with self._lock:
-            self._consecutive_failures = 0
-            self._probe_in_flight = False
-            self._transition_locked(BreakerState.CLOSED)
-
-    def on_neutral(self) -> None:
-        """The call neither proves nor indicts the backend (e.g. the caller's
-        deadline expired client-side): release a half-open probe slot without
-        moving the state machine either way."""
-        with self._lock:
-            self._probe_in_flight = False
-
-    def on_failure(self) -> None:
-        with self._lock:
-            self._consecutive_failures += 1
-            was_probe = self._probe_in_flight
-            self._probe_in_flight = False
-            if was_probe or self._consecutive_failures >= self._threshold:
-                if self._state is not BreakerState.OPEN:
-                    self.opens += 1
-                self._opened_at = self._now()
-                self._transition_locked(BreakerState.OPEN)
+from tieredstorage_tpu.utils.retry import (  # noqa: F401 — re-exported compat names
+    BreakerState,
+    CircuitBreaker,
+    CircuitOpenException,
+    RetryPolicy,
+    call_with_retry,
+)
 
 
 class RetryBudget:
@@ -210,8 +120,14 @@ class ResilientStorageBackend(StorageBackend):
         self._delegate = delegate
         self.breaker = breaker
         self.retry_budget = retry_budget
-        self._max_attempts = max(1, max_attempts)
-        self._backoff_s = backoff_s
+        self._policy = RetryPolicy(
+            max_attempts=max(1, max_attempts),
+            base_backoff_s=backoff_s,
+            max_backoff_s=max(backoff_s, backoff_s * 8.0),
+            retryable=(StorageBackendException,),
+            healthy=(KeyNotFoundException, InvalidRangeException),
+        )
+        self._single = self._policy.single()
         self._tracer = tracer
 
     @property
@@ -221,81 +137,57 @@ class ResilientStorageBackend(StorageBackend):
     def configure(self, configs: Mapping[str, object]) -> None:
         self._delegate.configure(configs)
 
-    def _attempt(self, fn, *args):
-        """One breaker-accounted delegate call."""
-        if self.breaker is not None:
-            self.breaker.acquire()
-        try:
-            result = fn(*args)
-        except (KeyNotFoundException, InvalidRangeException):
-            # The backend answered; the request was just unsatisfiable.
-            if self.breaker is not None:
-                self.breaker.on_success()
-            raise
-        except DeadlineExceededException:
-            # Caller impatience, not backend failure: opening the breaker on
-            # tight-deadline traffic would turn slow callers into an outage.
-            if self.breaker is not None:
-                self.breaker.on_neutral()
-            raise
-        except Exception:
-            if self.breaker is not None:
-                self.breaker.on_failure()
-            raise
-        if self.breaker is not None:
-            self.breaker.on_success()
-        return result
+    def _on_retry(self, attempt: int, delay_s: float, exc: BaseException) -> None:
+        if self._tracer is not None:
+            self._tracer.event("storage.retry", attempt=attempt)
 
-    def _call(self, fn, *args, replayable: bool = True):
-        attempt = 0
-        while True:
-            try:
-                result = self._attempt(fn, *args)
-            except (KeyNotFoundException, InvalidRangeException):
-                if self.retry_budget is not None:
-                    self.retry_budget.deposit()  # contract answer = healthy
-                raise
-            except (CircuitOpenException, DeadlineExceededException):
-                raise  # fast-fail paths are never retried
-            except StorageBackendException:
-                if (
-                    not replayable
-                    or self.retry_budget is None
-                    or attempt >= self._max_attempts - 1
-                    or not self.retry_budget.try_spend()
-                ):
-                    raise
-                delay = random.uniform(0.0, self._backoff_s * (2**attempt))
-                budget = remaining_s()
-                if budget is not None and delay >= budget:
-                    raise  # the deadline can't fit another attempt + backoff
-                if self._tracer is not None:
-                    self._tracer.event("storage.retry", attempt=attempt + 1)
-                time.sleep(delay)
-                attempt += 1
-                continue
+    def _call(self, fn, *args, op: str, replayable: bool = True):
+        # No budget = no retries: the budget is what makes retries a shared,
+        # earned resource; without one this layer degrades to the breaker
+        # gate plus single attempts.
+        retryable = replayable and self.retry_budget is not None
+        policy = self._policy if retryable else self._single
+        try:
+            result = call_with_retry(
+                lambda: fn(*args),
+                policy=policy,
+                site=f"storage.{op}",
+                breaker=self.breaker,
+                retry_gate=self.retry_budget.try_spend if retryable else None,
+                on_retry=self._on_retry,
+            )
+        except (KeyNotFoundException, InvalidRangeException):
             if self.retry_budget is not None:
-                self.retry_budget.deposit()
-            return result
+                self.retry_budget.deposit()  # contract answer = healthy
+            raise
+        if self.retry_budget is not None:
+            self.retry_budget.deposit()
+        return result
 
     def upload(self, input_stream: BinaryIO, key: ObjectKey) -> int:
         # Not replayable: the first attempt consumes the stream.
-        return self._call(self._delegate.upload, input_stream, key, replayable=False)
+        return self._call(
+            self._delegate.upload, input_stream, key, op="upload", replayable=False
+        )
 
     def fetch(self, key: ObjectKey, byte_range: Optional[BytesRange] = None) -> BinaryIO:
-        return self._call(self._delegate.fetch, key, byte_range)
+        return self._call(self._delegate.fetch, key, byte_range, op="fetch")
 
     def delete(self, key: ObjectKey) -> None:
-        return self._call(self._delegate.delete, key)
+        return self._call(self._delegate.delete, key, op="delete")
 
     def delete_all(self, keys) -> None:
         # Materialized so a budgeted replay re-deletes the same key list.
-        return self._call(self._delegate.delete_all, list(keys))
+        return self._call(self._delegate.delete_all, list(keys), op="delete")
 
     def list_objects(self, prefix: str = ""):
         # Materialized under the breaker so mid-iteration page failures count
         # as backend failures instead of escaping the accounting.
-        return iter(self._call(lambda p: list(self._delegate.list_objects(p)), prefix))
+        return iter(
+            self._call(
+                lambda p: list(self._delegate.list_objects(p)), prefix, op="list"
+            )
+        )
 
     def __str__(self) -> str:
         return f"ResilientStorageBackend{{delegate={self._delegate}}}"

@@ -87,8 +87,9 @@ from tieredstorage_tpu.transform.scheduler import (
     is_speculative,
     validate_work_class,
 )
-from tieredstorage_tpu.utils import flightrecorder
+from tieredstorage_tpu.utils import faults, flightrecorder
 from tieredstorage_tpu.utils.locks import new_condition, note_mutation
+from tieredstorage_tpu.utils.retry import RetryPolicy, call_with_retry
 
 
 class BatcherStoppedError(RuntimeError):
@@ -199,6 +200,8 @@ class WindowBatcher:
         max_bytes: int = 64 << 20,
         background_max_age_ms: float = DEFAULT_BACKGROUND_MAX_AGE_MS,
         class_shares: Optional[dict] = None,
+        launch_attempts: int = 2,
+        launch_backoff_s: float = 0.005,
         time_source: Callable[[], float] = time.monotonic,
     ) -> None:
         if wait_ms < 0:
@@ -223,6 +226,19 @@ class WindowBatcher:
                 raise ValueError(f"share for {cls!r} must be > 0, got {share}")
             self.class_shares[cls] = float(share)
         self._now = time_source
+        # Unified failure policy (ISSUE 19): ONE bounded re-dispatch before
+        # a merged launch fails its waiters — a transient device/runtime
+        # hiccup (preempted stream, transfer glitch) should not fail a whole
+        # coalesced window of requests. Classes never share a launch, so the
+        # retry cannot leak a failure across classes; each attempt re-stages
+        # from the host-side packed buffer (the staged device buffer is
+        # donated by the launch and must never be replayed).
+        self._launch_policy = RetryPolicy(
+            max_attempts=max(1, int(launch_attempts)),
+            base_backoff_s=max(0.0, float(launch_backoff_s)),
+            max_backoff_s=max(0.0, float(launch_backoff_s)) * 4.0,
+            retryable=(Exception,),
+        )
         #: The ONE guard of every shared field below; doubles as the
         #: flusher's wakeup condition (the admission-controller idiom, so
         #: the lock-order checker sees wait() release the held lock).
@@ -252,6 +268,8 @@ class WindowBatcher:
         self.launches = 0
         self.expired_windows = 0
         self.launch_failures = 0
+        #: Merged launches that needed the bounded re-dispatch.
+        self.launch_retries = 0
         #: Per-class counters: windows that rode a merged flush, merged
         #: launches, and the summed added queue wait — the class gauges.
         self.class_flushed_windows = {cls: 0 for cls in WORK_CLASSES}
@@ -294,6 +312,18 @@ class WindowBatcher:
         occupancy-1 by definition and excluded)."""
         with self._cond:
             return self.batched_windows / self.launches if self.launches else 0.0
+
+    def set_launch_retry(self, attempts: int, backoff_s: float) -> None:
+        """Rebuild the launch retry policy (`retry.launch.*`): the RSM wires
+        this after the backend's configure() built the batcher, since the
+        policy keys live at the RSM level, not the transform.* subtree."""
+        backoff = max(0.0, float(backoff_s))
+        self._launch_policy = RetryPolicy(
+            max_attempts=max(1, int(attempts)),
+            base_backoff_s=backoff,
+            max_backoff_s=backoff * 4.0,
+            retryable=(Exception,),
+        )
 
     def set_class_rate(
         self, work_class: str, rate_bytes: Optional[float],
@@ -670,6 +700,23 @@ class WindowBatcher:
                     flushes += 1
 
     # ------------------------------------------------------------------ flush
+    def _on_launch_retry(
+        self, attempt: int, delay_s: float, exc: BaseException
+    ) -> None:
+        with self._cond:
+            self.launch_retries += 1
+            note_mutation("batcher.WindowBatcher.launch_retries")
+
+    def _launch_once(self, ctx, packed, decrypt: bool, work_class: str):
+        """One stage + launch attempt of a merged flush, replay-safe: each
+        attempt re-stages from the host-side ``packed`` buffer because the
+        staged device buffer is donated by the launch. ``device.launch`` is
+        the fault-injection seam (keyed by work class). Returns the device
+        output buffer; the caller owns the sanctioned ``np.asarray``."""
+        faults.fire("device.launch", work_class)
+        staged = self._backend._stage_packed(packed, True)
+        return self._backend._launch_packed(ctx, staged, True, decrypt=decrypt)
+
     def _flush_group(self, key: tuple, entries: list) -> None:
         """ONE shared launch for a bucket's queued windows: merge rows into
         a single packed buffer, stage + launch through the owning backend
@@ -728,8 +775,12 @@ class WindowBatcher:
             # by the varlen contract).
             packed[rows:, n_bytes + IV_SIZE] = 16
             t0 = self._now()
-            staged = backend._stage_packed(packed, True)
-            out = backend._launch_packed(ctx, staged, True, decrypt=decrypt)
+            out = call_with_retry(
+                lambda: self._launch_once(ctx, packed, decrypt, work_class),
+                policy=self._launch_policy,
+                site="device.launch",
+                on_retry=self._on_launch_retry,
+            )
             host = np.asarray(out)
             launch_s = self._now() - t0
         except BaseException as exc:  # noqa: BLE001 - every waiter must wake

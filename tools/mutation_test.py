@@ -115,6 +115,14 @@ DEFAULT_TARGETS = [
     # or under-counts wasted decrypt bytes (breaking the misprediction
     # bound the SLO spec and the load-demo gate both judge against).
     ("tieredstorage_tpu/fetch/readahead.py", ["tests/test_readahead.py"]),
+    # ISSUE 19: the unified failure-policy plane is pure policy arithmetic —
+    # classification precedence, decorrelated-jitter bounds, the breaker
+    # threshold/cooldown state machine, ledger amplification math, and the
+    # fault grammar's trigger predicates. An operator flip here silently
+    # retries the unretryable, opens breakers early/never, or fires faults
+    # off-schedule (breaking the chaos matrix's determinism contract).
+    ("tieredstorage_tpu/utils/retry.py", ["tests/test_retry_policy.py"]),
+    ("tieredstorage_tpu/utils/faults.py", ["tests/test_fault_plane.py"]),
 ]
 
 _CMP_SWAP = {
