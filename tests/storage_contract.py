@@ -95,6 +95,24 @@ class StorageContract:
             with pytest.raises(KeyNotFoundException):
                 backend.fetch(k)
 
+    def test_retried_delete_of_half_deleted_triple_succeeds(self, backend):
+        """Crash-consistent deletes (ISSUE 20) retry the FULL segment
+        triple after a partial first attempt: re-deleting keys that are
+        already gone must be a no-op on every backend, per key and batched."""
+        stem = "topic/partition/00000000000000000042-abc"
+        triple = [ObjectKey(stem + suffix)
+                  for suffix in (".log", ".indexes", ".rsm-manifest")]
+        for k in triple:
+            backend.upload(io.BytesIO(b"v"), k)
+        backend.delete(triple[1])  # first attempt died half-way
+        backend.delete_all(triple)  # the retry sees a half-deleted triple
+        for k in triple:
+            with pytest.raises(KeyNotFoundException):
+                backend.fetch(k)
+        backend.delete_all(triple)  # and a full second retry converges too
+        for k in triple:
+            backend.delete(k)  # per-key retries are no-ops as well
+
     def test_overwrite_same_key(self, backend):
         backend.upload(io.BytesIO(b"first"), KEY)
         try:

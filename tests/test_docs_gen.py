@@ -45,6 +45,11 @@ def test_configs_rst_covers_all_config_classes():
         "``fleet.vnodes``",
         "``fleet.forward.timeout.ms``",
         "``fleet.peer.down.cooldown.ms``",
+        "``lifecycle.enabled``",
+        "``lifecycle.journal.path``",
+        "``lifecycle.sweep.interval.ms``",
+        "``lifecycle.sweep.on.start``",
+        "``lifecycle.grace.ms``",
     ):
         assert key in rst
     # Required keys render as required, defaulted ones with their default.
@@ -70,6 +75,7 @@ def test_metrics_rst_covers_all_groups():
         "gcs-client-metrics",
         "azure-blob-client-metrics",
         "timeline-metrics",
+        "lifecycle-metrics",
     ):
         assert f"Group ``{group}``" in rst
     for name in (
@@ -98,6 +104,10 @@ def test_metrics_rst_covers_all_groups():
         "timeline-ring-occupancy",
         "batch-class-latency-added-wait-time-ms",
         "batch-class-latency-last-batch-id",
+        "lifecycle-journal-pending-uploads",
+        "lifecycle-orphans-deleted-total",
+        "lifecycle-quarantined-manifests",
+        "lifecycle-sweep-invariant-blocks-total",
     ):
         assert f"``{name}``" in rst
 

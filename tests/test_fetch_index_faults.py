@@ -1,0 +1,131 @@
+"""``fetch_index`` under injected ``storage.read`` faults (ISSUE 20
+satellite): every IndexType, driven through the FULL chain — RSM →
+MemorySegmentIndexesCache (single-flight LoadingCache) → storage fetch →
+detransform — with the ISSUE 19 fault grammar at the storage seam.
+
+Pins:
+- an ``error`` fault surfaces as RemoteStorageException for every
+  IndexType (FaultInjectedError IS a StorageBackendException, so the
+  existing wrap applies);
+- a failed load is NOT cached — the next fetch_index heals;
+- ``flaky`` heals after its window through the same cache chain;
+- ``partial`` torn bytes on an ENCRYPTED index are refused (GCM tag),
+  never served, and never poison the cache;
+- a warm cache serves every IndexType through a total storage outage
+  (zero further storage reads — the decrypt-once, serve-many property).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tests.test_rsm_lifecycle import (
+    make_rsm,
+    make_segment_data,
+    make_segment_metadata,
+)
+from tieredstorage_tpu.errors import RemoteStorageException
+from tieredstorage_tpu.manifest.segment_indexes import IndexType
+from tieredstorage_tpu.utils import faults
+from tieredstorage_tpu.utils.faults import FaultPlane
+
+EXPECTED_INDEX_BYTES = {
+    IndexType.OFFSET: b"OFFSETIDX" * 16,
+    IndexType.TIMESTAMP: b"TIMEIDX" * 24,
+    IndexType.PRODUCER_SNAPSHOT: b"PRODSNAP" * 4,
+    IndexType.LEADER_EPOCH: b"leader-epoch-checkpoint-content",
+    IndexType.TRANSACTION: b"TXN" * 11,
+}
+
+ALL_INDEX_TYPES = sorted(EXPECTED_INDEX_BYTES, key=lambda t: t.name)
+
+
+@pytest.fixture(autouse=True)
+def _pristine_plane():
+    prior = faults.install(None)
+    yield
+    faults.install(prior)
+
+
+def uploaded_rsm(tmp_path, *, encryption=False):
+    metadata = make_segment_metadata()
+    data = make_segment_data(tmp_path, with_txn=True)
+    rsm, _ = make_rsm(tmp_path, False, encryption)
+    rsm.copy_log_segment_data(metadata, data)
+    return rsm, metadata
+
+
+@pytest.mark.parametrize("index_type", ALL_INDEX_TYPES, ids=lambda t: t.name)
+class TestPerIndexType:
+    def test_error_fault_surfaces_and_does_not_poison_cache(
+        self, tmp_path, index_type
+    ):
+        rsm, metadata = uploaded_rsm(tmp_path)
+        faults.install(FaultPlane.parse("storage.read:error@1"))
+        with pytest.raises(RemoteStorageException):
+            rsm.fetch_index(metadata, index_type)
+        # The failed load was NOT cached: the very next call (fault spent)
+        # loads cleanly through the same cache chain.
+        got = rsm.fetch_index(metadata, index_type).read()
+        assert got == EXPECTED_INDEX_BYTES[index_type]
+        rsm.close()
+
+    def test_flaky_fault_heals_through_cache_chain(self, tmp_path, index_type):
+        rsm, metadata = uploaded_rsm(tmp_path)
+        faults.install(FaultPlane.parse("storage.read:flaky=2"))
+        for _ in range(2):
+            with pytest.raises(RemoteStorageException):
+                rsm.fetch_index(metadata, index_type)
+        assert (
+            rsm.fetch_index(metadata, index_type).read()
+            == EXPECTED_INDEX_BYTES[index_type]
+        )
+        # Healed AND cached: serving again burns no storage call.
+        plane = faults.plane()
+        calls_before = plane.calls("storage.read")
+        assert (
+            rsm.fetch_index(metadata, index_type).read()
+            == EXPECTED_INDEX_BYTES[index_type]
+        )
+        assert plane.calls("storage.read") == calls_before
+        rsm.close()
+
+    def test_torn_encrypted_index_is_refused_then_heals(
+        self, tmp_path, index_type
+    ):
+        rsm, metadata = uploaded_rsm(tmp_path, encryption=True)
+        faults.install(FaultPlane.parse("storage.read:partial=5@1"))
+        # GCM tag over the index blob: torn ciphertext must never be
+        # served as index bytes.
+        with pytest.raises(Exception):
+            rsm.fetch_index(metadata, index_type)
+        # And must not have been cached: the retry round-trips.
+        assert (
+            rsm.fetch_index(metadata, index_type).read()
+            == EXPECTED_INDEX_BYTES[index_type]
+        )
+        rsm.close()
+
+
+class TestWarmCacheOutage:
+    def test_warm_cache_serves_all_types_through_total_outage(self, tmp_path):
+        rsm, metadata = uploaded_rsm(tmp_path)
+        for index_type, expected in EXPECTED_INDEX_BYTES.items():
+            assert rsm.fetch_index(metadata, index_type).read() == expected
+        # Total storage-read outage: every subsequent load would fail...
+        faults.install(FaultPlane.parse("storage.read:error"))
+        # ...but the warm cache serves every type, zero storage reads.
+        for index_type, expected in EXPECTED_INDEX_BYTES.items():
+            assert rsm.fetch_index(metadata, index_type).read() == expected
+        assert faults.plane().calls("storage.read") == 0
+        rsm.close()
+
+    def test_key_match_scopes_fault_to_indexes_object(self, tmp_path):
+        """The `~match` clause from the ISSUE 19 grammar: a fault pinned to
+        the `.indexes` key breaks fetch_index but not manifest fetches."""
+        rsm, metadata = uploaded_rsm(tmp_path)
+        faults.install(FaultPlane.parse("storage.read:error~.indexes"))
+        with pytest.raises(RemoteStorageException):
+            rsm.fetch_index(metadata, IndexType.OFFSET)
+        assert rsm.fetch_segment_manifest(metadata) is not None
+        rsm.close()

@@ -1,0 +1,274 @@
+"""Upload intent journal (ISSUE 20, storage/lifecycle.py): the durable WAL
+that names what a crash may strand.
+
+Pins: begin-before-first-byte durability (the record is on disk and
+replayable before begin_upload returns), commit/rollback/tombstone
+resolution, crash-artifact tolerance (torn trailing line), compaction,
+best-effort vs critical append failure policy, the ``lifecycle.journal``
+fault-plane site, and txn-id monotonicity across restarts.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import pytest
+
+from tieredstorage_tpu.storage.lifecycle import (
+    DELETE,
+    STAGE_INDEXES_UPLOADED,
+    STAGE_LOG_UPLOADED,
+    UPLOAD,
+    JournalAppendError,
+    UploadIntentJournal,
+)
+from tieredstorage_tpu.utils import faults
+from tieredstorage_tpu.utils.faults import FaultPlane
+
+
+@pytest.fixture(autouse=True)
+def _pristine_plane():
+    prior = faults.install(None)
+    yield
+    faults.install(prior)
+
+
+KEYS = ["t/s1.log", "t/s1.indexes", "t/s1.rsm-manifest"]
+
+
+def reopen(path):
+    return UploadIntentJournal(path)
+
+
+class TestIntentRoundTrip:
+    def test_begin_is_durable_before_return(self, tmp_path):
+        path = tmp_path / "wal" / "journal.jsonl"
+        with UploadIntentJournal(path) as j:
+            txn = j.begin_upload("seg-1", KEYS)
+            # The intent must be replayable from DISK at this instant — a
+            # kill -9 here is the exact scenario the journal exists for.
+            with reopen(path) as fresh:
+                (entry,) = fresh.pending()
+                assert entry.txn == txn
+                assert entry.kind == UPLOAD
+                assert entry.keys == KEYS
+
+    def test_commit_resolves(self, tmp_path):
+        path = tmp_path / "j.wal"
+        with UploadIntentJournal(path) as j:
+            txn = j.begin_upload("seg-1", KEYS)
+            j.commit(txn)
+            assert j.pending() == []
+            assert j.commits_total == 1
+        with reopen(path) as fresh:
+            assert fresh.pending() == []
+
+    def test_rollback_resolves(self, tmp_path):
+        path = tmp_path / "j.wal"
+        with UploadIntentJournal(path) as j:
+            txn = j.begin_upload("seg-1", KEYS)
+            j.rollback(txn)
+            assert j.pending() == []
+            assert j.rollbacks_total == 1
+
+    def test_stage_marks_survive_replay(self, tmp_path):
+        path = tmp_path / "j.wal"
+        with UploadIntentJournal(path) as j:
+            txn = j.begin_upload("seg-1", KEYS)
+            j.stage(txn, STAGE_LOG_UPLOADED)
+            j.stage(txn, STAGE_INDEXES_UPLOADED)
+        with reopen(path) as fresh:
+            (entry,) = fresh.pending()
+            assert entry.stage == STAGE_INDEXES_UPLOADED
+
+    def test_tombstone_round_trip(self, tmp_path):
+        path = tmp_path / "j.wal"
+        with UploadIntentJournal(path) as j:
+            txn = j.begin_delete("seg-1", KEYS)
+            assert j.pending_tombstone_count == 1
+        with reopen(path) as fresh:
+            (entry,) = fresh.pending_tombstones()
+            assert entry.kind == DELETE and entry.keys == KEYS
+            fresh.commit_delete(entry.txn)
+            assert fresh.pending() == []
+        with reopen(path) as again:
+            assert again.pending() == []
+
+    def test_txn_ids_monotonic_across_restarts(self, tmp_path):
+        path = tmp_path / "j.wal"
+        with UploadIntentJournal(path) as j:
+            t1 = j.begin_upload("a", KEYS)
+            j.commit(t1)
+        with reopen(path) as j2:
+            t2 = j2.begin_upload("b", KEYS)
+            assert t2 > t1
+
+    def test_resolving_unknown_txn_is_noop(self, tmp_path):
+        with UploadIntentJournal(tmp_path / "j.wal") as j:
+            j.commit(999)
+            j.rollback(999)
+            j.commit_delete(999)
+            j.stage(999, STAGE_LOG_UPLOADED)
+            assert j.commits_total == 0 and j.rollbacks_total == 0
+
+
+class TestCrashArtifacts:
+    def test_torn_trailing_line_is_tolerated(self, tmp_path):
+        path = tmp_path / "j.wal"
+        with UploadIntentJournal(path) as j:
+            j.begin_upload("seg-1", KEYS)
+        # Simulate dying mid-append: garbage half-record at the tail.
+        with open(path, "ab") as fh:
+            fh.write(b'{"rec": "beg')
+        with reopen(path) as fresh:
+            assert fresh.torn_records_total == 1
+            (entry,) = fresh.pending()  # the durable intent survived
+            assert entry.keys == KEYS
+
+    def test_unknown_record_kind_counts_as_torn(self, tmp_path):
+        path = tmp_path / "j.wal"
+        path.write_text(json.dumps({"rec": "wat", "txn": 1}) + "\n")
+        with UploadIntentJournal(path) as j:
+            assert j.torn_records_total == 1
+            assert j.pending() == []
+
+    def test_missing_file_is_a_fresh_journal(self, tmp_path):
+        with UploadIntentJournal(tmp_path / "sub" / "dir" / "j.wal") as j:
+            assert j.pending() == []
+            j.begin_upload("seg", KEYS)
+
+
+class TestCompaction:
+    def test_compact_keeps_only_pending(self, tmp_path):
+        path = tmp_path / "j.wal"
+        with UploadIntentJournal(path) as j:
+            for i in range(50):
+                j.commit(j.begin_upload(f"seg-{i}", KEYS))
+            keep = j.begin_upload("keeper", KEYS)
+            size_before = path.stat().st_size
+            j.compact()
+            assert path.stat().st_size < size_before
+            assert j.compactions_total == 1
+            (entry,) = j.pending()
+            assert entry.txn == keep
+        with reopen(path) as fresh:
+            (entry,) = fresh.pending()
+            assert entry.txn == keep and entry.keys == KEYS
+
+    def test_inline_compaction_bounds_the_file(self, tmp_path):
+        path = tmp_path / "j.wal"
+        with UploadIntentJournal(path, compact_bytes=2048) as j:
+            for i in range(200):
+                j.commit(j.begin_upload(f"seg-{i}", KEYS))
+            assert j.compactions_total >= 1
+            assert path.stat().st_size < 2048 + 1024
+
+
+class TestKindFidelity:
+    """The UPLOAD/DELETE kind split must survive every view
+    (pending_uploads / pending_tombstones / status) AND a
+    compact-then-replay cycle; inline compaction must fire at EXACTLY
+    compact_bytes. A flipped comparison in any of these silently
+    misclassifies what a crash stranded."""
+
+    def test_views_and_status_split_uploads_from_tombstones(self, tmp_path):
+        # Deliberately ASYMMETRIC counts (2 vs 1): a flipped kind
+        # comparison then produces the wrong number, not a mirror image.
+        with UploadIntentJournal(tmp_path / "j.wal") as j:
+            j.begin_upload("seg-u1", KEYS)
+            j.begin_upload("seg-u2", KEYS)
+            j.begin_delete("seg-d", KEYS)
+            assert sorted(e.segment for e in j.pending_uploads()) == [
+                "seg-u1", "seg-u2",
+            ]
+            assert [e.segment for e in j.pending_tombstones()] == ["seg-d"]
+            status = j.status()
+            assert status["pending_uploads"] == 2
+            assert status["pending_tombstones"] == 1
+
+    def test_compaction_preserves_kinds_across_replay(self, tmp_path):
+        path = tmp_path / "j.wal"
+        with UploadIntentJournal(path) as j:
+            j.begin_upload("seg-u", KEYS)
+            j.begin_delete("seg-d", KEYS)
+            j.commit(j.begin_upload("resolved", KEYS))
+            j.compact()
+        with reopen(path) as fresh:
+            assert [e.segment for e in fresh.pending_uploads()] == ["seg-u"]
+            assert [e.segment for e in fresh.pending_tombstones()] == ["seg-d"]
+
+    def test_inline_compaction_triggers_at_exact_threshold(self, tmp_path):
+        def run(base, compact_bytes):
+            base.mkdir()
+            with UploadIntentJournal(
+                base / "j.wal", compact_bytes=compact_bytes
+            ) as j:
+                j.begin_upload("pending", KEYS)
+                j.commit(j.begin_upload("resolved", KEYS))
+                return j.compactions_total, (base / "j.wal").stat().st_size
+
+        # Dry run with an unreachable threshold: measure the file size at
+        # the moment the post-resolve bound check runs.
+        compactions, size = run(tmp_path / "dry", 1 << 30)
+        assert compactions == 0
+        # At EXACTLY that size the bound is crossed (size < compact_bytes
+        # is false): the inline compaction must fire, not wait one more.
+        compactions, _ = run(tmp_path / "exact", size)
+        assert compactions == 1
+
+
+class TestAppendFailurePolicy:
+    def test_critical_append_failure_raises_and_strands_nothing(self, tmp_path):
+        path = tmp_path / "j.wal"
+        with UploadIntentJournal(path) as j:
+            faults.install(FaultPlane.parse("lifecycle.journal:error@1"))
+            with pytest.raises(JournalAppendError):
+                j.begin_upload("seg-1", KEYS)
+            faults.install(None)
+            assert j.pending() == []
+            assert j.append_failures_total == 1
+            # The journal recovers for the retried copy.
+            txn = j.begin_upload("seg-1", KEYS)
+            assert txn >= 1
+
+    def test_best_effort_commit_failure_is_swallowed_but_visible(self, tmp_path):
+        path = tmp_path / "j.wal"
+        with UploadIntentJournal(path) as j:
+            txn = j.begin_upload("seg-1", KEYS)
+            faults.install(FaultPlane.parse("lifecycle.journal:error@1"))
+            j.commit(txn)  # must NOT raise: the manifest already landed
+            faults.install(None)
+            assert j.append_failures_total == 1
+            # In-memory state resolved; only the FILE lost the record —
+            # exactly what the sweeper re-derives from the store.
+            assert j.pending() == []
+        with reopen(path) as fresh:
+            (entry,) = fresh.pending()  # replay sees the lost commit
+            assert entry.txn == txn
+
+    def test_tombstone_append_failure_raises(self, tmp_path):
+        with UploadIntentJournal(tmp_path / "j.wal") as j:
+            faults.install(FaultPlane.parse("lifecycle.journal:error@1"))
+            with pytest.raises(JournalAppendError):
+                j.begin_delete("seg-1", KEYS)
+
+
+class TestConcurrency:
+    def test_parallel_begins_get_unique_txns(self, tmp_path):
+        with UploadIntentJournal(tmp_path / "j.wal") as j:
+            txns: list[int] = []
+            lock = threading.Lock()
+
+            def worker(i: int) -> None:
+                t = j.begin_upload(f"seg-{i}", KEYS)
+                with lock:
+                    txns.append(t)
+
+            threads = [threading.Thread(target=worker, args=(i,)) for i in range(16)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert len(set(txns)) == 16
+            assert j.pending_upload_count == 16

@@ -1,0 +1,589 @@
+"""Convergent recovery sweeper (ISSUE 20, scrub/sweeper.py): the crash
+drill gate.
+
+The SIGKILL analogue: a BaseException subclass raised from the storage
+upload seam escapes ``except Exception`` in ``copy_log_segment_data``, so
+the in-process rollback never runs — store and journal are left EXACTLY as
+a kill -9 at that instant leaves them.  A fresh RSM over the same journal +
+store then recovers via its startup sweep.
+
+Gates pinned here (the ISSUE 20 acceptance criteria):
+- kill at each upload stage (after ``.log``, after ``.indexes``,
+  mid-manifest) leaves zero permanent orphans after ONE recovery sweep;
+- the post-sweep store listing equals the manifest-reachable set;
+- the retried copy round-trips byte-identically;
+- quarantined/corrupt manifests are never served (and heal + un-quarantine
+  once the retried copy lands);
+- a seeded adversarial test proves the sweeper cannot delete a
+  manifest-reachable object (one-sidedness);
+- tombstoned deletes converge and tombstones are GC'd;
+- non-journal-named orphans out-wait a grace window.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+
+import pytest
+
+from tests.test_rsm_lifecycle import (
+    make_rsm as _plain_make_rsm,
+    make_segment_bytes,
+    make_segment_data,
+    make_segment_metadata,
+    EXPECTED_MAIN,
+)
+from tieredstorage_tpu.errors import RemoteStorageException
+from tieredstorage_tpu.scrub.sweeper import (
+    RecoverySweeper,
+    SweeperInvariantError,
+    SweepScheduler,
+)
+from tieredstorage_tpu.storage.core import ObjectKey, StorageBackendException
+from tieredstorage_tpu.storage.lifecycle import UploadIntentJournal
+from tieredstorage_tpu.storage.memory import InMemoryStorage
+from tieredstorage_tpu.utils import faults
+from tieredstorage_tpu.utils.faults import FaultPlane
+
+
+@pytest.fixture(autouse=True)
+def _pristine_plane():
+    prior = faults.install(None)
+    yield
+    faults.install(prior)
+
+
+class SimulatedKill(BaseException):
+    """Escapes ``except Exception``: the in-process SIGKILL stand-in."""
+
+
+def make_rsm(tmp_path, **kw):
+    """test_rsm_lifecycle's RSM factory with the lifecycle plane armed
+    against a journal that SURVIVES rebuilds (same path every call)."""
+    extra = {
+        "lifecycle.enabled": True,
+        "lifecycle.journal.path": str(tmp_path / "lifecycle-journal.jsonl"),
+        "lifecycle.sweep.interval.ms": 3_600_000,  # paced sweeps dormant
+        "lifecycle.grace.ms": 3_600_000,  # only journal-named deletions
+        **kw.pop("extra_configs", {}),
+    }
+    return _plain_make_rsm(
+        tmp_path, kw.pop("compression", False), kw.pop("encryption", False),
+        extra_configs=extra, **kw,
+    )
+
+
+def listing(rsm):
+    return sorted(k.value for k in rsm._storage.list_objects("test/"))
+
+
+def manifest_reachable_set(rsm):
+    """The committed set, derived from the store alone."""
+    present = set(listing(rsm))
+    reachable = set()
+    for key in present:
+        if key.endswith(".rsm-manifest"):
+            stem = key[: -len(".rsm-manifest")]
+            for k in (key, stem + ".log", stem + ".indexes"):
+                if k in present:
+                    reachable.add(k)
+    return sorted(reachable)
+
+
+def crash_upload_on_call(rsm, n, torn_bytes=None):
+    """Arrange for the Nth storage upload to die mid-copy.  With
+    ``torn_bytes`` the object lands truncated first (a torn write), else
+    nothing of call N lands."""
+    real_upload = rsm._storage.upload
+    calls = {"n": 0}
+
+    def dying_upload(stream, key):
+        calls["n"] += 1
+        if calls["n"] == n:
+            if torn_bytes is not None:
+                real_upload(io.BytesIO(stream.read()[:torn_bytes]), key)
+            raise SimulatedKill(f"kill -9 during upload #{n} ({key})")
+        return real_upload(stream, key)
+
+    rsm._storage.upload = dying_upload
+
+
+STAGES = [
+    pytest.param(2, ["test/" + EXPECTED_MAIN + ".log"], id="after-log"),
+    pytest.param(
+        3,
+        ["test/" + EXPECTED_MAIN + ".indexes", "test/" + EXPECTED_MAIN + ".log"],
+        id="after-indexes",
+    ),
+]
+
+
+class TestCrashDrill:
+    @pytest.mark.parametrize("kill_call,expect_stranded", STAGES)
+    def test_one_sweep_recovers_kill_mid_copy(
+        self, tmp_path, kill_call, expect_stranded
+    ):
+        metadata = make_segment_metadata()
+        data = make_segment_data(tmp_path, with_txn=True)
+        rsm, storage_root = make_rsm(tmp_path)
+        crash_upload_on_call(rsm, kill_call)
+        with pytest.raises(SimulatedKill):
+            rsm.copy_log_segment_data(metadata, data)
+        # The "process" died: stranded objects, pending journal intent.
+        assert listing(rsm) == expect_stranded
+        assert rsm.lifecycle_journal.pending_upload_count == 1
+        rsm._sweep_scheduler.stop()
+
+        # Restart: a fresh RSM over the same store + journal.  Its startup
+        # sweep (lifecycle.sweep.on.start default True) IS the recovery.
+        rsm2, _ = make_rsm(tmp_path)
+        assert rsm2.recovery_sweeper.sweeps == 1
+        report = rsm2.recovery_sweeper.last_report
+        assert sorted(report.orphans_deleted) == expect_stranded
+        # Zero permanent orphans after ONE sweep; listing == reachable set.
+        assert listing(rsm2) == []
+        assert listing(rsm2) == manifest_reachable_set(rsm2)
+        assert rsm2.lifecycle_journal.pending() == []
+
+        # The retried copy round-trips byte-identically.
+        (tmp_path / "retry").mkdir(exist_ok=True)
+        retry_data = make_segment_data(tmp_path / "retry", with_txn=True)
+        rsm2.copy_log_segment_data(metadata, retry_data)
+        assert listing(rsm2) == manifest_reachable_set(rsm2)
+        assert len(listing(rsm2)) == 3
+        fetched = rsm2.fetch_log_segment(metadata, 0).read()
+        assert fetched == make_segment_bytes()
+        rsm2.close()
+
+    def test_torn_manifest_quarantined_then_healed(self, tmp_path):
+        """Kill MID-manifest: a truncated `.rsm-manifest` lands.  The sweep
+        quarantines it (unreadable) and the data keys stay protected; the
+        retried copy heals; the next sweep un-quarantines."""
+        metadata = make_segment_metadata()
+        data = make_segment_data(tmp_path, with_txn=True)
+        rsm, _ = make_rsm(tmp_path)
+        crash_upload_on_call(rsm, 3, torn_bytes=17)
+        with pytest.raises(SimulatedKill):
+            rsm.copy_log_segment_data(metadata, data)
+        assert len(listing(rsm)) == 3  # triple present, manifest torn
+        rsm._sweep_scheduler.stop()
+
+        rsm2, _ = make_rsm(tmp_path)
+        manifest_key = "test/" + EXPECTED_MAIN + ".rsm-manifest"
+        assert rsm2.recovery_sweeper.is_quarantined(manifest_key)
+        # Never served while quarantined — cold or cached.
+        with pytest.raises(RemoteStorageException, match="quarantine"):
+            rsm2.fetch_segment_manifest(metadata)
+        with pytest.raises(RemoteStorageException, match="quarantine"):
+            with rsm2.fetch_log_segment(metadata, 0) as s:
+                s.read()
+        # The quarantined manifest's surviving data keys are PROTECTED:
+        # the sweep deleted nothing.
+        assert rsm2.recovery_sweeper.last_report.orphans_deleted == []
+        assert len(listing(rsm2)) == 3
+
+        # Heal: the broker retries the copy (overwrite enabled).
+        (tmp_path / "retry").mkdir(exist_ok=True)
+        retry_data = make_segment_data(tmp_path / "retry", with_txn=True)
+        rsm2.copy_log_segment_data(metadata, retry_data)
+        rsm2.recovery_sweeper.sweep_once()
+        assert not rsm2.recovery_sweeper.is_quarantined(manifest_key)
+        assert rsm2.fetch_log_segment(metadata, 0).read() == make_segment_bytes()
+        assert listing(rsm2) == manifest_reachable_set(rsm2)
+        rsm2.close()
+
+    def test_manifest_referencing_missing_log_is_quarantined(self, tmp_path):
+        metadata = make_segment_metadata()
+        data = make_segment_data(tmp_path, with_txn=True)
+        rsm, _ = make_rsm(tmp_path)
+        rsm.copy_log_segment_data(metadata, data)
+        log_key = "test/" + EXPECTED_MAIN + ".log"
+        rsm._storage.delete(ObjectKey(log_key))
+        rsm.recovery_sweeper.sweep_once()
+        manifest_key = "test/" + EXPECTED_MAIN + ".rsm-manifest"
+        assert rsm.recovery_sweeper.is_quarantined(manifest_key)
+        with pytest.raises(RemoteStorageException, match="quarantine"):
+            rsm.fetch_segment_manifest(metadata)
+        # Counted + surfaced.
+        assert rsm.recovery_sweeper.quarantines_total == 1
+        assert manifest_key in rsm.lifecycle_status()["sweeper"][
+            "quarantined_manifests"
+        ]
+        rsm.close()
+
+    def test_crash_before_first_byte_resolves_cleanly(self, tmp_path):
+        metadata = make_segment_metadata()
+        data = make_segment_data(tmp_path, with_txn=True)
+        rsm, _ = make_rsm(tmp_path)
+        crash_upload_on_call(rsm, 1)
+        with pytest.raises(SimulatedKill):
+            rsm.copy_log_segment_data(metadata, data)
+        assert listing(rsm) == []
+        rsm._sweep_scheduler.stop()
+        rsm2, _ = make_rsm(tmp_path)
+        assert rsm2.lifecycle_journal.pending() == []  # intent resolved
+        assert rsm2.recovery_sweeper.last_report.orphans_deleted == []
+        rsm2.close()
+
+
+class TestRollbackCleanupFailure:
+    def test_cleanup_failure_is_counted_and_sweeper_converges(self, tmp_path):
+        """ISSUE 20 satellite: the once-swallowed orphan-cleanup failure is
+        now a counter + flight note, the journal entry stays PENDING, and
+        the recovery sweeper converges the stranded objects."""
+        from tieredstorage_tpu.storage.core import StorageBackendException
+
+        metadata = make_segment_metadata()
+        data = make_segment_data(tmp_path, with_txn=True)
+        rsm, _ = make_rsm(tmp_path)
+        crash_upload_on_call(rsm, 3)  # keep .log/.indexes, die on manifest
+        # ...but this time die with a plain Exception (broker-visible
+        # failure, NOT a kill) so the rollback path runs — and its deletes
+        # fail too (the outage that broke the upload breaks cleanup).
+        real_upload = rsm._storage.upload
+
+        def failing_upload(stream, key):
+            try:
+                return real_upload(stream, key)
+            except SimulatedKill as e:
+                raise IOError(str(e)) from None
+
+        rsm._storage.upload = failing_upload
+        real_delete = rsm._storage.delete
+        real_delete_all = rsm._storage.delete_all
+
+        def broken(*_a, **_k):
+            raise StorageBackendException("injected outage")
+
+        rsm._storage.delete = broken
+        rsm._storage.delete_all = broken
+        with pytest.raises(RemoteStorageException):
+            rsm.copy_log_segment_data(metadata, data)
+
+        [m] = rsm.metrics.registry.find(
+            "upload-rollback-cleanup-failures-total", {}
+        )
+        assert rsm.metrics.registry.value(m) == 1.0
+        assert rsm.lifecycle_journal.pending_upload_count == 1
+        assert len(listing(rsm)) == 2  # cleanup failed: objects stranded
+
+        # The storage heals; the next sweep converges without a restart.
+        rsm._storage.delete = real_delete
+        rsm._storage.delete_all = real_delete_all
+        rsm.recovery_sweeper.sweep_once()
+        assert listing(rsm) == []
+        assert rsm.lifecycle_journal.pending() == []
+        rsm.close()
+
+
+class TestTombstonedDeletes:
+    def test_delete_converges_and_tombstone_gcs(self, tmp_path):
+        metadata = make_segment_metadata()
+        data = make_segment_data(tmp_path, with_txn=True)
+        rsm, _ = make_rsm(tmp_path)
+        rsm.copy_log_segment_data(metadata, data)
+        journal = rsm.lifecycle_journal
+        rsm.delete_log_segment_data(metadata)
+        assert listing(rsm) == []
+        assert journal.pending_tombstone_count == 0
+        assert journal.tombstone_commits_total == 1
+        rsm.close()
+
+    def test_retried_delete_of_half_deleted_triple_succeeds(self, tmp_path):
+        metadata = make_segment_metadata()
+        data = make_segment_data(tmp_path, with_txn=True)
+        rsm, _ = make_rsm(tmp_path)
+        rsm.copy_log_segment_data(metadata, data)
+        rsm._storage.delete(ObjectKey("test/" + EXPECTED_MAIN + ".indexes"))
+        rsm.delete_log_segment_data(metadata)  # must not raise
+        assert listing(rsm) == []
+        rsm.delete_log_segment_data(metadata)  # and again (full retry)
+        assert listing(rsm) == []
+        rsm.close()
+
+    def test_crash_interrupted_delete_finished_by_sweeper(self, tmp_path):
+        """Manifest deleted, data still present, tombstone pending — the
+        exact state a kill -9 between the delete's two phases leaves."""
+        metadata = make_segment_metadata()
+        data = make_segment_data(tmp_path, with_txn=True)
+        rsm, _ = make_rsm(tmp_path)
+        rsm.copy_log_segment_data(metadata, data)
+        keys = ["test/" + EXPECTED_MAIN + s
+                for s in (".log", ".indexes", ".rsm-manifest")]
+        # Crash simulation: tombstone written, manifest-first phase done,
+        # then the process dies before the data phase.
+        rsm.lifecycle_journal.begin_delete("seg", keys)
+        rsm._storage.delete(ObjectKey(keys[2]))
+        rsm._sweep_scheduler.stop()
+
+        rsm2, _ = make_rsm(tmp_path)
+        # One startup sweep finished the delete and GC'd the tombstone.
+        assert listing(rsm2) == []
+        assert rsm2.lifecycle_journal.pending_tombstone_count == 0
+        assert rsm2.recovery_sweeper.tombstones_gcd_total == 1
+        rsm2.close()
+
+    def test_tombstone_never_widens_past_a_present_manifest(self, tmp_path):
+        """A pending tombstone whose manifest still exists (the delete
+        crashed BEFORE its manifest-first phase) must not let the sweeper
+        delete anything — completing it is the retried delete's job."""
+        metadata = make_segment_metadata()
+        data = make_segment_data(tmp_path, with_txn=True)
+        rsm, _ = make_rsm(tmp_path)
+        rsm.copy_log_segment_data(metadata, data)
+        keys = ["test/" + EXPECTED_MAIN + s
+                for s in (".log", ".indexes", ".rsm-manifest")]
+        rsm.lifecycle_journal.begin_delete("seg", keys)
+        report = rsm.recovery_sweeper.sweep_once()
+        assert report.orphans_deleted == []
+        assert len(listing(rsm)) == 3
+        assert rsm.lifecycle_journal.pending_tombstone_count == 1
+        # The retried delete converges it.
+        rsm.delete_log_segment_data(metadata)
+        rsm.recovery_sweeper.sweep_once()
+        assert listing(rsm) == []
+        assert rsm.lifecycle_journal.pending_tombstone_count == 0
+        rsm.close()
+
+
+class TestOneSidedness:
+    """The proof obligation: the sweeper may only ever delete
+    manifest-UNreachable objects."""
+
+    def _store_with(self, objects):
+        store = InMemoryStorage()
+        store.configure({})
+        for key, blob in objects.items():
+            store.upload(io.BytesIO(blob), ObjectKey(key))
+        return store
+
+    def _manifest_blob(self, indexes_size=10):
+        return json.dumps({"segment_indexes_total": indexes_size}).encode()
+
+    def _loader(self, store):
+        class _M:
+            class segment_indexes:
+                total_size = 10
+        def load(key):
+            with store.fetch(ObjectKey(key)) as s:
+                json.loads(s.read())  # unreadable JSON → raises → quarantine
+            return _M
+        return load
+
+    def test_seeded_adversarial_random_states(self):
+        import random
+
+        rng = random.Random(0xC0FFEE)
+        for trial in range(30):
+            objects = {}
+            committed_reachable = set()
+            journal_named = []
+            for i in range(rng.randint(1, 12)):
+                stem = f"p/seg-{trial}-{i}"
+                triple = [stem + ".log", stem + ".indexes",
+                          stem + ".rsm-manifest"]
+                shape = rng.random()
+                if shape < 0.5:
+                    # Committed: manifest + whatever data survived.
+                    objects[triple[2]] = self._manifest_blob()
+                    committed_reachable.add(triple[2])
+                    for k in triple[:2]:
+                        if rng.random() < 0.8:
+                            objects[k] = b"d" * rng.randint(1, 64)
+                            committed_reachable.add(k)
+                else:
+                    # Stranded: data only, no manifest.
+                    for k in triple[:2]:
+                        if rng.random() < 0.8:
+                            objects[k] = b"d" * rng.randint(1, 64)
+                    if rng.random() < 0.5:
+                        journal_named.append((stem, triple))
+            store = self._store_with(objects)
+            journal = None
+            if journal_named:
+                import tempfile
+                from pathlib import Path
+
+                tmp = tempfile.mkdtemp(prefix="adv-journal-")
+                journal = UploadIntentJournal(Path(tmp) / "j.wal")
+                for stem, triple in journal_named:
+                    journal.begin_upload(stem, triple)
+            sweeper = RecoverySweeper(
+                store, journal, prefix="p/", grace_s=0.0,
+                manifest_loader=self._loader(store),
+            )
+            sweeper.sweep_once()
+            sweeper.sweep_once()  # a second pass must change nothing more
+            left = {k.value for k in store.list_objects("p/")}
+            # EVERY manifest-reachable object survived...
+            assert committed_reachable <= left, f"trial {trial} deleted reachable"
+            # ...and with zero grace, ONLY the reachable set survived.
+            assert left == committed_reachable, f"trial {trial} kept orphans"
+            assert sweeper.invariant_blocks_total == 0
+            if journal is not None:
+                assert journal.pending() == []
+                journal.close()
+
+    def test_chokepoint_refuses_protected_keys(self):
+        store = self._store_with({"p/a.log": b"x", "p/a.rsm-manifest": b"{}"})
+        sweeper = RecoverySweeper(store, None, prefix="p/", grace_s=0.0,
+                                  manifest_loader=lambda k: None)
+        from tieredstorage_tpu.scrub.sweeper import SweepReport
+
+        with pytest.raises(SweeperInvariantError):
+            sweeper._delete_orphan(
+                "p/a.log", {"p/a.log"}, {"p/a.log"}, SweepReport()
+            )
+        with pytest.raises(SweeperInvariantError):
+            sweeper._delete_orphan(
+                "p/a.rsm-manifest", {"p/a.rsm-manifest"}, set(), SweepReport()
+            )
+        assert sweeper.invariant_blocks_total == 2
+        assert {k.value for k in store.list_objects("p/")} == {
+            "p/a.log", "p/a.rsm-manifest",
+        }
+
+
+class TestGraceWindow:
+    def test_unnamed_orphan_outwaits_grace(self):
+        store = InMemoryStorage()
+        store.configure({})
+        store.upload(io.BytesIO(b"x"), ObjectKey("p/foreign.log"))
+        now = [1000.0]
+        sweeper = RecoverySweeper(
+            store, None, prefix="p/", grace_s=60.0,
+            manifest_loader=lambda k: None, clock=lambda: now[0],
+        )
+        r1 = sweeper.sweep_once()
+        assert r1.orphans_deleted == [] and r1.orphans_pending == ["p/foreign.log"]
+        assert sweeper.orphans_pending == 1
+        now[0] += 30.0
+        assert sweeper.sweep_once().orphans_deleted == []  # still in grace
+        now[0] += 31.0
+        r3 = sweeper.sweep_once()
+        assert r3.orphans_deleted == ["p/foreign.log"]
+        assert sweeper.orphans_pending == 0
+        assert [k.value for k in store.list_objects("p/")] == []
+
+    def test_late_manifest_rescues_candidate(self):
+        """An in-flight upload from ANOTHER writer: its data keys enter the
+        grace ledger, then its manifest lands — the candidate must leave
+        the ledger untouched."""
+        store = InMemoryStorage()
+        store.configure({})
+        store.upload(io.BytesIO(b"x"), ObjectKey("p/s.log"))
+        now = [0.0]
+        sweeper = RecoverySweeper(
+            store, None, prefix="p/", grace_s=60.0,
+            manifest_loader=lambda k: None, clock=lambda: now[0],
+        )
+        sweeper.sweep_once()
+        store.upload(io.BytesIO(b"{}"), ObjectKey("p/s.rsm-manifest"))
+        now[0] += 120.0
+        report = sweeper.sweep_once()
+        assert report.orphans_deleted == []
+        assert sweeper.orphans_pending == 0
+        assert {k.value for k in store.list_objects("p/")} == {
+            "p/s.log", "p/s.rsm-manifest",
+        }
+
+
+class TestSchedulerAndFaults:
+    def test_sweep_fault_site_counts_and_recovers(self):
+        store = InMemoryStorage()
+        store.configure({})
+        sweeper = RecoverySweeper(store, None, prefix="p/",
+                                  manifest_loader=lambda k: None)
+        faults.install(FaultPlane.parse("lifecycle.sweep:error@1"))
+        with pytest.raises(Exception):
+            sweeper.sweep_once()
+        assert sweeper.sweep_failures_total == 1
+        sweeper.sweep_once()  # healed
+        faults.install(None)
+        assert sweeper.sweeps == 1
+
+    def test_scheduler_paces_and_survives_failures(self):
+        store = InMemoryStorage()
+        store.configure({})
+        sweeper = RecoverySweeper(store, None, prefix="p/",
+                                  manifest_loader=lambda k: None)
+        sched = SweepScheduler(sweeper, interval_ms=30_000, jitter_seed=0)
+        sched.start()
+        with pytest.raises(RuntimeError):
+            sched.start()
+        sched.run_now()
+        deadline = __import__("time").monotonic() + 5.0
+        while sweeper.sweeps == 0 and __import__("time").monotonic() < deadline:
+            __import__("time").sleep(0.01)
+        assert sweeper.sweeps >= 1
+        status = sched.status()
+        assert status["state"] in ("idle", "sweeping")
+        assert status["sweeps"] >= 1
+        sched.stop()
+        assert sched.status()["state"] == "stopped"
+
+
+class TestMutationBoundaries:
+    """Exact-boundary pins: the grace window is INCLUSIVE at grace_s, a
+    failed orphan delete keeps its tombstone PENDING (committing it would
+    leak the orphan forever), and the scheduler accepts its documented
+    1 ms floor. Each pins a comparison a mutation flip would invert."""
+
+    def test_grace_boundary_is_inclusive(self):
+        store = InMemoryStorage()
+        store.configure({})
+        store.upload(io.BytesIO(b"x"), ObjectKey("p/edge.log"))
+        now = [500.0]
+        sweeper = RecoverySweeper(
+            store, None, prefix="p/", grace_s=60.0,
+            manifest_loader=lambda k: None, clock=lambda: now[0],
+        )
+        first = sweeper.sweep_once()
+        assert first.orphans_pending == ["p/edge.log"]
+        # A frozen clock also pins the duration arithmetic: end - start.
+        assert first.duration_s == 0.0
+        now[0] += 60.0  # EXACTLY the window, not one tick past it
+        report = sweeper.sweep_once()
+        assert report.orphans_deleted == ["p/edge.log"]
+
+    def test_failed_orphan_delete_keeps_tombstone_pending(self, tmp_path):
+        store = InMemoryStorage()
+        store.configure({})
+        keys = ["p/s.log", "p/s.indexes", "p/s.rsm-manifest"]
+        for k in keys[:2]:  # the delete's manifest-first phase already ran
+            store.upload(io.BytesIO(b"x"), ObjectKey(k))
+        journal = UploadIntentJournal(tmp_path / "j.wal")
+        journal.begin_delete("s", keys)
+        real_delete = store.delete
+
+        def flaky_delete(key):
+            if key.value.endswith(".indexes"):
+                raise StorageBackendException("injected delete outage")
+            real_delete(key)
+
+        store.delete = flaky_delete
+        sweeper = RecoverySweeper(
+            store, journal, prefix="p/", grace_s=0.0,
+            manifest_loader=lambda k: None,
+        )
+        report = sweeper.sweep_once()
+        # .log went; .indexes survived its failed delete — the tombstone
+        # must stay pending so the next sweep retries it.
+        assert "p/s.indexes" in report.delete_failures
+        assert journal.pending_tombstone_count == 1
+        assert sweeper.tombstones_gcd_total == 0
+        store.delete = real_delete
+        sweeper.sweep_once()  # healed: the retry converges and GCs
+        assert journal.pending_tombstone_count == 0
+        assert sweeper.tombstones_gcd_total == 1
+        assert list(store.list_objects("p/")) == []
+
+    def test_scheduler_accepts_the_1ms_floor(self):
+        store = InMemoryStorage()
+        store.configure({})
+        sweeper = RecoverySweeper(store, None, prefix="p/",
+                                  manifest_loader=lambda k: None)
+        assert SweepScheduler(sweeper, interval_ms=1).interval_s == 0.001
+        with pytest.raises(ValueError):
+            SweepScheduler(sweeper, interval_ms=0)

@@ -126,6 +126,8 @@ SANCTIONED_THREAD_SPAWNS = {
         "anti-entropy daemon (one per RSM)",
     "tieredstorage_tpu/scrub/scheduler.py:ScrubScheduler.start":
         "scrub daemon (one per RSM)",
+    "tieredstorage_tpu/scrub/sweeper.py:SweepScheduler.start":
+        "recovery-sweep daemon (one per RSM, stopped via stop)",
     "tieredstorage_tpu/sidecar/http_gateway.py:SidecarHttpGateway.start":
         "gateway accept loop (workers ride the bounded executor)",
     "tieredstorage_tpu/fleet/gossip.py:GossipAgent.start":

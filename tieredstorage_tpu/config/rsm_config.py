@@ -367,7 +367,8 @@ def _base_def() -> ConfigDef:
         doc="Fault-plane rules 'site:kind[=arg][@trigger][~match]' "
             "(utils/faults.py) armed at RSM configure time — the same "
             "grammar as the TSTPU_FAULTS env var. site in [storage.read, "
-            "storage.write, peer.forward, gossip.probe, device.launch, *]; "
+            "storage.write, peer.forward, gossip.probe, device.launch, "
+            "lifecycle.journal, lifecycle.sweep, *]; "
             "kind in [error, latency, partial, flaky]; trigger '@N', "
             "'@every=K', '@from=N', '@p=P'; '~match' restricts to keys "
             "containing the substring. Empty (the default) installs "
@@ -579,6 +580,49 @@ def _base_def() -> ConfigDef:
             "(chunkChecksums) at upload, giving scrub passes at-rest ground "
             "truth without detransforming. Adds one batched CRC pass per "
             "upload window (ops/crc32c).",
+    ))
+    d.define(ConfigKey(
+        "lifecycle.enabled", "bool", default=False, importance="medium",
+        doc="Arm the crash-consistent segment lifecycle plane (ISSUE 20): "
+            "an upload intent journal (storage/lifecycle.py) records "
+            "{segment, expected keys} before the first uploaded byte and "
+            "marks commit when the manifest lands; delete tombstones make "
+            "retried/crash-interrupted deletes converge; the recovery "
+            "sweeper (scrub/sweeper.py) reconciles journal + store listing "
+            "against manifest reachability on startup and on a paced "
+            "period. Requires lifecycle.journal.path.",
+    ))
+    d.define(ConfigKey(
+        "lifecycle.journal.path", "string", default=None,
+        validator=non_empty_string, importance="medium",
+        doc="Filesystem path of the upload intent journal (append-only "
+            "JSONL WAL, fsynced per intent record, compacted in place). "
+            "Must survive process restarts — put it next to the broker's "
+            "log dirs, NOT on tmpfs. Required when lifecycle.enabled.",
+    ))
+    d.define(ConfigKey(
+        "lifecycle.sweep.interval.ms", "long", default=300_000,
+        validator=in_range(1, None), importance="medium",
+        doc="Period between recovery sweeps; the first scheduled sweep "
+            "starts after a random jitter in [0, interval) so restarting "
+            "fleets don't synchronize their listing load.",
+    ))
+    d.define(ConfigKey(
+        "lifecycle.sweep.on.start", "bool", default=True, importance="medium",
+        doc="Run one synchronous recovery sweep during configure(), before "
+            "serving — the crash-recovery path: anything the journal names "
+            "as stranded by a previous process is deleted in this first "
+            "sweep (zero permanent orphans after one sweep).",
+    ))
+    d.define(ConfigKey(
+        "lifecycle.grace.ms", "long", default=300_000,
+        validator=in_range(0, None), importance="low",
+        doc="Grace window for orphan candidates the journal does NOT name "
+            "(another writer's in-flight upload, a foreign journal's "
+            "crash): deleted only after staying manifest-unreachable this "
+            "long past the sweeper first seeing them. Journal-named "
+            "orphans need no grace — the journal proves no commit "
+            "happened.",
     ))
     d.define(ConfigKey(
         "flight.enabled", "bool", default=False, importance="medium",
@@ -1035,6 +1079,26 @@ class RemoteStorageManagerConfig:
     @property
     def scrub_checksums_enabled(self) -> bool:
         return self._values["scrub.checksums.enabled"]
+
+    @property
+    def lifecycle_enabled(self) -> bool:
+        return self._values["lifecycle.enabled"]
+
+    @property
+    def lifecycle_journal_path(self) -> Optional[str]:
+        return self._values["lifecycle.journal.path"]
+
+    @property
+    def lifecycle_sweep_interval_ms(self) -> int:
+        return self._values["lifecycle.sweep.interval.ms"]
+
+    @property
+    def lifecycle_sweep_on_start(self) -> bool:
+        return self._values["lifecycle.sweep.on.start"]
+
+    @property
+    def lifecycle_grace_ms(self) -> int:
+        return self._values["lifecycle.grace.ms"]
 
     @property
     def flight_enabled(self) -> bool:

@@ -150,6 +150,35 @@ def _collect_scrub() -> dict[str, list[str]]:
     return _group_names(registry)
 
 
+def _collect_lifecycle() -> dict[str, list[str]]:
+    import tempfile
+    from pathlib import Path
+
+    from tieredstorage_tpu.metrics.core import MetricsRegistry
+    from tieredstorage_tpu.metrics.lifecycle_metrics import (
+        register_lifecycle_metrics,
+    )
+    from tieredstorage_tpu.scrub.sweeper import RecoverySweeper, SweepScheduler
+    from tieredstorage_tpu.storage.lifecycle import UploadIntentJournal
+    from tieredstorage_tpu.storage.memory import InMemoryStorage
+
+    registry = MetricsRegistry()
+    with tempfile.TemporaryDirectory() as tmp:
+        journal = UploadIntentJournal(Path(tmp) / "journal.jsonl")
+        store = InMemoryStorage()
+        store.configure({})
+        sweeper = RecoverySweeper(
+            store, journal, manifest_loader=lambda key: None
+        )
+        register_lifecycle_metrics(
+            registry,
+            journal=journal,
+            sweeper=sweeper,
+            scheduler=SweepScheduler(sweeper, interval_ms=60_000),
+        )
+        return _group_names(registry)
+
+
 def _collect_slo() -> dict[str, list[str]]:
     from tieredstorage_tpu.metrics.core import MetricsRegistry
     from tieredstorage_tpu.metrics.slo import RatioSource, SloEngine, SloSpec
@@ -302,6 +331,7 @@ def generate() -> str:
         ("Replication metrics", _collect_replication()),
         ("Fleet metrics", _collect_fleet()),
         ("Scrubber metrics", _collect_scrub()),
+        ("Segment-lifecycle metrics", _collect_lifecycle()),
         ("SLO metrics", _collect_slo()),
         ("Tracer metrics", _collect_tracer()),
         ("Storage backend client metrics", _collect_backends()),

@@ -157,6 +157,16 @@ class Metrics:
         for tags in self._scopes(topic, partition):
             self._count_rate_total("upload-rollbacks", tags)
 
+    def record_upload_rollback_cleanup_failure(
+        self, topic: str, partition: int
+    ) -> None:
+        """The best-effort orphan cleanup of a failed copy ITSELF failed —
+        partial objects remain until the recovery sweeper (or the
+        scrubber's orphan pass) converges them.  The PR 14 "no invisible
+        swallows" rule: this was a bare log.warning before ISSUE 20."""
+        for tags in self._scopes(topic, partition):
+            self._count_rate_total("upload-rollback-cleanup-failures", tags)
+
     def record_hedge_win(self, ms: float) -> None:
         """A hedged chunk fetch where the hedge beat the straggling primary;
         `ms` is the full call latency (primary start → hedge completion)."""

@@ -167,6 +167,11 @@ class RemoteStorageManager:
         self._fault_schedule = None
         self._scrubber = None
         self._scrub_scheduler = None
+        #: Crash-consistent lifecycle plane (`lifecycle.*`, ISSUE 20):
+        #: upload intent journal + convergent recovery sweeper.
+        self._lifecycle_journal = None
+        self._sweeper = None
+        self._sweep_scheduler = None
         self._replicated: Optional[ReplicatedStorageBackend] = None
         self._antientropy = None
         self._antientropy_scheduler = None
@@ -259,6 +264,7 @@ class RemoteStorageManager:
         register_tracer_metrics(self._metrics.registry, self.tracer)
         self._wire_replication(config)
         self._wire_scrubber(config)
+        self._wire_lifecycle(config)
         self._wire_slo(config)
         self._wire_fleet_telemetry(config)
 
@@ -492,6 +498,7 @@ class RemoteStorageManager:
         manifest_key = ObjectKey(f"{base}.{Suffix.MANIFEST.value}")
         with ensure_deadline(self.default_deadline_s):
             check_deadline("fleet chunk serve")
+            self._check_not_quarantined(manifest_key)
             manifest = self._manifest_lookahead.get(
                 manifest_key, lambda: self._fetch_manifest_by_key(manifest_key)
             )
@@ -562,6 +569,89 @@ class RemoteStorageManager:
             config.scrub_interval_ms, config.scrub_rate_bytes,
             config.scrub_repair_enabled,
         )
+
+    def _wire_lifecycle(self, config: RemoteStorageManagerConfig) -> None:
+        """Crash-consistent lifecycle plane (`lifecycle.*`, ISSUE 20): the
+        upload intent journal names what a crash may strand BEFORE the
+        first uploaded byte; the recovery sweeper reconciles journal +
+        store listing against manifest reachability — synchronously once
+        at startup (the crash-recovery path), then on a paced period.
+        Manifest-last upload stays the sole commit point; the sweeper may
+        only ever delete manifest-UNreachable objects."""
+        if not config.lifecycle_enabled:
+            return
+        from tieredstorage_tpu.config.configdef import ConfigException
+        from tieredstorage_tpu.metrics.lifecycle_metrics import (
+            register_lifecycle_metrics,
+        )
+        from tieredstorage_tpu.scrub.sweeper import RecoverySweeper, SweepScheduler
+        from tieredstorage_tpu.storage.lifecycle import UploadIntentJournal
+
+        if not config.lifecycle_journal_path:
+            raise ConfigException(
+                "lifecycle.enabled requires lifecycle.journal.path"
+            )
+        self._lifecycle_journal = UploadIntentJournal(
+            Path(config.lifecycle_journal_path)
+        )
+
+        def load_manifest(manifest_key: str) -> SegmentManifestV1:
+            return self._fetch_manifest_raw(ObjectKey(manifest_key))
+
+        self._sweeper = RecoverySweeper(
+            self._storage,
+            self._lifecycle_journal,
+            prefix=config.key_prefix,
+            grace_s=config.lifecycle_grace_ms / 1000.0,
+            manifest_loader=load_manifest,
+            tracer=self.tracer,
+        )
+        if config.lifecycle_sweep_on_start:
+            try:
+                report = self._sweeper.sweep_once()
+                if report.orphans_deleted or report.quarantined:
+                    log.info(
+                        "Startup recovery sweep: %d orphan(s) deleted, "
+                        "%d manifest(s) quarantined",
+                        len(report.orphans_deleted), len(report.quarantined),
+                    )
+            except Exception:  # noqa: BLE001 — recovery must not block startup
+                log.warning("Startup recovery sweep failed; the paced "
+                            "scheduler will retry", exc_info=True)
+        self._sweep_scheduler = SweepScheduler(
+            self._sweeper, interval_ms=config.lifecycle_sweep_interval_ms
+        ).start()
+        register_lifecycle_metrics(
+            self._metrics.registry, self._lifecycle_journal, self._sweeper,
+            self._sweep_scheduler,
+        )
+        log.info(
+            "Lifecycle plane enabled: journal=%s sweep_interval=%dms "
+            "grace=%dms",
+            config.lifecycle_journal_path, config.lifecycle_sweep_interval_ms,
+            config.lifecycle_grace_ms,
+        )
+
+    @property
+    def lifecycle_journal(self):
+        return self._lifecycle_journal
+
+    @property
+    def recovery_sweeper(self):
+        return self._sweeper
+
+    @property
+    def sweep_scheduler(self):
+        return self._sweep_scheduler
+
+    def lifecycle_status(self) -> dict:
+        """JSON-shaped lifecycle plane status (journal + sweeper)."""
+        if self._lifecycle_journal is None:
+            raise RemoteStorageException("lifecycle plane is not enabled")
+        out = {"journal": self._lifecycle_journal.status()}
+        if self._sweep_scheduler is not None:
+            out["sweeper"] = self._sweep_scheduler.status()
+        return out
 
     def _wire_slo(self, config: RemoteStorageManagerConfig) -> None:
         """SLO engine (`slo.*`, ISSUE 14): declarative objectives over the
@@ -1113,19 +1203,27 @@ class RemoteStorageManager:
         )
 
         uploaded_keys: list[ObjectKey] = []
+        # Intent BEFORE the first uploaded byte: a kill -9 anywhere past
+        # this line leaves a journal entry naming exactly the keys the
+        # recovery sweeper may find stranded.  Manifest-last stays the sole
+        # commit point — the journal only names, it never commits.
+        txn = self._journal_begin_upload(metadata)
         try:
             chunk_index, chunk_checksums = self._upload_segment_log(
                 metadata, segment_data, requires_compression, data_key,
                 custom_builder, uploaded_keys,
             )
+            self._journal_stage(txn, "log-uploaded")
             segment_indexes = self._upload_indexes(
                 metadata, segment_data, data_key, custom_builder, uploaded_keys
             )
+            self._journal_stage(txn, "indexes-uploaded")
             self._upload_manifest(
                 metadata, chunk_index, segment_indexes, requires_compression,
                 data_key, custom_builder, uploaded_keys,
                 chunk_checksums=chunk_checksums,
             )
+            self._journal_commit(txn)
         except Exception as e:
             # Orphan cleanup: a failed copy must not leave partial objects
             # (reference :258-267); the broker will retry the whole copy.
@@ -1138,10 +1236,22 @@ class RemoteStorageManager:
                 )
                 try:
                     self._delete_keys(uploaded_keys)
+                    self._journal_rollback(txn)
                 except Exception:
+                    # Cleanup failure is visible, not just logged (the PR 14
+                    # "no invisible swallows" rule): counted per scope,
+                    # noted on the ambient flight record, and the journal
+                    # entry stays PENDING so the recovery sweeper converges
+                    # the stranded objects on its next pass.
+                    self._metrics.record_upload_rollback_cleanup_failure(
+                        topic, partition
+                    )
+                    flight.note("upload.rollback_cleanup_failures")
                     log.warning(
                         "Failed to clean up partial upload for %s", metadata, exc_info=True
                     )
+            else:
+                self._journal_rollback(txn)
             if isinstance(e, (RemoteStorageException, DeadlineExceededException)):
                 # DeadlineExceededException stays distinct end to end so the
                 # boundaries map it to 504 / DEADLINE_EXCEEDED.
@@ -1189,6 +1299,39 @@ class RemoteStorageManager:
             compression_codec=config.compression_codec,
             encryption=data_key,
         )
+
+    # ------------------------------------------------- lifecycle journal hooks
+    def _journal_begin_upload(self, metadata) -> Optional[int]:
+        """Record upload intent (`lifecycle.enabled`); None when disabled.
+        A failed intent append fails the copy while the store is still
+        clean — the store must never hold state the journal cannot name."""
+        if self._lifecycle_journal is None:
+            return None
+        from tieredstorage_tpu.storage.lifecycle import JournalAppendError
+
+        keys = [
+            self._object_key_factory.key(metadata, suffix).value
+            for suffix in Suffix
+        ]
+        segment = str(metadata.remote_log_segment_id.id)
+        try:
+            return self._lifecycle_journal.begin_upload(segment, keys)
+        except JournalAppendError as e:
+            raise RemoteStorageException(
+                f"Upload intent journal append failed for {metadata}"
+            ) from e
+
+    def _journal_stage(self, txn: Optional[int], stage: str) -> None:
+        if txn is not None and self._lifecycle_journal is not None:
+            self._lifecycle_journal.stage(txn, stage)
+
+    def _journal_commit(self, txn: Optional[int]) -> None:
+        if txn is not None and self._lifecycle_journal is not None:
+            self._lifecycle_journal.commit(txn)
+
+    def _journal_rollback(self, txn: Optional[int]) -> None:
+        if txn is not None and self._lifecycle_journal is not None:
+            self._lifecycle_journal.rollback(txn)
 
     def _storage_upload(self, stream: BinaryIO, key) -> int:
         """Segment-object upload chokepoint: the ``storage.write`` injection
@@ -1330,6 +1473,9 @@ class RemoteStorageManager:
         # cache's loader pool (the storage GET itself runs on that pool and
         # records its own storage.fetch_manifest root span).
         with self.tracer.span("rsm.fetch_manifest", key=key.value):
+            # Quarantine gate BEFORE the cache: a manifest cached while
+            # healthy stops being served the moment the sweeper flags it.
+            self._check_not_quarantined(key)
             # Through the lookahead: a boundary crossing whose manifest a
             # readahead continuation already started resolving JOINS that
             # flight instead of stalling on a second fetch+parse.
@@ -1338,6 +1484,13 @@ class RemoteStorageManager:
             )
 
     def _fetch_manifest_by_key(self, key: ObjectKey) -> SegmentManifestV1:
+        self._check_not_quarantined(key)
+        return self._fetch_manifest_raw(key)
+
+    def _fetch_manifest_raw(self, key: ObjectKey) -> SegmentManifestV1:
+        """Fetch + parse WITHOUT the quarantine gate — the recovery
+        sweeper's loader: quarantine is recomputed from readability every
+        sweep, so a healed manifest must be loadable to un-quarantine."""
         try:
             with self.tracer.span("storage.fetch_manifest", key=key.value), \
                     self._storage.fetch(key) as stream:
@@ -1346,6 +1499,19 @@ class RemoteStorageManager:
             raise RemoteResourceNotFoundException(str(e)) from e
         decoder = self._rsa.data_key_decoder if self._rsa is not None else None
         return manifest_from_json(text, data_key_decoder=decoder)
+
+    def _check_not_quarantined(self, key: ObjectKey) -> None:
+        """Quarantined manifests (unreadable, or referencing missing
+        objects — see scrub/sweeper.py) are NEVER served: a half-present
+        segment must fail fast and loud, not half-serve.  Checked on the
+        cache path too, so a manifest cached before its quarantine stops
+        being served the moment the sweeper flags it."""
+        if self._sweeper is not None and self._sweeper.is_quarantined(key.value):
+            raise RemoteStorageException(
+                f"Manifest {key.value} is quarantined by the recovery "
+                "sweeper (incomplete or unreadable segment); refusing to "
+                "serve it"
+            )
 
     @_traced("rsm.fetch_log_segment")
     def fetch_log_segment(
@@ -1438,8 +1604,15 @@ class RemoteStorageManager:
     def _fetch_index_bytes(
         self, key: ObjectKey, byte_range: BytesRange, manifest: SegmentManifestV1
     ) -> bytes:
+        # Same `storage.read` injection seam as the chunk path
+        # (chunk_manager._fetch_stored): `error` propagates as a backend
+        # failure, `partial` tears the bytes so the encrypted detransform's
+        # tag check must refuse them instead of serving a torn index.
+        torn = faults.fire("storage.read", key.value)
         with self._storage.fetch(key, byte_range) as stream:
             blob = stream.read()
+        if torn:
+            blob = faults.mutate(blob, torn)
         opts = DetransformOptions(
             compression=False,
             encryption=(
@@ -1462,7 +1635,18 @@ class RemoteStorageManager:
         start = time.monotonic()
         try:
             keys = [self._object_key(metadata, s) for s in Suffix]
-            self._delete_keys(keys)
+            # Tombstone BEFORE the first delete (`lifecycle.enabled`): a
+            # crash-interrupted delete converges because the recovery
+            # sweeper finishes what the tombstone names.  Then the manifest
+            # goes FIRST: every crash point past it leaves only
+            # manifest-UNreachable leftovers, which keeps the sweeper's
+            # one-sidedness license sufficient to finish the job.
+            txn = self._journal_begin_delete(metadata, keys)
+            manifest_keys = [k for k in keys if k.value.endswith(Suffix.MANIFEST.value)]
+            data_keys = [k for k in keys if not k.value.endswith(Suffix.MANIFEST.value)]
+            self._delete_keys(manifest_keys, total=len(keys))
+            self._delete_keys(data_keys, total=len(keys))
+            self._journal_commit_delete(txn)
         except RemoteStorageException:
             self._metrics.record_segment_delete_error(topic, partition)
             raise
@@ -1473,18 +1657,44 @@ class RemoteStorageManager:
             topic, partition, (time.monotonic() - start) * 1000.0
         )
 
-    def _delete_keys(self, keys: list[ObjectKey]) -> None:
+    def _journal_begin_delete(self, metadata, keys: list[ObjectKey]) -> Optional[int]:
+        """Record delete intent; a failed tombstone append fails the delete
+        before any object is removed (the broker retries)."""
+        if self._lifecycle_journal is None:
+            return None
+        from tieredstorage_tpu.storage.lifecycle import JournalAppendError
+
+        segment = str(metadata.remote_log_segment_id.id)
+        try:
+            return self._lifecycle_journal.begin_delete(
+                segment, [k.value for k in keys]
+            )
+        except JournalAppendError as e:
+            raise RemoteStorageException(
+                f"Delete tombstone append failed for {metadata}"
+            ) from e
+
+    def _journal_commit_delete(self, txn: Optional[int]) -> None:
+        if txn is not None and self._lifecycle_journal is not None:
+            self._lifecycle_journal.commit_delete(txn)
+
+    def _delete_keys(
+        self, keys: list[ObjectKey], *, total: Optional[int] = None
+    ) -> None:
         """Idempotent multi-delete: bulk fast path, then a per-key sweep on
         failure — missing keys (KeyNotFoundException) are fine (a retried
         delete or a partially-failed bulk call must converge), every other
         per-key failure is collected and surfaced as ONE
-        RemoteStorageException after the sweep finishes."""
+        RemoteStorageException after the sweep finishes.  ``total`` is the
+        size of the logical delete set when the caller splits it across
+        phases (manifest-first), so the aggregate message counts failures
+        against the whole segment, not one phase."""
         if self._storage is None or not keys:
             return
         with self.tracer.span("storage.delete_keys", keys=len(keys)):
-            self._delete_keys_traced(keys)
+            self._delete_keys_traced(keys, len(keys) if total is None else total)
 
-    def _delete_keys_traced(self, keys: list[ObjectKey]) -> None:
+    def _delete_keys_traced(self, keys: list[ObjectKey], total: int) -> None:
         try:
             self._storage.delete_all(keys)
             return
@@ -1501,7 +1711,7 @@ class RemoteStorageManager:
         if failures:
             detail = "; ".join(f"{key}: {e}" for key, e in failures)
             raise RemoteStorageException(
-                f"Failed to delete {len(failures)}/{len(keys)} keys: {detail}"
+                f"Failed to delete {len(failures)}/{total} keys: {detail}"
             ) from failures[0][1]
 
     def close(self) -> None:
@@ -1513,6 +1723,10 @@ class RemoteStorageManager:
             self._antientropy_scheduler.stop()
         if self._scrub_scheduler is not None:
             self._scrub_scheduler.stop()
+        if self._sweep_scheduler is not None:
+            self._sweep_scheduler.stop()
+        if self._lifecycle_journal is not None:
+            self._lifecycle_journal.close()
         if self._replicated is not None:
             self._replicated.close()
         if self._hedger is not None:

@@ -21,15 +21,25 @@ from tieredstorage_tpu.scrub.scrubber import (
     ScrubReport,
     Scrubber,
 )
+from tieredstorage_tpu.scrub.sweeper import (
+    RecoverySweeper,
+    SweeperInvariantError,
+    SweepReport,
+    SweepScheduler,
+)
 
 __all__ = [
     "INDEXES_SUFFIX",
     "LOG_SUFFIX",
     "MANIFEST_SUFFIX",
     "SCRUB_METRIC_GROUP",
+    "RecoverySweeper",
     "ScrubFinding",
     "ScrubMetrics",
     "ScrubReport",
     "ScrubScheduler",
     "Scrubber",
+    "SweepReport",
+    "SweepScheduler",
+    "SweeperInvariantError",
 ]

@@ -9,7 +9,8 @@ tier and gate the policy invariants per cell. A rule is
     site ":" kind ["=" arg] ["@" trigger] ["~" match]
 
 - site: ``storage.read`` | ``storage.write`` | ``peer.forward`` |
-  ``gossip.probe`` | ``device.launch`` | ``*`` (any site)
+  ``gossip.probe`` | ``device.launch`` | ``lifecycle.journal`` |
+  ``lifecycle.sweep`` | ``*`` (any site)
 - kind:
     - ``error`` — raise FaultInjectedError (a StorageBackendException, so
       it propagates — and classifies as retryable — exactly like a real
@@ -61,6 +62,10 @@ SITES = (
     "peer.forward",
     "gossip.probe",
     "device.launch",
+    # Crash-consistent lifecycle plane (ISSUE 20): intent-journal appends
+    # and recovery-sweeper passes are first-class failure seams too.
+    "lifecycle.journal",
+    "lifecycle.sweep",
 )
 KINDS = ("error", "latency", "partial", "flaky")
 #: Sites whose payload bytes a ``partial`` rule may mutate.

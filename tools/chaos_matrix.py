@@ -3,9 +3,10 @@
 Sweeps every fault kind the ``FaultPlane`` speaks (``error`` / ``latency``
 / ``partial`` / ``flaky``; ``partial`` on the data-bearing sites only)
 across every I/O seam the retry plane guards — the storage read and write
-chokepoints, the peer-forward hop, the gossip probe round trip, and the
-merged GCM device launch — and gates each cell on the policy invariants,
-judged with real component harnesses, not mocks:
+chokepoints, the peer-forward hop, the gossip probe round trip, the
+merged GCM device launch, and the crash-consistent lifecycle plane's
+journal-append and recovery-sweep seams (ISSUE 20) — and gates each cell
+on the policy invariants, judged with real component harnesses, not mocks:
 
 - **integrity** — zero byte corruption: every byte a harness serves while
   its seam is being torn/failed must equal the source bytes, and torn
@@ -42,6 +43,7 @@ from __future__ import annotations
 import argparse
 import contextlib
 import http.server
+import io
 import json
 import pathlib
 import random
@@ -105,6 +107,16 @@ CELLS = [
     ("device.launch", "error", "device.launch:error"),
     ("device.launch", "latency", "device.launch:latency=20"),
     ("device.launch", "flaky", "device.launch:flaky=1"),
+    # Crash-consistent lifecycle plane (ISSUE 20). Every lifecycle cell's
+    # recovery phase also runs the kill-mid-copy drill at each of the
+    # three upload stages (after .log, after .indexes, mid-manifest) and
+    # gates on ONE recovery sweep leaving zero permanent orphans.
+    ("lifecycle.journal", "error", "lifecycle.journal:error"),
+    ("lifecycle.journal", "latency", "lifecycle.journal:latency=5"),
+    ("lifecycle.journal", "flaky", "lifecycle.journal:flaky=2"),
+    ("lifecycle.sweep", "error", "lifecycle.sweep:error"),
+    ("lifecycle.sweep", "latency", "lifecycle.sweep:latency=5"),
+    ("lifecycle.sweep", "flaky", "lifecycle.sweep:flaky=1"),
 ]
 
 
@@ -751,6 +763,190 @@ def run_device_cell(device: DeviceHarness, cell: Cell, seed: int) -> dict:
     return cell.verdict(ledger_delta(before), plane.snapshot())
 
 
+# ----------------------------------------------------------- lifecycle harness
+class _Kill(BaseException):
+    """Escapes ``except Exception`` in copy_log_segment_data: the tool's
+    in-process SIGKILL stand-in (same idiom as tests/test_recovery_sweeper)."""
+
+
+class LifecycleHarness:
+    """An RSM with the crash-consistent lifecycle plane armed (intent
+    journal + recovery sweeper) over ``InMemoryStorage``.  Ops are whole
+    copy→fetch round trips; the recovery phase of every lifecycle cell runs
+    the kill-mid-copy drill at all three upload stages and gates each on
+    one sweep converging the store to the manifest-reachable set."""
+
+    PREFIX = "lifecycle/"
+
+    def __init__(self, workdir: pathlib.Path) -> None:
+        self.workdir = workdir
+        self.rsm = RemoteStorageManager()
+        self.rsm.configure({
+            "storage.backend.class": "tieredstorage_tpu.storage.memory.InMemoryStorage",
+            "chunk.size": CHUNK_SIZE,
+            "key.prefix": self.PREFIX,
+            "lifecycle.enabled": True,
+            "lifecycle.journal.path": str(workdir / "intent-journal.jsonl"),
+            "lifecycle.sweep.on.start": False,
+            "lifecycle.sweep.interval.ms": 3_600_000,
+            "lifecycle.grace.ms": 3_600_000,
+        })
+        self._next_tag = 1
+
+    def close(self) -> None:
+        self.rsm.close()
+
+    def segment(self) -> tuple:
+        tag = self._next_tag
+        self._next_tag += 1
+        return make_segment(self.workdir, tag)
+
+    def _listing(self) -> list[str]:
+        return sorted(
+            k.value for k in self.rsm._storage.list_objects(self.PREFIX)
+        )
+
+    def _manifest_reachable(self) -> list[str]:
+        present = set(self._listing())
+        reachable = set()
+        for key in present:
+            if key.endswith(".rsm-manifest"):
+                stem = key[: -len(".rsm-manifest")]
+                reachable.update(
+                    k for k in (key, stem + ".log", stem + ".indexes")
+                    if k in present
+                )
+        return sorted(reachable)
+
+    def copy_fetch_ok(self, cell: Cell) -> bool:
+        metadata, data, original = self.segment()
+        try:
+            self.rsm.copy_log_segment_data(metadata, data)
+            with self.rsm.fetch_log_segment(metadata, 0) as s:
+                got = s.read()
+        except Exception:  # noqa: BLE001 - clean failure is the contract
+            cell.count(False)
+            return False
+        if got != original:
+            cell.corruptions += 1
+            cell.count(False)
+            return False
+        cell.count(True)
+        return True
+
+    def sweep_ok(self, cell: Cell) -> bool:
+        try:
+            self.rsm.recovery_sweeper.sweep_once()
+        except Exception:  # noqa: BLE001 - clean failure is the contract
+            cell.count(False)
+            return False
+        cell.count(True)
+        return True
+
+    def crash_drill_ok(self, cell: Cell, kill_call: int,
+                       torn_bytes: int | None) -> bool:
+        """kill -9 mid-copy at upload #``kill_call`` (optionally landing a
+        torn object first), then the gate: ONE recovery sweep leaves zero
+        permanent orphans (listing == manifest-reachable set, no pending
+        intent) and the retried copy round-trips byte-identically."""
+        metadata, data, original = self.segment()
+        real_upload = self.rsm._storage.upload
+        calls = [0]
+
+        def dying_upload(stream, key):
+            calls[0] += 1
+            if calls[0] == kill_call:
+                if torn_bytes is not None:
+                    real_upload(io.BytesIO(stream.read()[:torn_bytes]), key)
+                raise _Kill(f"kill -9 during upload #{kill_call}")
+            return real_upload(stream, key)
+
+        self.rsm._storage.upload = dying_upload
+        try:
+            try:
+                self.rsm.copy_log_segment_data(metadata, data)
+            except _Kill:
+                pass
+            except Exception:  # noqa: BLE001 - journal faults preempt the kill
+                cell.count(False)
+                return False
+            else:
+                cell.count(False)  # the kill did not fire: not a drill
+                return False
+        finally:
+            self.rsm._storage.upload = real_upload
+        if not self.sweep_ok(cell):
+            return False
+        if (self._listing() != self._manifest_reachable()
+                or self.rsm.lifecycle_journal.pending()):
+            cell.corruptions += 1  # permanent orphan / unresolved intent
+            cell.count(False)
+            return False
+        try:
+            self.rsm.copy_log_segment_data(metadata, data)  # the retry
+            self.rsm.recovery_sweeper.sweep_once()  # heals any quarantine
+            with self.rsm.fetch_log_segment(metadata, 0) as s:
+                got = s.read()
+        except Exception:  # noqa: BLE001 - clean failure is the contract
+            cell.count(False)
+            return False
+        if got != original:
+            cell.corruptions += 1
+            cell.count(False)
+            return False
+        cell.count(True)
+        return True
+
+    def evidence(self) -> dict:
+        sweeper = self.rsm.recovery_sweeper
+        return {
+            "journal": self.rsm.lifecycle_journal.status(),
+            "sweeper": {
+                "sweeps": sweeper.sweeps,
+                "orphans_deleted_total": sweeper.orphans_deleted_total,
+                "quarantines_total": sweeper.quarantines_total,
+                "journal_resolved_total": sweeper.journal_resolved_total,
+                "invariant_blocks_total": sweeper.invariant_blocks_total,
+                "sweep_failures_total": sweeper.sweep_failures_total,
+            },
+        }
+
+
+#: (kill at upload #N, torn bytes): after .log, after .indexes, mid-manifest.
+CRASH_STAGES = ((2, None), (3, None), (3, 17))
+
+
+def run_lifecycle_cell(lc: LifecycleHarness, cell: Cell, seed: int) -> dict:
+    before = retry_ledger().snapshot()
+    plane = arm(cell.rule, seed)
+    try:
+        for _ in range(3):
+            lc.copy_fetch_ok(cell)
+        lc.sweep_ok(cell)  # the lifecycle.sweep cells fail HERE, cleanly
+        t0 = time.monotonic()
+        with deadline_scope(Deadline.after_ms(250)):
+            lc.copy_fetch_ok(cell)
+        cell.shed_wall_s = time.monotonic() - t0
+    finally:
+        heal()
+    # Recovery: the crash matrix — kill at each upload stage x one sweep.
+    drills_ok = all(
+        [lc.crash_drill_ok(cell, kill_call, torn)
+         for kill_call, torn in CRASH_STAGES]
+    )
+    cell.evidence["crash_drills_ok"] = drills_ok
+    for _ in range(2):
+        lc.copy_fetch_ok(cell)
+    if cell.kind in ("error", "flaky"):
+        cell.breaker_ok, cell.evidence["drill"] = breaker_drill(
+            cell.site, cell.rule, seed
+        )
+    if not drills_ok:
+        cell.corruptions += 1  # a failed drill is an integrity failure
+    cell.evidence["lifecycle"] = lc.evidence()
+    return cell.verdict(ledger_delta(before), plane.snapshot())
+
+
 # ------------------------------------------------------------------ self-checks
 def determinism_check(seed: int) -> bool:
     """Same seed + same call sequence => identical injection schedule."""
@@ -795,6 +991,7 @@ def run_matrix(out_path: pathlib.Path, seed: int) -> dict:
         (workdir / "storage").mkdir(exist_ok=True)
         storage = StorageHarness(workdir / "storage")
         device: DeviceHarness | None = None
+        lifecycle: LifecycleHarness | None = None
         try:
             for site, kind, rule in CELLS:
                 cell = Cell(site, kind, rule)
@@ -806,6 +1003,12 @@ def run_matrix(out_path: pathlib.Path, seed: int) -> dict:
                     result = run_peer_cell(cell, seed)
                 elif site == "gossip.probe":
                     result = run_gossip_cell(cell, seed)
+                elif site.startswith("lifecycle."):
+                    if lifecycle is None:
+                        lifecycle_dir = workdir / "lifecycle"
+                        lifecycle_dir.mkdir(exist_ok=True)
+                        lifecycle = LifecycleHarness(lifecycle_dir)
+                    result = run_lifecycle_cell(lifecycle, cell, seed)
                 else:
                     if device is None:
                         device = DeviceHarness()
@@ -820,6 +1023,8 @@ def run_matrix(out_path: pathlib.Path, seed: int) -> dict:
             heal()
             if device is not None:
                 device.close()
+            if lifecycle is not None:
+                lifecycle.close()
             storage.rsm.close()
     disarmed = disarmed_check()
     say(f"disarmed zero-work check: {'ok' if disarmed else 'FAILED'}")

@@ -31,6 +31,14 @@ Gates (all recorded in ``artifacts/fleet_soak_report.json``):
    its observed lock orders and sampled shared-attribute mutations against
    the static inference (``GET /fleet/ping?witness=1``) and must report
    zero lock AND zero race violations under real multi-process contention.
+5. **Crash-consistent copy (ISSUE 20)** — the victim dies with a COPY IN
+   FLIGHT: a ``/v1/copy`` whose manifest write is stalled by a scoped
+   fault rule (``storage.write:latency~.rsm-manifest``), so the SIGKILL
+   lands after ``.log``/``.indexes`` uploaded but before the manifest —
+   the exact torn-upload state the intent journal exists for. The gate:
+   after the restart, the victim's startup recovery sweep leaves ZERO
+   permanent orphans — the stranded objects are gone and the shared
+   store's listing equals its manifest-reachable set.
 
 This is the ``make fleet-soak`` CI gate.
 """
@@ -48,6 +56,7 @@ import socket
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 
 REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
@@ -282,6 +291,15 @@ def run(out_path: pathlib.Path) -> int:
         loader.copy_log_segment_data(md, data)
     loader.close()
 
+    # The ring is a pure function of names + vnodes, so the victim is known
+    # BEFORE launch — which lets its config carry the ISSUE 20 manifest-write
+    # stall (gate 5) from the first boot.
+    ring = HashRing(INSTANCES, VNODES)
+    key_factory = ObjectKeyFactory(KEY_PREFIX, False)
+    primer_seg = WARM_SEGMENTS
+    primer_key = key_factory.key(segments[primer_seg][0], Suffix.LOG).value
+    victim, second_owner = ring.owners(primer_key, REPLICATION)
+
     ports = dict(zip(INSTANCES, free_ports(len(INSTANCES))))
     peers_arg = ",".join(f"{n}=http://127.0.0.1:{p}" for n, p in ports.items())
     sidecars: dict[str, Sidecar] = {}
@@ -313,7 +331,21 @@ def run(out_path: pathlib.Path) -> int:
             # backend or a cache tier".
             "fault.injection.enabled": True,
             "fault.schedule": [],
+            # ISSUE 20: every member journals its uploads and sweeps on
+            # start. The huge interval/grace means the ONLY sweep that can
+            # delete the drill's stranded objects is the victim's own
+            # journal-led startup recovery after the restart.
+            "lifecycle.enabled": True,
+            "lifecycle.journal.path": str(tmp / f"{name}-journal.jsonl"),
+            "lifecycle.sweep.interval.ms": 3_600_000,
+            "lifecycle.grace.ms": 3_600_000,
         }
+        if name == victim:
+            # Stall ONLY the manifest write (the sole commit point), so the
+            # kill -9 lands after .log/.indexes but before the commit.
+            config["faults.spec"] = [
+                "storage.write:latency=120000~.rsm-manifest"
+            ]
         config_path = tmp / f"{name}.json"
         config_path.write_text(json.dumps(config, indent=1))
         sidecars[name] = Sidecar(
@@ -395,24 +427,71 @@ def run(out_path: pathlib.Path) -> int:
         }
 
         # --------------------------------------- phase 2: kill -9 mid-load
-        # The ring is a pure function of names + vnodes, so the harness can
-        # pick the victim DETERMINISTICALLY as the first owner of the first
-        # cold segment: reads of that segment right after the kill (before
-        # gossip re-rings) MUST fail over to its second replica owner —
-        # the R=2 guarantee under test.
-        ring = HashRing(INSTANCES, VNODES)
-        key_factory = ObjectKeyFactory(KEY_PREFIX, False)
-        primer_seg = WARM_SEGMENTS
-        primer_key = key_factory.key(segments[primer_seg][0], Suffix.LOG).value
-        victim, second_owner = ring.owners(primer_key, REPLICATION)
+        # The victim was picked deterministically above as the first owner
+        # of the first cold segment: reads of that segment right after the
+        # kill (before gossip re-rings) MUST fail over to its second
+        # replica owner — the R=2 guarantee under test.
         survivors = [n for n in INSTANCES if n != victim]
         primer_client = next(n for n in survivors if n != second_owner)
         kill_at = KILL_PHASE_REQUESTS // 3
 
         # First third of the phase still includes the victim in rotation.
         zipf_pass(kill_at, warm_ids, list(INSTANCES))
+
+        # ISSUE 20 drill: die with a copy IN FLIGHT. The victim's config
+        # stalls manifest writes, so this /v1/copy uploads .log and
+        # .indexes, then parks on the commit point — the SIGKILL below
+        # lands exactly in the torn-upload window the intent journal covers.
+        drill_md, drill_data, _ = make_segment(SEGMENTS, tmp)
+        drill_keys = {
+            suffix: key_factory.key(drill_md, suffix).value
+            for suffix in (Suffix.LOG, Suffix.INDEXES, Suffix.MANIFEST)
+        }
+        drill_body = shimwire.encode_metadata(drill_md) + shimwire.encode_sections({
+            "log_segment": drill_data.log_segment.read_bytes(),
+            "offset_index": drill_data.offset_index.read_bytes(),
+            "time_index": drill_data.time_index.read_bytes(),
+            "producer_snapshot": drill_data.producer_snapshot_index.read_bytes(),
+            "transaction_index": None,
+            "leader_epoch_index": drill_data.leader_epoch_index,
+        })
+        drill_errors: list[BaseException] = []
+
+        def _drill_copy() -> None:
+            try:
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", ports[victim], timeout=300.0
+                )
+                conn.request("POST", "/v1/copy", body=drill_body)
+                conn.getresponse().read()
+            except OSError:
+                pass  # the kill -9 severs this connection — expected
+            except BaseException as exc:  # diagnostics for the report
+                drill_errors.append(exc)
+
+        def _in_store(key: str) -> bool:
+            return (store / key).exists()
+
+        copy_thread = threading.Thread(target=_drill_copy, daemon=True)
+        copy_thread.start()
+        # Only kill once the copy is demonstrably MID-FLIGHT: .log and
+        # .indexes durable in the shared store, manifest parked on the stall.
+        drill_deadline = time.monotonic() + 60.0
+        while not (_in_store(drill_keys[Suffix.LOG])
+                   and _in_store(drill_keys[Suffix.INDEXES])):
+            assert time.monotonic() < drill_deadline, (
+                "drill copy never reached mid-flight (no stranded objects)"
+            )
+            time.sleep(0.05)
+        assert not _in_store(drill_keys[Suffix.MANIFEST]), (
+            "drill manifest committed before the kill — the stall rule is inert"
+        )
+        stranded = sorted(
+            (drill_keys[Suffix.LOG], drill_keys[Suffix.INDEXES])
+        )
         sidecars[victim].sigkill()
         kill_wall = time.monotonic()
+        copy_thread.join(timeout=30.0)
         # Ordered-owner failover, in the window BEFORE gossip re-rings:
         # a non-owner's forward to the dead first owner fails (peer marked
         # down), the next owner serves — one extra hop, no cache arc lost.
@@ -487,6 +566,45 @@ def run(out_path: pathlib.Path) -> int:
         # -------------------------------------- phase 4: restart + rejoin
         sidecars[victim].launch()
         sidecars[victim].wait_ready()
+
+        # ISSUE 20 gate: the victim's journal-led startup sweep (it runs
+        # during configure, before SIDECAR_READY) must have erased the torn
+        # upload — journal-named orphans are deleted with no grace wait.
+        sweep_deadline = time.monotonic() + 30.0
+        while any(_in_store(k) for k in stranded):
+            assert time.monotonic() < sweep_deadline, (
+                "startup recovery sweep left permanent orphans: "
+                + repr([k for k in stranded if _in_store(k)])
+            )
+            time.sleep(0.1)
+        # Zero permanent orphans, fleet-wide: the shared store's listing is
+        # exactly its manifest-reachable set (each committed segment is the
+        # .log/.indexes/.rsm-manifest triple; nothing else survives).
+        listing = sorted(
+            str(p.relative_to(store)) for p in store.rglob("*") if p.is_file()
+        )
+        reachable = sorted(
+            m[: -len(".rsm-manifest")] + suffix
+            for m in listing if m.endswith(".rsm-manifest")
+            for suffix in (".log", ".indexes", ".rsm-manifest")
+        )
+        report["lifecycle_drill"] = {
+            "victim": victim,
+            "drill_segment": SEGMENTS,
+            "manifest_stall_rule": "storage.write:latency=120000~.rsm-manifest",
+            "stranded_at_kill": stranded,
+            "orphans_after_restart_sweep": [
+                k for k in stranded if _in_store(k)
+            ],
+            "listing_equals_manifest_reachable": listing == reachable,
+            "store_objects": len(listing),
+            "drill_copy_harness_errors": [repr(e) for e in drill_errors],
+        }
+        assert listing == reachable, (
+            "post-sweep store listing diverges from the manifest-reachable "
+            f"set: {sorted(set(listing) ^ set(reachable))}"
+        )
+
         rejoined = await_view(
             ports, set(INSTANCES),
             periods_bound=CONVERGENCE_BOUND, label="rejoin",
@@ -547,6 +665,11 @@ def run(out_path: pathlib.Path) -> int:
         w["lock_violations"] == [] and w["race_violations"] == []
         for w in parsed["witness"].values()
     )
+    drill = parsed["lifecycle_drill"]
+    assert len(drill["stranded_at_kill"]) >= 2
+    assert drill["orphans_after_restart_sweep"] == []
+    assert drill["listing_equals_manifest_reachable"] is True
+    assert drill["drill_copy_harness_errors"] == []
     print(
         f"FLEET_SOAK_OK instances={len(parsed['instances'])} "
         f"killed={parsed['kill']['victim']}(SIGKILL) "
@@ -554,6 +677,7 @@ def run(out_path: pathlib.Path) -> int:
         f"rejoin_periods={max(parsed['rejoin']['convergence_periods'].values())} "
         f"failover_hits={parsed['failover']['failover_hits']} "
         f"repeat_cache_rate={parsed['failover']['repeat_cache_tier_rate']} "
+        f"lifecycle_orphans={len(drill['orphans_after_restart_sweep'])} "
         f"byte_diffs={parsed['byte_diffs']} out={out_path}"
     )
     return 0

@@ -123,6 +123,13 @@ DEFAULT_TARGETS = [
     # off-schedule (breaking the chaos matrix's determinism contract).
     ("tieredstorage_tpu/utils/retry.py", ["tests/test_retry_policy.py"]),
     ("tieredstorage_tpu/utils/faults.py", ["tests/test_fault_plane.py"]),
+    # ISSUE 20: the crash-consistency plane is pure bookkeeping — journal
+    # record encoding/replay precedence, the sweeper's reachability set
+    # arithmetic, grace-window clocks, and the one-sided delete chokepoint.
+    # An operator flip here silently deletes committed data (the one
+    # unforgivable direction) or stops reclaiming orphans at all.
+    ("tieredstorage_tpu/storage/lifecycle.py", ["tests/test_lifecycle_journal.py"]),
+    ("tieredstorage_tpu/scrub/sweeper.py", ["tests/test_recovery_sweeper.py"]),
 ]
 
 _CMP_SWAP = {
