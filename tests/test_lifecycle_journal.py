@@ -113,6 +113,54 @@ class TestIntentRoundTrip:
             assert j.commits_total == 0 and j.rollbacks_total == 0
 
 
+class TestInflight:
+    """In-flight tracking: begin marks the txn as owned by a running
+    operation; commit/rollback/commit_delete/release clear it; replay
+    never marks (the process that began the txn is dead)."""
+
+    def test_begin_marks_inflight_and_release_clears(self, tmp_path):
+        with UploadIntentJournal(tmp_path / "j.wal") as j:
+            txn = j.begin_upload("seg-1", KEYS)
+            (entry,) = j.pending()
+            assert entry.inflight
+            j.release(txn)
+            (entry,) = j.pending()
+            assert not entry.inflight  # still pending, no longer owned
+            j.release(txn)  # idempotent
+            j.release(999)  # unknown txn: no-op
+
+    def test_resolution_clears_inflight(self, tmp_path):
+        with UploadIntentJournal(tmp_path / "j.wal") as j:
+            j.commit(j.begin_upload("u", KEYS))
+            j.rollback(j.begin_upload("r", KEYS))
+            j.commit_delete(j.begin_delete("d", KEYS))
+            assert j.status()["inflight"] == 0
+
+    def test_replayed_entries_are_not_inflight(self, tmp_path):
+        path = tmp_path / "j.wal"
+        with UploadIntentJournal(path) as j:
+            j.begin_upload("seg-u", KEYS)
+            j.begin_delete("seg-d", KEYS)
+        with reopen(path) as fresh:
+            assert fresh.status()["inflight"] == 0
+            assert all(not e.inflight for e in fresh.pending())
+
+    def test_replay_does_not_recount_tombstones(self, tmp_path):
+        path = tmp_path / "j.wal"
+        with UploadIntentJournal(path) as j:
+            j.begin_delete("seg-d", KEYS)
+            assert j.tombstones_total == 1
+        # begin_delete already counted it; a pending tombstone surviving
+        # a restart (or a compact-then-reopen cycle) must not count again.
+        with reopen(path) as fresh:
+            assert fresh.tombstones_total == 0
+            assert fresh.pending_tombstone_count == 1
+            fresh.compact()
+        with reopen(path) as again:
+            assert again.tombstones_total == 0
+            assert again.pending_tombstone_count == 1
+
+
 class TestCrashArtifacts:
     def test_torn_trailing_line_is_tolerated(self, tmp_path):
         path = tmp_path / "j.wal"

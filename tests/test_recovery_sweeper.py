@@ -334,7 +334,8 @@ class TestTombstonedDeletes:
         rsm.copy_log_segment_data(metadata, data)
         keys = ["test/" + EXPECTED_MAIN + s
                 for s in (".log", ".indexes", ".rsm-manifest")]
-        rsm.lifecycle_journal.begin_delete("seg", keys)
+        txn = rsm.lifecycle_journal.begin_delete("seg", keys)
+        rsm.lifecycle_journal.release(txn)  # the crashed delete returned
         report = rsm.recovery_sweeper.sweep_once()
         assert report.orphans_deleted == []
         assert len(listing(rsm)) == 3
@@ -409,6 +410,10 @@ class TestOneSidedness:
                 journal = UploadIntentJournal(Path(tmp) / "j.wal")
                 for stem, triple in journal_named:
                     journal.begin_upload(stem, triple)
+                # The stranded states model a CRASHED prior process:
+                # reopen so the intents are replayed (not in flight).
+                journal.close()
+                journal = UploadIntentJournal(Path(tmp) / "j.wal")
             sweeper = RecoverySweeper(
                 store, journal, prefix="p/", grace_s=0.0,
                 manifest_loader=self._loader(store),
@@ -489,6 +494,116 @@ class TestGraceWindow:
         }
 
 
+class TestLiveTransactions:
+    """A pending journal entry whose txn is still IN FLIGHT (the copy or
+    delete is running right now in this process) is untouchable: the
+    sweeper must neither delete its keys — no-grace or grace path — nor
+    resolve the txn.  ``release()`` (called by the RSM in a ``finally``)
+    hands whatever is left pending back to the sweeper."""
+
+    KEYS = ["p/s.log", "p/s.indexes", "p/s.rsm-manifest"]
+
+    def _sweeper(self, store, journal, grace_s=0.0):
+        return RecoverySweeper(store, journal, prefix="p/", grace_s=grace_s,
+                               manifest_loader=lambda k: None)
+
+    def test_live_upload_keys_survive_a_zero_grace_sweep(self, tmp_path):
+        store = InMemoryStorage()
+        store.configure({})
+        store.upload(io.BytesIO(b"x"), ObjectKey("p/s.log"))  # mid-upload
+        journal = UploadIntentJournal(tmp_path / "j.wal")
+        txn = journal.begin_upload("s", self.KEYS)
+        sweeper = self._sweeper(store, journal)
+        report = sweeper.sweep_once()
+        assert report.orphans_deleted == []
+        assert journal.pending_upload_count == 1  # NOT resolved
+        # The copy finishes: indexes + manifest land, commit — nothing of
+        # the now-committed segment was destroyed by the racing sweep.
+        store.upload(io.BytesIO(b"y"), ObjectKey("p/s.indexes"))
+        store.upload(io.BytesIO(b"{}"), ObjectKey("p/s.rsm-manifest"))
+        journal.commit(txn)
+        sweeper.sweep_once()
+        assert {k.value for k in store.list_objects("p/")} == set(self.KEYS)
+        journal.close()
+
+    def test_live_txn_with_no_keys_is_not_rolled_back(self, tmp_path):
+        store = InMemoryStorage()
+        store.configure({})
+        journal = UploadIntentJournal(tmp_path / "j.wal")
+        txn = journal.begin_upload("s", self.KEYS)  # first byte not landed
+        sweeper = self._sweeper(store, journal)
+        sweeper.sweep_once()
+        # Resolving a live intent would un-name the upload's keys: a crash
+        # right after would strand them behind the grace window, and the
+        # owner's later commit() would be a silent counter no-op.
+        assert journal.pending_upload_count == 1
+        assert sweeper.journal_resolved_total == 0
+        journal.release(txn)  # the copy failed and returned
+        sweeper.sweep_once()
+        assert journal.pending() == []  # nothing stranded: resolved now
+        journal.close()
+
+    def test_release_enables_no_grace_deletion(self, tmp_path):
+        store = InMemoryStorage()
+        store.configure({})
+        for k in self.KEYS[:2]:
+            store.upload(io.BytesIO(b"x"), ObjectKey(k))
+        journal = UploadIntentJournal(tmp_path / "j.wal")
+        txn = journal.begin_upload("s", self.KEYS)
+        sweeper = self._sweeper(store, journal, grace_s=3600.0)
+        assert sweeper.sweep_once().orphans_deleted == []  # in flight
+        journal.release(txn)  # copy failed AND its rollback cleanup failed
+        report = sweeper.sweep_once()  # journal-named: no grace wait
+        assert sorted(report.orphans_deleted) == sorted(self.KEYS[:2])
+        assert journal.pending() == []
+        journal.close()
+
+    def test_live_tombstone_is_not_finished_by_the_sweeper(self, tmp_path):
+        store = InMemoryStorage()
+        store.configure({})
+        for k in self.KEYS[:2]:  # manifest-first phase already ran
+            store.upload(io.BytesIO(b"x"), ObjectKey(k))
+        journal = UploadIntentJournal(tmp_path / "j.wal")
+        txn = journal.begin_delete("s", self.KEYS)
+        sweeper = self._sweeper(store, journal)
+        report = sweeper.sweep_once()
+        assert report.orphans_deleted == []
+        assert report.tombstones_completed == 0
+        assert journal.pending_tombstone_count == 1
+        journal.release(txn)  # the delete returned (partial failure)
+        sweeper.sweep_once()
+        assert list(store.list_objects("p/")) == []
+        assert journal.pending_tombstone_count == 0
+        journal.close()
+
+
+class TestStatusReads:
+    def test_orphans_pending_is_lock_free_during_a_sweep(self):
+        """Gauges and status() read orphans_pending while a pass holds the
+        sweeper lock across the listing and deletes; the read must come
+        from the end-of-pass snapshot, never block behind the pass."""
+        import threading
+
+        store = InMemoryStorage()
+        store.configure({})
+        store.upload(io.BytesIO(b"{}"), ObjectKey("p/a.rsm-manifest"))
+        reads: list = []
+
+        def loader(key):  # runs mid-pass, sweeper lock held
+            t = threading.Thread(
+                target=lambda: reads.append(sweeper.orphans_pending)
+            )
+            t.start()
+            t.join(timeout=5.0)
+            assert not t.is_alive(), "orphans_pending blocked behind the sweep"
+            return None
+
+        sweeper = RecoverySweeper(store, None, prefix="p/", grace_s=60.0,
+                                  manifest_loader=loader)
+        sweeper.sweep_once()
+        assert reads == [0]
+
+
 class TestSchedulerAndFaults:
     def test_sweep_fault_site_counts_and_recovers(self):
         store = InMemoryStorage()
@@ -554,7 +669,8 @@ class TestMutationBoundaries:
         for k in keys[:2]:  # the delete's manifest-first phase already ran
             store.upload(io.BytesIO(b"x"), ObjectKey(k))
         journal = UploadIntentJournal(tmp_path / "j.wal")
-        journal.begin_delete("s", keys)
+        txn = journal.begin_delete("s", keys)
+        journal.release(txn)  # the interrupted delete is not in flight
         real_delete = store.delete
 
         def flaky_delete(key):

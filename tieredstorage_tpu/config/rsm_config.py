@@ -615,14 +615,24 @@ def _base_def() -> ConfigDef:
             "sweep (zero permanent orphans after one sweep).",
     ))
     d.define(ConfigKey(
-        "lifecycle.grace.ms", "long", default=300_000,
-        validator=in_range(0, None), importance="low",
+        "lifecycle.grace.ms", "long", default=14_400_000,
+        validator=in_range(0, None), importance="medium",
         doc="Grace window for orphan candidates the journal does NOT name "
-            "(another writer's in-flight upload, a foreign journal's "
-            "crash): deleted only after staying manifest-unreachable this "
-            "long past the sweeper first seeing them. Journal-named "
-            "orphans need no grace — the journal proves no commit "
-            "happened.",
+            "(another broker's in-flight upload on the fleet-shared "
+            "prefix, a foreign journal's crash): deleted only after "
+            "staying manifest-unreachable this long past the sweeper "
+            "first seeing them. MUST comfortably exceed the slowest "
+            "end-to-end segment upload (.log + .indexes + manifest) any "
+            "fleet member can perform — the sweeper lists the shared "
+            "prefix, so a peer's uncommitted objects are protected ONLY "
+            "by this window, and a too-small value lets a sweep delete "
+            "them mid-upload (cross-process data loss: the peer's "
+            "manifest then lands referencing missing keys). The default "
+            "is 4 hours; values under 10 minutes are warned about at "
+            "startup. This process's own in-flight uploads are exempt "
+            "via the journal's in-flight tracking, and journal-named "
+            "orphans of finished operations need no grace — the journal "
+            "proves no commit happened.",
     ))
     d.define(ConfigKey(
         "flight.enabled", "bool", default=False, importance="medium",

@@ -594,6 +594,18 @@ class RemoteStorageManager:
         self._lifecycle_journal = UploadIntentJournal(
             Path(config.lifecycle_journal_path)
         )
+        if config.lifecycle_grace_ms < 600_000:
+            # The grace window is the ONLY protection for a fleet peer's
+            # in-progress upload on the shared prefix (this process's own
+            # are exempt via in-flight tracking); below the slowest
+            # end-to-end segment upload it becomes cross-process data loss.
+            log.warning(
+                "lifecycle.grace.ms=%d is under 10 minutes: any fleet "
+                "peer's segment upload outlasting it can have its "
+                "uncommitted objects swept mid-upload. Size it above the "
+                "slowest end-to-end upload (default 4h).",
+                config.lifecycle_grace_ms,
+            )
 
         def load_manifest(manifest_key: str) -> SegmentManifestV1:
             return self._fetch_manifest_raw(ObjectKey(manifest_key))
@@ -1257,6 +1269,14 @@ class RemoteStorageManager:
                 # boundaries map it to 504 / DEADLINE_EXCEEDED.
                 raise
             raise RemoteStorageException(f"Failed to copy segment {metadata}") from e
+        finally:
+            # This copy is no longer in flight (committed, rolled back, or
+            # left pending by a failed cleanup): release the txn so the
+            # recovery sweeper may converge whatever it left behind.  While
+            # in flight the sweeper must not touch the txn's keys — a paced
+            # sweep racing this upload would otherwise delete objects whose
+            # manifest is about to land.
+            self._journal_release(txn)
 
         elapsed = time.monotonic() - start
         topic, partition = self._topic_partition(metadata)
@@ -1332,6 +1352,12 @@ class RemoteStorageManager:
     def _journal_rollback(self, txn: Optional[int]) -> None:
         if txn is not None and self._lifecycle_journal is not None:
             self._lifecycle_journal.rollback(txn)
+
+    def _journal_release(self, txn: Optional[int]) -> None:
+        """Mark ``txn`` no longer in flight (the owning copy/delete has
+        returned); the sweeper may then act on anything still pending."""
+        if txn is not None and self._lifecycle_journal is not None:
+            self._lifecycle_journal.release(txn)
 
     def _storage_upload(self, stream: BinaryIO, key) -> int:
         """Segment-object upload chokepoint: the ``storage.write`` injection
@@ -1633,6 +1659,7 @@ class RemoteStorageManager:
             topic, partition, metadata.segment_size_in_bytes
         )
         start = time.monotonic()
+        txn: Optional[int] = None
         try:
             keys = [self._object_key(metadata, s) for s in Suffix]
             # Tombstone BEFORE the first delete (`lifecycle.enabled`): a
@@ -1653,6 +1680,10 @@ class RemoteStorageManager:
         except StorageBackendException as e:
             self._metrics.record_segment_delete_error(topic, partition)
             raise RemoteStorageException(f"Failed to delete {metadata}") from e
+        finally:
+            # The delete is no longer in flight; a tombstone left pending
+            # by a partial failure is now the sweeper's to finish.
+            self._journal_release(txn)
         self._metrics.record_segment_delete_time(
             topic, partition, (time.monotonic() - start) * 1000.0
         )

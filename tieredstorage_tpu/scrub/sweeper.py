@@ -12,19 +12,29 @@ three sources of truth:
    itself and the ``.log``/``.indexes`` keys it references.  Manifest-last
    upload is the sole commit point, so "reachable from a present manifest"
    IS "committed".
-3. **The journal** — pending upload intents name keys a crash stranded
-   (deletable immediately, no grace needed: the journal proves no commit
-   happened); pending tombstones name keys a crashed/retried delete must
-   still remove.
+3. **The journal** — pending upload intents whose owning operation is no
+   longer running name keys a crash (or a failed rollback cleanup)
+   stranded — deletable immediately, no grace needed: the journal proves
+   no commit happened.  Pending tombstones name keys a crashed/retried
+   delete must still remove.  Entries whose txn is still IN FLIGHT (the
+   copy/delete is running right now in this process — see
+   ``UploadIntentJournal.release``) are untouchable: the sweeper neither
+   resolves them nor considers their keys, because a paced sweep racing a
+   live upload would otherwise delete objects whose manifest is about to
+   land, leaving a committed manifest over missing keys.
 
 Verdicts per pass:
 
 * **Orphans** — data objects reachable from no manifest.  Journal-named
-  orphans are deleted in the FIRST sweep after a crash ("zero permanent
-  orphans after one recovery sweep").  Orphans the journal does not name
-  (another writer's in-flight upload, a foreign journal's crash) must
-  out-wait a grace window measured from when THIS sweeper first saw them —
-  object stores expose no portable mtime, so first-seen is the clock.
+  orphans (of non-in-flight intents) are deleted in the FIRST sweep after
+  a crash ("zero permanent orphans after one recovery sweep").  Orphans
+  the journal does not name (ANOTHER broker's in-flight upload on the
+  shared prefix, a foreign journal's crash) must out-wait a grace window
+  measured from when THIS sweeper first saw them — object stores expose
+  no portable mtime, so first-seen is the clock.  The grace window is the
+  ONLY thing protecting a peer's in-progress upload, so it must exceed
+  the slowest end-to-end segment upload (``lifecycle.grace.ms``
+  documents and defaults accordingly).
 * **Quarantined manifests** — a manifest that is unreadable or references a
   missing object is quarantined: never served (the RSM refuses it), counted,
   surfaced as gauges.  The quarantine set is recomputed every pass, so a
@@ -137,6 +147,9 @@ class RecoverySweeper:
         self._lock = new_lock("sweeper.RecoverySweeper._lock")
         #: Orphan candidate → monotonic instant this sweeper first saw it.
         self._first_seen: Dict[str, float] = {}
+        #: len(_first_seen) snapshotted at the end of each pass so gauges
+        #: and status() never block behind a sweep holding the pass lock.
+        self._orphans_pending_count = 0
         #: Manifest keys quarantined by the LAST pass (recomputed per pass).
         self._quarantined: frozenset = frozenset()
         # Cumulative counters (gauge suppliers read these).
@@ -159,8 +172,11 @@ class RecoverySweeper:
 
     @property
     def orphans_pending(self) -> int:
-        with self._lock:
-            return len(self._first_seen)
+        """Orphan candidates inside their grace window, as of the end of
+        the last pass.  Deliberately lock-free: a sweep holds the pass
+        lock across the store listing and per-key deletes, and metrics
+        gauges / status endpoints must not block for that long."""
+        return self._orphans_pending_count
 
     # ------------------------------------------------------------------- pass
     def sweep_once(self) -> SweepReport:
@@ -263,6 +279,14 @@ class RecoverySweeper:
         if self._journal is None:
             return
         for entry in self._journal.pending():
+            if entry.inflight:
+                # The owning copy/delete is running RIGHT NOW in this
+                # process.  Its outcome is not ours to decide: committing
+                # it early double-counts, rolling it back un-names an
+                # upload whose first byte merely hasn't landed yet, and
+                # finishing its delete races the owner.  The owner (or
+                # its release() + a later pass) resolves it.
+                continue
             manifest_keys = [k for k in entry.keys if k.endswith(MANIFEST_SUFFIX)]
             if entry.kind == UPLOAD:
                 if any(k in present for k in manifest_keys):
@@ -300,30 +324,38 @@ class RecoverySweeper:
                         report.tombstones_completed += 1
                         report.journal_resolved += 1
 
-    def _journal_named_orphans(self) -> set:
-        """Keys a pending (uncommitted) intent names — deletable without
-        grace: OUR journal proves no commit happened."""
+    def _journal_key_sets(self) -> tuple:
+        """``(named, inflight)`` key sets from the pending journal.
+        ``named`` keys belong to resolved-from-our-side intents (the
+        owning operation is no longer running) — deletable without grace:
+        OUR journal proves no commit happened.  ``inflight`` keys belong
+        to operations running right now in this process — untouchable,
+        not even grace-tracked (a key in both sets, e.g. a retried copy
+        of a previously-stranded segment, counts as in flight)."""
         if self._journal is None:
-            return set()
+            return set(), set()
         named: set = set()
+        inflight: set = set()
         for entry in self._journal.pending():
-            named.update(entry.keys)
-        return named
+            (inflight if entry.inflight else named).update(entry.keys)
+        return named - inflight, inflight
 
     # ---------------------------------------------------------------- orphans
     def _sweep_orphans(
         self, present: set, protected: set, report: SweepReport
     ) -> None:
-        named = self._journal_named_orphans()
+        named, inflight = self._journal_key_sets()
         now = self._clock()
         candidates = [
             k for k in present
             if k not in protected and not k.endswith(MANIFEST_SUFFIX)
+            and k not in inflight
         ]
         # Drop first-seen tracking for keys that stopped being candidates
-        # (committed by a late manifest, or deleted by their writer).
-        live = set(candidates)
-        for stale in [k for k in self._first_seen if k not in live]:
+        # (committed by a late manifest, deleted by their writer, or
+        # claimed by a new in-flight operation).
+        candidate_set = set(candidates)
+        for stale in [k for k in self._first_seen if k not in candidate_set]:
             del self._first_seen[stale]
         note_mutation("sweeper.RecoverySweeper._first_seen")
         for key in sorted(candidates):
@@ -337,6 +369,7 @@ class RecoverySweeper:
                 self._first_seen.pop(key, None)
             else:
                 report.orphans_pending.append(key)
+        self._orphans_pending_count = len(self._first_seen)
 
     def _delete_orphan(
         self, key: str, present: set, protected: set, report: SweepReport

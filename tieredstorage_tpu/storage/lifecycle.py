@@ -43,6 +43,17 @@ mid-append); torn records are counted, never fatal.  ``compact()`` rewrites
 the file with only the still-pending entries via a temp file +
 ``os.replace`` so the journal stays small across long uptimes.
 
+**In-flight tracking** (process-local, never persisted): ``begin_upload``/
+``begin_delete`` mark their txn *in flight* until the owning operation
+returns — ``commit``/``rollback``/``commit_delete`` clear it, and the RSM's
+copy/delete paths call ``release(txn)`` in a ``finally`` so a txn left
+pending by a failed rollback cleanup is still released.  A pending entry
+whose txn is in flight belongs to an operation running RIGHT NOW in this
+process; the recovery sweeper must neither resolve it nor touch its keys
+(a paced sweep racing a live upload would otherwise delete objects the
+copy is about to commit).  Entries rebuilt by replay are never in flight —
+the process that began them is dead.
+
 The ``lifecycle.journal`` fault-plane site (utils/faults.py) fires before
 every append, so chaos runs can fail/stall journaling without touching the
 store.
@@ -87,6 +98,9 @@ class JournalEntry:
     segment: str
     keys: List[str]
     stage: Optional[str] = None
+    #: The owning operation is running right now in THIS process (snapshot
+    #: taken by pending()); such entries are untouchable to the sweeper.
+    inflight: bool = False
 
     def to_json(self) -> dict:
         return {
@@ -95,6 +109,7 @@ class JournalEntry:
             "segment": self.segment,
             "keys": list(self.keys),
             "stage": self.stage,
+            "inflight": self.inflight,
         }
 
 
@@ -128,6 +143,9 @@ class UploadIntentJournal:
         self.compact_bytes = compact_bytes
         self._lock = new_lock("lifecycle.UploadIntentJournal._lock")
         self._pending: Dict[int, JournalEntry] = {}
+        #: Txns whose owning operation is running in this process (see the
+        #: module docstring); never persisted, never populated by replay.
+        self._inflight: set = set()
         self._next_txn = 1
         self._c = _Counters()
         self._closed = False
@@ -153,6 +171,7 @@ class UploadIntentJournal:
                 critical=True,
             )
             self._pending[txn] = entry
+            self._inflight.add(txn)
             note_mutation("lifecycle.UploadIntentJournal._pending")
             return txn
 
@@ -171,6 +190,7 @@ class UploadIntentJournal:
         with self._lock:
             if self._pending.pop(txn, None) is None:
                 return
+            self._inflight.discard(txn)
             note_mutation("lifecycle.UploadIntentJournal._pending")
             self._c.commits_total += 1
             self._append({"rec": "commit", "txn": txn}, critical=False)
@@ -181,6 +201,7 @@ class UploadIntentJournal:
         with self._lock:
             if self._pending.pop(txn, None) is None:
                 return
+            self._inflight.discard(txn)
             note_mutation("lifecycle.UploadIntentJournal._pending")
             self._c.rollbacks_total += 1
             self._append({"rec": "rollback", "txn": txn}, critical=False)
@@ -200,6 +221,7 @@ class UploadIntentJournal:
             )
             self._c.tombstones_total += 1
             self._pending[txn] = entry
+            self._inflight.add(txn)
             note_mutation("lifecycle.UploadIntentJournal._pending")
             return txn
 
@@ -208,17 +230,32 @@ class UploadIntentJournal:
         with self._lock:
             if self._pending.pop(txn, None) is None:
                 return
+            self._inflight.discard(txn)
             note_mutation("lifecycle.UploadIntentJournal._pending")
             self._c.tombstone_commits_total += 1
             self._append({"rec": "tombstone-commit", "txn": txn},
                          critical=False)
             self._maybe_compact()
 
+    def release(self, txn: int) -> None:
+        """The operation owning ``txn`` has returned (committed, rolled
+        back, or failed with its entry left pending): clear the in-flight
+        mark so the recovery sweeper may act on whatever it left behind.
+        Called from a ``finally`` on the RSM copy/delete paths; idempotent,
+        a no-op for resolved or unknown txns.  Appends nothing — in-flight
+        is process-local state, meaningless across restarts."""
+        with self._lock:
+            self._inflight.discard(txn)
+            note_mutation("lifecycle.UploadIntentJournal._inflight")
+
     # ---------------------------------------------------------------- queries
     def pending(self) -> List[JournalEntry]:
         with self._lock:
-            return [JournalEntry(e.txn, e.kind, e.segment, list(e.keys), e.stage)
-                    for e in self._pending.values()]
+            return [
+                JournalEntry(e.txn, e.kind, e.segment, list(e.keys), e.stage,
+                             inflight=e.txn in self._inflight)
+                for e in self._pending.values()
+            ]
 
     def pending_uploads(self) -> List[JournalEntry]:
         return [e for e in self.pending() if e.kind == UPLOAD]
@@ -278,6 +315,7 @@ class UploadIntentJournal:
                 "pending_tombstones": sum(
                     1 for e in self._pending.values() if e.kind == DELETE
                 ),
+                "inflight": len(self._inflight),
                 "appends_total": self._c.appends_total,
                 "append_failures_total": self._c.append_failures_total,
                 "torn_records_total": self._c.torn_records_total,
@@ -340,11 +378,14 @@ class UploadIntentJournal:
                     [str(k) for k in rec.get("keys", [])],
                 )
             elif kind == "tombstone":
+                # Replay only rebuilds pending state; tombstones_total was
+                # already counted by the begin_delete that wrote the record
+                # (re-counting here would skew the metric on every restart
+                # or compact-then-replay cycle).
                 self._pending[txn] = JournalEntry(
                     txn, DELETE, str(rec.get("segment", "")),
                     [str(k) for k in rec.get("keys", [])],
                 )
-                self._c.tombstones_total += 1
             elif kind == "stage":
                 entry = self._pending.get(txn)
                 if entry is not None:
